@@ -1,0 +1,44 @@
+"""Crypto layer: digests, ed25519 keys/signatures, signature service.
+
+Parity map (SURVEY.md §2.1): Digest/Hash, PublicKey/SecretKey, keygen,
+Signature (+verify_batch), SignatureService — reference crate ``crypto/``.
+"""
+
+from .digest import DIGEST_SIZE, Digest, Hashable, sha512_trunc
+from .keys import (
+    PUBLIC_KEY_SIZE,
+    SECRET_KEY_SIZE,
+    PublicKey,
+    SecretKey,
+    generate_keypair,
+    generate_production_keypair,
+    keypair_stream,
+)
+from .service import CpuVerifier, SignatureService, VerifierBackend
+from .signature import (
+    SIGNATURE_SIZE,
+    CryptoError,
+    Signature,
+    batch_verify_arrays,
+)
+
+__all__ = [
+    "DIGEST_SIZE",
+    "Digest",
+    "Hashable",
+    "sha512_trunc",
+    "PUBLIC_KEY_SIZE",
+    "SECRET_KEY_SIZE",
+    "PublicKey",
+    "SecretKey",
+    "generate_keypair",
+    "generate_production_keypair",
+    "keypair_stream",
+    "CpuVerifier",
+    "SignatureService",
+    "VerifierBackend",
+    "SIGNATURE_SIZE",
+    "CryptoError",
+    "Signature",
+    "batch_verify_arrays",
+]

@@ -1,0 +1,204 @@
+"""Pure-Python RFC 8032 Ed25519 — the framework's arithmetic oracle.
+
+This module is the single source of truth for curve math semantics:
+- the JAX TPU batch-verify kernel (``hotstuff_tpu.tpu.ed25519``) is tested
+  for bit-exact agreement with it,
+- it provides point (de)compression used to precompute committee-member
+  points for the TPU kernel,
+- and it is the fallback CPU path if neither ``cryptography`` nor libsodium
+  is available.
+
+It intentionally uses arbitrary-precision Python ints — slow but obviously
+correct, validated against the RFC 8032 test vectors in
+``tests/test_crypto.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+# --- Field: GF(2^255 - 19) ---------------------------------------------------
+
+P = 2**255 - 19
+# Group order L = 2^252 + 27742317777372353535851937790883648493
+L = 2**252 + 27742317777372353535851937790883648493
+# Edwards curve: -x^2 + y^2 = 1 + d x^2 y^2
+D = (-121665 * pow(121666, P - 2, P)) % P
+# sqrt(-1) mod p, used in decompression
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+
+def inv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+# --- Points (extended homogeneous coordinates X:Y:Z:T, x=X/Z, y=Y/Z, T=XY/Z) --
+
+# Base point B: y = 4/5, x recovered with positive... even x convention per RFC.
+_By = (4 * inv(5)) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """Solve x^2 = (y^2-1)/(d y^2+1); return x with parity ``sign``, or None."""
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * inv(D * y * y + 1) % P
+    if x2 == 0:
+        if sign:
+            return None
+        return 0
+    # square root via candidate x = x2^((p+3)/8)
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+_Bx = _recover_x(_By, 0)
+assert _Bx is not None
+BASE_AFFINE = (_Bx, _By)
+B_POINT = (_Bx, _By, 1, _Bx * _By % P)
+IDENTITY = (0, 1, 1, 0)
+
+Point = tuple[int, int, int, int]
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Unified addition, extended coords (add-2008-hwcd-3)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    Bv = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * T1 * T2 * D % P
+    Dv = 2 * Z1 * Z2 % P
+    E = Bv - A
+    F = Dv - C
+    G = Dv + C
+    H = Bv + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_double(p: Point) -> Point:
+    """Doubling, extended coords (dbl-2008-hwcd)."""
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    Bv = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    H = A + Bv
+    E = H - (X1 + Y1) * (X1 + Y1) % P
+    G = A - Bv
+    F = C + G
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_mul(s: int, p: Point) -> Point:
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p)
+        p = point_double(p)
+        s >>= 1
+    return q
+
+
+def point_neg(p: Point) -> Point:
+    X, Y, Z, T = p
+    return ((P - X) % P, Y, Z, (P - T) % P)
+
+
+def point_equal(p: Point, q: Point) -> bool:
+    # x1/z1 == x2/z2  <=>  x1*z2 == x2*z1 (likewise y)
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    return (X1 * Z2 - X2 * Z1) % P == 0 and (Y1 * Z2 - Y2 * Z1) % P == 0
+
+
+def point_compress(p: Point) -> bytes:
+    X, Y, Z, _ = p
+    zinv = inv(Z)
+    x = X * zinv % P
+    y = Y * zinv % P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def point_decompress(s: bytes) -> Point | None:
+    if len(s) != 32:
+        return None
+    enc = int.from_bytes(s, "little")
+    y = enc & ((1 << 255) - 1)
+    sign = enc >> 255
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def point_affine(p: Point) -> tuple[int, int]:
+    X, Y, Z, _ = p
+    zinv = inv(Z)
+    return X * zinv % P, Y * zinv % P
+
+
+def is_on_curve(x: int, y: int) -> bool:
+    return (-x * x + y * y - 1 - D * x * x * y * y) % P == 0
+
+
+# --- Scalars -----------------------------------------------------------------
+
+
+def _sha512_int(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "little")
+
+
+def secret_expand(seed32: bytes) -> tuple[int, bytes]:
+    h = hashlib.sha512(seed32).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_from_seed(seed32: bytes) -> bytes:
+    a, _ = secret_expand(seed32)
+    return point_compress(point_mul(a, B_POINT))
+
+
+def sign(seed32: bytes, msg: bytes) -> bytes:
+    a, prefix = secret_expand(seed32)
+    A = point_compress(point_mul(a, B_POINT))
+    r = _sha512_int(prefix, msg) % L
+    Rs = point_compress(point_mul(r, B_POINT))
+    k = _sha512_int(Rs, A, msg) % L
+    s = (r + k * a) % L
+    return Rs + int.to_bytes(s, 32, "little")
+
+
+def verify_challenge(sig: bytes, pub: bytes, msg: bytes) -> int:
+    """k = SHA-512(R || A || M) mod L — the scalar the TPU kernel consumes."""
+    return _sha512_int(sig[:32], pub, msg) % L
+
+
+def verify(sig: bytes, pub: bytes, msg: bytes) -> bool:
+    """RFC 8032 verification: [s]B == R + [k]A, with canonical-s check."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    A = point_decompress(pub)
+    if A is None:
+        return False
+    Rp = point_decompress(sig[:32])
+    if Rp is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = verify_challenge(sig, pub, msg)
+    sB = point_mul(s, B_POINT)
+    kA = point_mul(k, A)
+    return point_equal(sB, point_add(Rp, kA))

@@ -1,0 +1,117 @@
+"""SignatureService — the actor holding the node's secret key.
+
+Parity target: reference ``SignatureService`` (``crypto/src/lib.rs:232-257``):
+callers submit a digest and await the signature through a oneshot. This is
+the trait boundary the TPU backend slots behind (BASELINE.json north star):
+``VerifierBackend`` decides where *verification* work runs (CPU loop vs
+batched TPU kernel); signing stays on CPU (one ~25 µs OpenSSL sign per
+vote/block is never the bottleneck — QC verify is).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable, Protocol
+
+from .digest import Digest
+from .keys import PublicKey, SecretKey
+from .signature import CryptoError, Signature
+
+
+class VerifierBackend(Protocol):
+    """Where batched verification work executes."""
+
+    def verify_one(self, digest: Digest, pk: PublicKey, sig: Signature) -> bool: ...
+
+    def verify_shared_msg(
+        self, digest: Digest, votes: list[tuple[PublicKey, Signature]]
+    ) -> bool:
+        """All signatures over one shared digest (QC verify shape)."""
+        ...
+
+
+class CpuVerifier:
+    """Default backend: per-signature OpenSSL verification."""
+
+    name = "cpu"
+
+    def verify_one(self, digest: Digest, pk: PublicKey, sig: Signature) -> bool:
+        try:
+            sig.verify(digest, pk)
+            return True
+        except CryptoError:
+            return False
+
+    def verify_shared_msg(
+        self, digest: Digest, votes: list[tuple[PublicKey, Signature]]
+    ) -> bool:
+        try:
+            Signature.verify_batch(digest, votes)
+            return True
+        except CryptoError:
+            return False
+
+
+class SignatureService:
+    """Asyncio actor owning the secret key; a queue of (digest, future).
+
+    The parsed private key is constructed once and reused across sign
+    requests; ``shutdown()`` fails all pending requests, drops the key, and
+    wipes the secret, after which further requests raise.
+    """
+
+    def __init__(self, secret: SecretKey, channel_capacity: int = 100):
+        self._queue: asyncio.Queue[tuple[Digest, asyncio.Future[Signature]]] = (
+            asyncio.Queue(maxsize=channel_capacity)
+        )
+        self._secret = secret
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        self._key: object | None = Ed25519PrivateKey.from_private_bytes(secret.seed)
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="signature-service"
+            )
+
+    async def _run(self) -> None:
+        while True:
+            digest, fut = await self._queue.get()
+            if fut.cancelled():
+                continue
+            try:
+                fut.set_result(self.sign_sync(digest))
+            except Exception as e:  # surface the failure to the caller
+                fut.set_exception(e)
+
+    async def request_signature(self, digest: Digest) -> Signature:
+        if self._closed:
+            raise RuntimeError("SignatureService is shut down")
+        self._ensure_started()
+        fut: asyncio.Future[Signature] = asyncio.get_running_loop().create_future()
+        await self._queue.put((digest, fut))
+        return await fut
+
+    def sign_sync(self, digest: Digest) -> Signature:
+        """Synchronous signing for tests/fixtures (reference ``new_from_key``
+        test constructors, consensus/src/tests/common.rs:48-114)."""
+        if self._closed or self._key is None:
+            raise RuntimeError("SignatureService is shut down")
+        return Signature(self._key.sign(digest.to_bytes()))  # type: ignore[attr-defined]
+
+    def shutdown(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("SignatureService is shut down"))
+        self._key = None
+        self._secret.wipe()

@@ -1,0 +1,5 @@
+"""Shared utilities: fixed-size byte value types, canonical codec, logging."""
+
+from .fixed_bytes import FixedBytes
+
+__all__ = ["FixedBytes"]

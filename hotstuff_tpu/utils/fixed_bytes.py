@@ -1,0 +1,70 @@
+"""Fixed-size immutable byte value types.
+
+One shared implementation of the plumbing the reference repeats per type
+(base64 (de)serialization, ordering, hashing, truncated display —
+reference ``crypto/src/lib.rs`` Digest/PublicKey/Signature impls).
+"""
+
+from __future__ import annotations
+
+import base64
+
+
+class FixedBytes:
+    """Base for 32/64-byte value types. Subclasses set ``SIZE``."""
+
+    SIZE = 0
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes | None = None):
+        if data is None:
+            data = b"\x00" * self.SIZE
+        if len(data) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} must be {self.SIZE} bytes, got {len(data)}"
+            )
+        object.__setattr__(self, "data", bytes(data))
+
+    def to_bytes(self) -> bytes:
+        return self.data
+
+    @property
+    def size(self) -> int:
+        return self.SIZE
+
+    def encode_base64(self) -> str:
+        return base64.b64encode(self.data).decode()
+
+    @classmethod
+    def decode_base64(cls, s: str):
+        return cls(base64.b64decode(s))
+
+    def __eq__(self, other: object) -> bool:
+        return type(other) is type(self) and other.data == self.data  # type: ignore[attr-defined]
+
+    def __lt__(self, other) -> bool:
+        self._check_type(other)
+        return self.data < other.data
+
+    def __le__(self, other) -> bool:
+        self._check_type(other)
+        return self.data <= other.data
+
+    def _check_type(self, other) -> None:
+        if type(other) is not type(self):
+            raise TypeError(
+                f"cannot compare {type(self).__name__} with {type(other).__name__}"
+            )
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.data))
+
+    def __bool__(self) -> bool:
+        return self.data != b"\x00" * self.SIZE
+
+    def __repr__(self) -> str:
+        return self.encode_base64()
+
+    def __str__(self) -> str:
+        # Display = first 16 chars of base64 (reference crypto/src/lib.rs:46-49).
+        return self.encode_base64()[:16]

@@ -1,0 +1,166 @@
+"""Crypto layer tests.
+
+Ports the reference's crypto test coverage (crypto/src/tests/crypto_tests.rs:
+key import/export round trips, single verify incl. negative cases, batch
+verify incl. negative cases, signature service) and adds RFC 8032 known-
+answer vectors for the pure-Python oracle.
+"""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.crypto import (
+    CryptoError,
+    Digest,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureService,
+    batch_verify_arrays,
+    generate_keypair,
+    generate_production_keypair,
+)
+from hotstuff_tpu.crypto import ed25519_ref as ref
+
+# RFC 8032 §7.1 test vectors (TEST 1-3).
+RFC_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC_VECTORS)
+def test_rfc8032_vectors(seed, pub, msg, sig):
+    seed, pub, msg, sig = (
+        bytes.fromhex(seed),
+        bytes.fromhex(pub),
+        bytes.fromhex(msg),
+        bytes.fromhex(sig),
+    )
+    assert ref.public_from_seed(seed) == pub
+    assert ref.sign(seed, msg) == sig
+    assert ref.verify(sig, pub, msg)
+    # flip one bit -> invalid
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    assert not ref.verify(bytes(bad), pub, msg)
+
+
+def test_ref_matches_openssl_signing():
+    pk, sk = generate_keypair(b"\x07" * 32, index=3)
+    d = Digest.of(b"hello world")
+    sig = Signature.new(d, sk)
+    assert ref.sign(sk.seed, d.to_bytes()) == sig.to_bytes()
+    assert ref.verify(sig.to_bytes(), pk.to_bytes(), d.to_bytes())
+
+
+def test_digest_basics():
+    d = Digest.of(b"payload")
+    assert d.size == 32
+    assert Digest.decode_base64(d.encode_base64()) == d
+    assert Digest.of(b"payload") == d
+    assert Digest.of(b"other") != d
+    assert len({d, Digest.of(b"payload"), Digest.of(b"other")}) == 2
+    assert Digest.random() != Digest.random()
+    assert str(d) == d.encode_base64()[:16]
+
+
+def test_key_import_export():
+    pk, sk = generate_production_keypair()
+    assert PublicKey.decode_base64(pk.encode_base64()) == pk
+    sk2 = SecretKey.decode_base64(sk.encode_base64())
+    assert sk2.to_bytes() == sk.to_bytes()
+    assert sk.public_bytes == pk.to_bytes()
+
+
+def test_seeded_keygen_deterministic():
+    a = generate_keypair(b"\x00" * 32, 0)
+    b = generate_keypair(b"\x00" * 32, 0)
+    c = generate_keypair(b"\x00" * 32, 1)
+    assert a[0] == b[0] and a[1].to_bytes() == b[1].to_bytes()
+    assert a[0] != c[0]
+
+
+def test_verify_valid_signature():
+    pk, sk = generate_production_keypair()
+    d = Digest.of(b"Hello, world!")
+    Signature.new(d, sk).verify(d, pk)  # must not raise
+
+
+def test_verify_invalid_signature():
+    pk, sk = generate_production_keypair()
+    d = Digest.of(b"Hello, world!")
+    sig = Signature.new(d, sk)
+    with pytest.raises(CryptoError):
+        sig.verify(Digest.of(b"other message"), pk)
+    other_pk, _ = generate_production_keypair()
+    with pytest.raises(CryptoError):
+        sig.verify(d, other_pk)
+
+
+def test_verify_batch():
+    d = Digest.of(b"Hello, batch!")
+    votes = []
+    for i in range(4):
+        pk, sk = generate_keypair(b"\x01" * 32, i)
+        votes.append((pk, Signature.new(d, sk)))
+    Signature.verify_batch(d, votes)  # must not raise
+
+
+def test_verify_batch_one_bad():
+    d = Digest.of(b"Hello, batch!")
+    votes = []
+    for i in range(4):
+        pk, sk = generate_keypair(b"\x02" * 32, i)
+        votes.append((pk, Signature.new(d, sk)))
+    # corrupt one signature
+    bad = bytearray(votes[2][1].to_bytes())
+    bad[10] ^= 0xFF
+    votes[2] = (votes[2][0], Signature(bytes(bad)))
+    with pytest.raises(CryptoError):
+        Signature.verify_batch(d, votes)
+
+
+def test_batch_verify_arrays_distinct_messages():
+    msgs, pks, sigs = [], [], []
+    for i in range(5):
+        pk, sk = generate_keypair(b"\x03" * 32, i)
+        d = Digest.of(bytes([i]))
+        msgs.append(d.to_bytes())
+        pks.append(pk.to_bytes())
+        sigs.append(Signature.new(d, sk).to_bytes())
+    # corrupt item 1
+    sigs[1] = bytes(64)
+    assert batch_verify_arrays(msgs, pks, sigs) == [True, False, True, True, True]
+
+
+def test_signature_service():
+    async def run():
+        pk, sk = generate_production_keypair()
+        service = SignatureService(sk)
+        d = Digest.of(b"Hello, service!")
+        sig = await service.request_signature(d)
+        sig.verify(d, pk)
+        service.shutdown()
+
+    asyncio.run(run())
