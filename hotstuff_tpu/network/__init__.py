@@ -1,0 +1,24 @@
+"""Network layer: length-delimited TCP receiver and senders.
+
+Parity map (SURVEY.md §2.3): Receiver/MessageHandler/Writer, SimpleSender
+(best-effort), ReliableSender (ACK-paired with backoff retransmit) —
+reference crate ``network/``.
+"""
+
+from .framing import FramingError, read_frame, send_frame, write_frame
+from .receiver import MessageHandler, Receiver, Writer
+from .reliable_sender import CancelHandler, ReliableSender
+from .simple_sender import SimpleSender
+
+__all__ = [
+    "FramingError",
+    "read_frame",
+    "send_frame",
+    "write_frame",
+    "MessageHandler",
+    "Receiver",
+    "Writer",
+    "CancelHandler",
+    "ReliableSender",
+    "SimpleSender",
+]

@@ -1,0 +1,79 @@
+"""Network receiver: TCP listener dispatching frames to a handler.
+
+Parity target: reference ``Receiver<Handler>`` (network/src/receiver.rs:
+18-89): bind a TCP listener, spawn one runner per accepted connection,
+decode length-delimited frames, hand each to ``handler.dispatch(writer,
+bytes)``. The handler gets the connection's writer so it can send replies
+or ACKs back on the same socket (the proposer's quorum-ACK back-pressure
+depends on this, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Protocol
+
+from .framing import FramingError, read_frame, send_frame
+
+log = logging.getLogger(__name__)
+
+
+class Writer:
+    """Reply-channel handed to MessageHandler.dispatch."""
+
+    def __init__(self, stream_writer: asyncio.StreamWriter):
+        self._writer = stream_writer
+
+    async def send(self, payload: bytes) -> None:
+        await send_frame(self._writer, payload)
+
+    @property
+    def peer(self):
+        return self._writer.get_extra_info("peername")
+
+
+class MessageHandler(Protocol):
+    async def dispatch(self, writer: Writer, message: bytes) -> None: ...
+
+
+class Receiver:
+    """Listens on ``address`` and dispatches every frame to ``handler``."""
+
+    def __init__(self, host: str, port: int, handler: MessageHandler):
+        self.host = host
+        self.port = port
+        self.handler = handler
+        self._server: asyncio.AbstractServer | None = None
+
+    async def spawn(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+        log.debug("Listening on %s:%d", self.host, self.port)
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, stream_writer: asyncio.StreamWriter
+    ) -> None:
+        peer = stream_writer.get_extra_info("peername")
+        log.debug("Incoming connection from %s", peer)
+        writer = Writer(stream_writer)
+        try:
+            while True:
+                frame = await read_frame(reader)
+                await self.handler.dispatch(writer, frame)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            FramingError,
+        ):
+            log.debug("Connection from %s closed", peer)
+        finally:
+            stream_writer.close()
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

@@ -1,0 +1,164 @@
+"""Reliable sender: per-message ACK futures, reconnect with backoff,
+retransmission of un-ACKed messages.
+
+Parity target: reference ``ReliableSender`` (network/src/reliable_sender.rs:
+25-248). Semantics reproduced exactly (SURVEY.md §5 requires them
+bit-for-bit at the protocol level — the proposer's 2f+1-ACK back-pressure
+depends on them):
+
+- every ``send`` returns a CancelHandler (here: an asyncio Future) resolved
+  with the peer's ACK payload for that message;
+- each peer has one connection task pairing sent frames with ACK frames
+  FIFO;
+- on connection failure, un-ACKed messages are retransmitted after
+  reconnecting with exponential backoff (200 ms doubling, capped at 60 s —
+  reference reliable_sender.rs:131,166);
+- messages whose future was cancelled by the caller are dropped instead of
+  retransmitted (the reference drops messages whose CancelHandler receiver
+  was dropped).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from collections import deque
+
+from .framing import FramingError, read_frame, send_frame
+
+log = logging.getLogger(__name__)
+
+CHANNEL_CAPACITY = 1000
+RETRY_DELAY_S = 0.2
+RETRY_CAP_S = 60.0
+
+Address = tuple[str, int]
+CancelHandler = asyncio.Future  # resolves to the ACK payload (bytes)
+
+
+class _Connection:
+    def __init__(self, address: Address):
+        self.address = address
+        self.queue: asyncio.Queue[tuple[bytes, CancelHandler]] = asyncio.Queue(
+            maxsize=CHANNEL_CAPACITY
+        )
+        # un-ACKed in-flight messages, FIFO-paired with incoming ACKs
+        self.pending: deque[tuple[bytes, CancelHandler]] = deque()
+        self.task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"reliable-conn-{address}"
+        )
+
+    async def _run(self) -> None:
+        delay = RETRY_DELAY_S
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(*self.address)
+            except OSError as e:
+                log.debug("Failed to connect to %s: %s", self.address, e)
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, RETRY_CAP_S)
+                continue
+            log.debug("Outgoing connection established with %s", self.address)
+            delay = RETRY_DELAY_S  # reset on success
+            try:
+                await self._keep_alive(reader, writer)
+            except (
+                ConnectionError,
+                OSError,
+                asyncio.IncompleteReadError,
+                FramingError,
+            ) as e:
+                log.warning("Connection to %s dropped: %s", self.address, e)
+            finally:
+                writer.close()
+
+    async def _keep_alive(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        # retransmit un-ACKed messages first (skip cancelled),
+        # reference reliable_sender.rs:187-199
+        self.pending = deque(
+            (d, f) for d, f in self.pending if not f.cancelled()
+        )
+        for data, _ in self.pending:
+            await send_frame(writer, data)
+
+        async def writer_loop():
+            while True:
+                data, fut = await self.queue.get()
+                if fut.cancelled():
+                    continue
+                self.pending.append((data, fut))
+                await send_frame(writer, data)
+
+        async def reader_loop():
+            while True:
+                ack = await read_frame(reader)
+                # each ACK pairs FIFO with exactly one sent frame; a frame
+                # whose caller cancelled still consumed this ACK slot
+                if self.pending:
+                    _, fut = self.pending.popleft()
+                    if not fut.cancelled():
+                        fut.set_result(ack)
+
+        wtask = asyncio.ensure_future(writer_loop())
+        rtask = asyncio.ensure_future(reader_loop())
+        try:
+            done, _ = await asyncio.wait(
+                {wtask, rtask}, return_when=asyncio.FIRST_EXCEPTION
+            )
+            for t in done:
+                exc = t.exception()
+                if exc is not None:
+                    raise exc
+        finally:
+            wtask.cancel()
+            rtask.cancel()
+
+    def close(self) -> None:
+        self.task.cancel()
+        # fail every outstanding ACK future so no caller hangs
+        while not self.queue.empty():
+            _, fut = self.queue.get_nowait()
+            if not fut.done():
+                fut.cancel()
+        for _, fut in self.pending:
+            if not fut.done():
+                fut.cancel()
+        self.pending.clear()
+
+
+class ReliableSender:
+    def __init__(self):
+        self._connections: dict[Address, _Connection] = {}
+
+    def _connection(self, address: Address) -> _Connection:
+        conn = self._connections.get(address)
+        if conn is None or conn.task.done():
+            conn = _Connection(address)
+            self._connections[address] = conn
+        return conn
+
+    async def send(self, address: Address, data: bytes) -> CancelHandler:
+        """Queue ``data`` for reliable delivery; the returned future resolves
+        with the peer's ACK payload."""
+        fut: CancelHandler = asyncio.get_running_loop().create_future()
+        await self._connection(address).queue.put((data, fut))
+        return fut
+
+    async def broadcast(
+        self, addresses: list[Address], data: bytes
+    ) -> list[CancelHandler]:
+        return [await self.send(addr, data) for addr in addresses]
+
+    async def lucky_broadcast(
+        self, addresses: list[Address], data: bytes, nodes: int
+    ) -> list[CancelHandler]:
+        picks = random.sample(addresses, min(nodes, len(addresses)))
+        return await self.broadcast(picks, data)
+
+    def close(self) -> None:
+        for conn in self._connections.values():
+            conn.close()
+        self._connections.clear()

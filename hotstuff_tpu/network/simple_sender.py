@@ -1,0 +1,104 @@
+"""Best-effort sender with persistent per-peer connections.
+
+Parity target: reference ``SimpleSender`` (network/src/simple_sender.rs:
+22-143): one long-lived connection task per peer address holding a
+persistent TCP connection and a bounded queue (capacity 1000); sending is
+pushing onto that queue; messages are dropped on connection failure; ACK
+frames arriving from the peer are read and discarded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+
+from .framing import read_frame, send_frame
+
+log = logging.getLogger(__name__)
+
+CHANNEL_CAPACITY = 1000
+
+Address = tuple[str, int]
+
+
+class _Connection:
+    """Owns one persistent best-effort TCP connection."""
+
+    def __init__(self, address: Address):
+        self.address = address
+        self.queue: asyncio.Queue[bytes] = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        self.task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"simple-conn-{address}"
+        )
+
+    async def _run(self) -> None:
+        while True:
+            data = await self.queue.get()
+            try:
+                reader, writer = await asyncio.open_connection(*self.address)
+            except OSError as e:
+                log.warning("Failed to connect to %s: %s", self.address, e)
+                continue  # drop this message, wait for the next
+            log.debug("Outgoing connection established with %s", self.address)
+            sink = asyncio.get_running_loop().create_task(self._sink_acks(reader))
+            try:
+                while True:
+                    await send_frame(writer, data)
+                    data = await self.queue.get()
+            except (ConnectionError, OSError) as e:
+                log.warning("Failed to send message to %s: %s", self.address, e)
+            finally:
+                sink.cancel()
+                writer.close()
+
+    @staticmethod
+    async def _sink_acks(reader: asyncio.StreamReader) -> None:
+        # Peers ACK on the same socket; this sender ignores them
+        # (reference simple_sender.rs:120-131).
+        try:
+            while True:
+                await read_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self.task.cancel()
+
+
+class SimpleSender:
+    """Fire-and-forget sends; keeps one connection per peer."""
+
+    def __init__(self):
+        self._connections: dict[Address, _Connection] = {}
+
+    def _connection(self, address: Address) -> _Connection:
+        conn = self._connections.get(address)
+        if conn is None or conn.task.done():
+            conn = _Connection(address)
+            self._connections[address] = conn
+        return conn
+
+    async def send(self, address: Address, data: bytes) -> None:
+        conn = self._connection(address)
+        try:
+            conn.queue.put_nowait(data)
+        except asyncio.QueueFull:
+            log.warning("Dropping message to %s: channel full", address)
+
+    async def broadcast(self, addresses: list[Address], data: bytes) -> None:
+        for addr in addresses:
+            await self.send(addr, data)
+
+    async def lucky_broadcast(
+        self, addresses: list[Address], data: bytes, nodes: int
+    ) -> None:
+        """Send to ``nodes`` randomly-picked peers (reference
+        simple_sender.rs lucky_broadcast)."""
+        picks = random.sample(addresses, min(nodes, len(addresses)))
+        await self.broadcast(picks, data)
+
+    def close(self) -> None:
+        for conn in self._connections.values():
+            conn.close()
+        self._connections.clear()

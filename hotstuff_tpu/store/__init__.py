@@ -1,0 +1,120 @@
+"""Store: a single-writer actor serializing all storage access.
+
+Parity target: the reference ``store`` crate (store/src/lib.rs:15-92):
+one task owns the database; clients talk to it through a channel of
+Write/Read/NotifyRead commands. ``notify_read`` is the blocking-read
+primitive the synchronizer's "wait for a missing parent block" is built on
+(reference store/src/lib.rs:29,80-92): if the key is missing, the caller's
+future is parked in an obligations map and resolved by a later write of
+that key.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+
+from .engine import Engine, WalEngine
+
+
+def open_engine(path: str, prefer_native: bool = True) -> Engine:
+    """Open the best available engine at ``path`` (C++ if built, else WAL)."""
+    if prefer_native:
+        try:
+            from .native import NativeEngine  # noqa: PLC0415
+
+            return NativeEngine(path)
+        except (ImportError, OSError):
+            pass
+    return WalEngine(path)
+
+
+class Store:
+    """Asyncio actor API over an Engine.
+
+    write() is fire-and-forget from the caller's view but fully ordered:
+    all mutations and reads flow through one queue consumed by one task,
+    the reference's single-writer discipline (store/src/lib.rs:27-62).
+    """
+
+    def __init__(self, path: str, engine: Engine | None = None):
+        self.engine = engine if engine is not None else open_engine(path)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._obligations: dict[bytes, deque[asyncio.Future]] = {}
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            if self._closed:
+                raise RuntimeError("Store is closed")
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="store"
+            )
+
+    async def _run(self) -> None:
+        while True:
+            cmd = await self._queue.get()
+            op = cmd[0]
+            if op == "write":
+                _, key, value = cmd
+                self.engine.put(key, value)
+                waiters = self._obligations.pop(key, None)
+                if waiters:
+                    for fut in waiters:
+                        if not fut.done():
+                            fut.set_result(value)
+            elif op == "read":
+                _, key, fut = cmd
+                if not fut.done():
+                    fut.set_result(self.engine.get(key))
+            else:  # notify_read
+                _, key, fut = cmd
+                value = self.engine.get(key)
+                if value is not None:
+                    if not fut.done():
+                        fut.set_result(value)
+                else:
+                    self._obligations.setdefault(key, deque()).append(fut)
+
+    async def write(self, key: bytes, value: bytes) -> None:
+        self._ensure_started()
+        await self._queue.put(("write", key, value))
+
+    async def read(self, key: bytes) -> bytes | None:
+        self._ensure_started()
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(("read", key, fut))
+        return await fut
+
+    async def notify_read(self, key: bytes) -> bytes:
+        """Read that resolves when the key exists (possibly immediately)."""
+        self._ensure_started()
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(("notify_read", key, fut))
+        return await fut
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        # drain the queue: apply writes (they were acknowledged as ordered),
+        # fail reads so no caller hangs
+        while not self._queue.empty():
+            cmd = self._queue.get_nowait()
+            if cmd[0] == "write":
+                self.engine.put(cmd[1], cmd[2])
+            else:
+                fut = cmd[2]
+                if not fut.done():
+                    fut.cancel()
+        for waiters in self._obligations.values():
+            for fut in waiters:
+                if not fut.done():
+                    fut.cancel()
+        self._obligations.clear()
+        self.engine.close()
+
+
+__all__ = ["Store", "Engine", "WalEngine", "open_engine"]

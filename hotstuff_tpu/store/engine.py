@@ -1,0 +1,105 @@
+"""Persistent key-value engines backing the Store actor.
+
+The reference uses RocksDB (reference store/Cargo.toml:9). RocksDB isn't in
+this image, so the framework ships its own engines behind one interface:
+
+- ``WalEngine`` (this module, pure Python): in-memory index + append-only
+  write-ahead log, replayed on open. Crash recovery = reopen the same path
+  (the reference's resume semantics, SURVEY.md §5 "the store IS the
+  checkpoint").
+- ``NativeEngine`` (native/store_engine.cpp via ctypes): the C++ engine
+  with the same WAL format, used when the shared library is built.
+
+WAL record format (little-endian): u32 klen | u32 vlen | key | value.
+A record with vlen == 0xFFFFFFFF is a tombstone (delete).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Protocol
+
+_HDR = struct.Struct("<II")
+TOMBSTONE = 0xFFFFFFFF
+
+
+class Engine(Protocol):
+    def put(self, key: bytes, value: bytes) -> None: ...
+
+    def get(self, key: bytes) -> bytes | None: ...
+
+    def delete(self, key: bytes) -> None: ...
+
+    def keys(self) -> Iterator[bytes]: ...
+
+    def close(self) -> None: ...
+
+
+class WalEngine:
+    """Append-only WAL + in-memory hash index."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._wal_path = os.path.join(path, "wal.log")
+        self._index: dict[bytes, bytes] = {}
+        self._replay()
+        self._wal = open(self._wal_path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            data = f.read()
+        off, n = 0, len(data)
+        valid_end = 0  # end offset of the last complete record
+        while off + _HDR.size <= n:
+            klen, vlen = _HDR.unpack_from(data, off)
+            off += _HDR.size
+            if vlen == TOMBSTONE:
+                if off + klen > n:
+                    break  # torn tail record — discard
+                key = data[off : off + klen]
+                off += klen
+                self._index.pop(key, None)
+            else:
+                if off + klen + vlen > n:
+                    break  # torn tail record — discard
+                key = data[off : off + klen]
+                off += klen
+                self._index[key] = data[off : off + vlen]
+                off += vlen
+            valid_end = off
+        if valid_end < n:
+            # truncate the torn tail so post-recovery appends don't get
+            # stranded behind unparseable garbage on the next replay
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(valid_end)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._wal.write(_HDR.pack(len(key), len(value)))
+        self._wal.write(key)
+        self._wal.write(value)
+        self._wal.flush()
+        self._index[key] = value
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._index.get(key)
+
+    def delete(self, key: bytes) -> None:
+        self._wal.write(_HDR.pack(len(key), TOMBSTONE))
+        self._wal.write(key)
+        self._wal.flush()
+        self._index.pop(key, None)
+
+    def keys(self) -> Iterator[bytes]:
+        return iter(list(self._index.keys()))
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def close(self) -> None:
+        if not self._wal.closed:
+            self._wal.flush()
+            self._wal.close()

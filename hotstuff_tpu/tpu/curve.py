@@ -1,0 +1,163 @@
+"""Edwards25519 point arithmetic and fused double-scalar multiplication.
+
+TPU-first design notes:
+- Points are extended homogeneous coordinates (X:Y:Z:T) with each
+  coordinate a [..., 20]-limb int32 array (see tpu/field.py). All batch
+  axes vectorize through the limb ops directly — no vmap needed, the ops
+  broadcast.
+- The verification workhorse is a *fused* Straus/Shamir double-scalar
+  multiplication [s]B + [k]A' evaluated by one `lax.scan` over 253 bit
+  positions shared by the whole batch: per step one doubling and two
+  arithmetically-selected additions. Data-dependent branching is replaced
+  by `jnp.where` selects, keeping the graph static for XLA.
+- There is deliberately no on-device decompression: committee public keys
+  are decompressed once on the host (cached), and R is never decompressed
+  at all — the kernel compares the *compressed encoding* of the computed
+  point against the signature's R bytes (math in tpu/ed25519.py).
+
+Formulas: extended-coordinate unified addition (add-2008-hwcd-3) and
+doubling (dbl-2008-hwcd), mirroring the oracle in crypto/ed25519_ref.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto import ed25519_ref as ref
+from . import field as F
+
+# Curve constant 2d in limbs.
+D2_LIMBS = F.limbs_from_int(2 * ref.D % ref.P)
+
+# Base point in extended affine limbs (Z=1).
+_BX, _BY = ref.BASE_AFFINE
+B_X = F.limbs_from_int(_BX)
+B_Y = F.limbs_from_int(_BY)
+B_T = F.limbs_from_int(_BX * _BY % ref.P)
+
+NBITS = 253  # scalars are < L < 2^253
+
+Point = tuple  # (X, Y, Z, T) limb arrays
+
+
+def identity(shape_like) -> Point:
+    """Identity point broadcast to the batch shape of ``shape_like``."""
+    zeros = jnp.zeros_like(shape_like)
+    one = zeros.at[..., 0].set(1)
+    return (zeros, one, one, zeros)
+
+
+def base_point(shape_like) -> Point:
+    zeros = jnp.zeros_like(shape_like)
+    return (
+        zeros + jnp.asarray(B_X),
+        zeros + jnp.asarray(B_Y),
+        zeros.at[..., 0].set(1),
+        zeros + jnp.asarray(B_T),
+    )
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Unified addition (valid for doubling & identity), add-2008-hwcd-3."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
+    b = F.mul(F.add(Y1, X1), F.add(Y2, X2))
+    c = F.mul(F.mul(T1, T2), jnp.asarray(D2_LIMBS))
+    d = F.mul_small(F.mul(Z1, Z2), 2)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_double(p: Point) -> Point:
+    """Doubling, dbl-2008-hwcd."""
+    X1, Y1, Z1, _ = p
+    a = F.sqr(X1)
+    b = F.sqr(Y1)
+    c = F.mul_small(F.sqr(Z1), 2)
+    h = F.add(a, b)
+    e = F.sub(h, F.sqr(F.add(X1, Y1)))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_select(flag, p: Point, q: Point) -> Point:
+    """flag ? p : q, element-wise over the batch. flag: bool/int [...]."""
+    m = flag[..., None] != 0
+    return tuple(jnp.where(m, a, b) for a, b in zip(p, q))
+
+
+def point_neg(p: Point) -> Point:
+    X, Y, Z, T = p
+    zero = jnp.zeros_like(X)
+    return (F.sub(zero, X), Y, Z, F.sub(zero, T))
+
+
+def dual_scalar_mult(s_bits, k_bits, a_point: Point) -> Point:
+    """[s]B + [k]A for a whole batch at once.
+
+    s_bits, k_bits: int32 [NBITS, ...batch] — MSB first.
+    a_point: batch of points (each coord [...batch, 20]).
+    Returns the batch of result points.
+
+    One lax.scan step = 1 doubling + 2 selected additions; B is a
+    compile-time constant, A rides in the closure (loop-invariant).
+    """
+    b_point = base_point(a_point[0])
+
+    def step(acc, bits):
+        bs, bk = bits
+        acc = point_double(acc)
+        with_b = point_add(acc, b_point)
+        acc = point_select(bs, with_b, acc)
+        with_a = point_add(acc, a_point)
+        acc = point_select(bk, with_a, acc)
+        return acc, None
+
+    init = identity(a_point[0])
+    out, _ = jax.lax.scan(step, init, (s_bits, k_bits))
+    return out
+
+
+def compressed_equals(p: Point, y_limbs, sign_bits):
+    """Does ``p`` compress to (y_limbs, sign_bits)?
+
+    y_limbs: raw 13-bit limb decomposition of the low 255 bits of the
+    candidate encoding (NOT reduced mod p — a non-canonical y >= p can then
+    never match, which is exactly RFC 8032's rejection of invalid
+    encodings). sign_bits: int [...] in {0,1}, bit 255 of the encoding.
+    """
+    X, Y, Z, _ = p
+    zinv = F.pow_inv(Z)
+    x = F.mul(X, zinv)
+    y = F.mul(Y, zinv)
+    y_ok = jnp.all(F.canonical(y) == y_limbs, axis=-1)
+    sign_ok = F.is_odd(x) == sign_bits
+    return y_ok & sign_ok
+
+
+# --- host-side helpers -------------------------------------------------------
+
+
+def scalar_to_bits(s: int) -> np.ndarray:
+    """Scalar -> MSB-first bit vector of length NBITS (int32)."""
+    return np.array([(s >> (NBITS - 1 - i)) & 1 for i in range(NBITS)], np.int32)
+
+
+def point_to_limbs(p: "ref.Point") -> tuple[np.ndarray, ...]:
+    """Affine-ize a reference point and emit (X, Y, Z=1, T) limb vectors."""
+    x, y = ref.point_affine(p)
+    one = F.limbs_from_int(1)
+    return (
+        F.limbs_from_int(x),
+        F.limbs_from_int(y),
+        one,
+        F.limbs_from_int(x * y % ref.P),
+    )

@@ -1,0 +1,209 @@
+"""GF(2^255-19) arithmetic in JAX int32 limbs — the TPU field layer.
+
+Design (TPU-first, see SURVEY.md §7 "hard parts"): field elements are 20
+little-endian limbs of 13 bits held in int32. 13-bit limbs are chosen so a
+schoolbook product term is < 2^26 and a 20-term accumulation stays < 2^31,
+i.e. everything fits native int32 multiply-accumulate on the TPU VPU — no
+int64 emulation, no float tricks. All ops are shape-static and jit/vmap
+friendly; the trailing axis is always the limb axis.
+
+Representation invariant ("loose normalized", the output of ``carry``):
+limbs[1..18] in [0, 2^13), limb 19 in [0, 256), limb 0 in [0, 2^13 + 1216).
+The loose limb-0 bound keeps products safe: 20 * (2^13+1216)^2 < 2^31.
+``canonical`` produces the unique fully-reduced representation (used for
+equality / parity / encoding).
+
+Correctness oracle: ``hotstuff_tpu.crypto.ed25519_ref`` (arbitrary-precision
+ints), tested in tests/test_tpu_field.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 20
+LIMB_BITS = 13
+MASK = (1 << LIMB_BITS) - 1
+
+P_INT = 2**255 - 19
+
+# 2^260 = 2^5 * 2^255 ≡ 19 * 32 (mod p): fold multiplier for limb index 20+j.
+FOLD = 19 * 32  # 608
+# 2^255 ≡ 19: fold multiplier for bits >= 255 (bit 8 of limb 19).
+TOP_FOLD = 19
+TOP_SHIFT = 255 - 19 * LIMB_BITS  # = 8
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    """Host-side: Python int -> canonical limb vector (numpy int32)."""
+    x %= P_INT
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    return out
+
+
+def int_from_limbs(limbs) -> int:
+    """Host-side: limb vector -> Python int (not reduced mod p)."""
+    arr = np.asarray(limbs)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr.tolist()))
+
+
+# Constant for subtraction: 4p decomposed so each limb strictly dominates any
+# loose-normalized operand limb (borrow-adjusted; see sub()).
+def _sub_pad() -> np.ndarray:
+    n = [(4 * P_INT >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)]
+    c = list(n)
+    c[0] = n[0] + (1 << LIMB_BITS)
+    for j in range(1, NLIMBS - 1):
+        c[j] = n[j] - 1 + (1 << LIMB_BITS)
+    c[NLIMBS - 1] = n[NLIMBS - 1] - 1
+    assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == 4 * P_INT
+    # limb 0 must dominate the loose limb-0 bound, middles the 13-bit bound,
+    # top the 256 bound
+    assert c[0] >= MASK + 1216 and all(v >= MASK for v in c[1:-1]) and c[-1] >= 256
+    return np.array(c, dtype=np.int32)
+
+
+SUB_PAD = _sub_pad()
+# p itself in limbs (limbs_from_int reduces mod p, so build directly).
+P_LIMBS = np.array(
+    [(P_INT >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+)
+
+
+def _chain(z):
+    """One sequential signed carry pass; returns (list of limb columns, final
+    carry column). Each column has shape [..., 1]; limbs end in [0, 2^13)."""
+    c = jnp.zeros_like(z[..., :1])
+    outs = []
+    for i in range(z.shape[-1]):
+        x = z[..., i : i + 1] + c
+        c = x >> LIMB_BITS  # arithmetic shift: floor semantics for negatives
+        outs.append(x & MASK)
+    return outs, c
+
+
+def _fold_pass(z):
+    """chain -> fold limbs >= 20 (x608) -> fold bit 255 (x19)."""
+    outs, c = _chain(z)
+    lo = outs[:NLIMBS]
+    # limb index 20+j has weight 2^(260+13j) ≡ 608 * 2^(13j); the final carry
+    # sits one position past the last limb column.
+    for j, hi in enumerate(outs[NLIMBS:] + [c]):
+        lo[j] = lo[j] + hi * FOLD
+    top = lo[NLIMBS - 1] >> TOP_SHIFT
+    lo[NLIMBS - 1] = lo[NLIMBS - 1] - (top << TOP_SHIFT)
+    lo[0] = lo[0] + top * TOP_FOLD
+    return jnp.concatenate(lo, axis=-1)
+
+
+def carry(z):
+    """Reduce any bounded limb vector (e.g. a 39-limb product) to loose
+    normalized 20-limb form."""
+    z = _fold_pass(z)
+    z = _fold_pass(z)
+    return z
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    # a - b + 4p keeps every limb non-negative before the carry pass.
+    return carry(a + (jnp.asarray(SUB_PAD) - b))
+
+
+# prod[k] = sum_{i+j=k} a_i b_j: one outer product + one anti-diagonal
+# scatter-add keeps the traced graph small (vs 20 slice-updates).
+_DIAG_IDX = np.add.outer(np.arange(NLIMBS), np.arange(NLIMBS))  # [20,20]
+
+
+def mul(a, b):
+    """Schoolbook polynomial multiply + reduction. a, b loose normalized."""
+    outer = a[..., :, None] * b[..., None, :]  # [..., 20, 20] int32-safe
+    prod = jnp.zeros(a.shape[:-1] + (2 * NLIMBS - 1,), dtype=jnp.int32)
+    prod = prod.at[..., _DIAG_IDX].add(outer)
+    return carry(prod)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small non-negative constant (k < 2^17)."""
+    return carry(a * jnp.int32(k))
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def _sqr_n(a, n: int):
+    """n repeated squarings via fori_loop (body traced once — keeps the XLA
+    graph compact; a fully unrolled inversion chain takes minutes to compile)."""
+    return jax.lax.fori_loop(0, n, lambda _, t: sqr(t), a)
+
+
+def pow_inv(a):
+    """a^(p-2) = a^-1 via the standard curve25519 addition chain."""
+    z2 = sqr(a)
+    z9 = mul(sqr(sqr(z2)), a)
+    z11 = mul(z9, z2)
+    z2_5_0 = mul(sqr(z11), z9)
+    z2_10_0 = mul(_sqr_n(z2_5_0, 5), z2_5_0)
+    z2_20_0 = mul(_sqr_n(z2_10_0, 10), z2_10_0)
+    z2_40_0 = mul(_sqr_n(z2_20_0, 20), z2_20_0)
+    z2_50_0 = mul(_sqr_n(z2_40_0, 10), z2_10_0)
+    z2_100_0 = mul(_sqr_n(z2_50_0, 50), z2_50_0)
+    z2_200_0 = mul(_sqr_n(z2_100_0, 100), z2_100_0)
+    z2_250_0 = mul(_sqr_n(z2_200_0, 50), z2_50_0)
+    return mul(_sqr_n(z2_250_0, 5), z11)  # 2^255 - 21
+
+
+def _strict(a):
+    """Loose normalized -> strictly normalized (every limb < 2^13, value <
+    2^255 + 19, unique up to one conditional p-subtraction)."""
+    outs, _ = _chain(a)  # value < 2^260, carry out of limb 19 is 0
+    z = jnp.concatenate(outs, axis=-1)
+    for _ in range(2):  # peel bit 255 (at most twice: value < 2^256)
+        top = z[..., NLIMBS - 1 :] >> TOP_SHIFT
+        z = jnp.concatenate(
+            [
+                z[..., :1] + top * TOP_FOLD,
+                z[..., 1 : NLIMBS - 1],
+                z[..., NLIMBS - 1 :] - (top << TOP_SHIFT),
+            ],
+            axis=-1,
+        )
+        outs, _ = _chain(z)
+        z = jnp.concatenate(outs, axis=-1)
+    return z
+
+
+def canonical(a):
+    """Fully reduce loose-normalized limbs to the unique value in [0, p)."""
+    a = _strict(a)
+    p_limbs = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        borrow = jnp.zeros_like(a[..., :1])
+        outs = []
+        for i in range(NLIMBS):
+            x = a[..., i : i + 1] - p_limbs[i] + borrow
+            borrow = x >> LIMB_BITS
+            outs.append(x & MASK)
+        diff = jnp.concatenate(outs, axis=-1)
+        a = jnp.where(borrow >= 0, diff, a)  # no final borrow -> a >= p
+    return a
+
+
+def eq(a, b):
+    """Field equality of loose-normalized elements -> bool[...]."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_odd(a):
+    """Parity of the canonical value -> int32[...] in {0,1}."""
+    return canonical(a)[..., 0] & 1

@@ -1,0 +1,182 @@
+"""Network tests — ports of the reference's receiver/sender tests
+(network/src/tests/*.rs): listener fixtures assert what lands on the wire;
+the reliable `retry` case sends before any listener exists and asserts
+delivery after one appears."""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.network import (
+    Receiver,
+    ReliableSender,
+    SimpleSender,
+    read_frame,
+    send_frame,
+)
+
+BASE_PORT = 24100
+
+
+async def listener(port: int, expected: bytes, reply: bytes = b"Ack"):
+    """One-shot fake peer (reference tests/common.rs:182-198): accept one
+    connection, assert the first frame, reply, return the frame."""
+    got = asyncio.get_running_loop().create_future()
+
+    async def handle(reader, writer):
+        frame = await read_frame(reader)
+        await send_frame(writer, reply)
+        if not got.done():
+            got.set_result(frame)
+
+    server = await asyncio.start_server(handle, "127.0.0.1", port)
+    try:
+        frame = await asyncio.wait_for(got, 5)
+        assert frame == expected
+        return frame
+    finally:
+        # no wait_closed(): senders hold their persistent connection open,
+        # and 3.12's wait_closed blocks until every peer connection dies
+        server.close()
+
+
+class EchoHandler:
+    def __init__(self):
+        self.received = []
+
+    async def dispatch(self, writer, message):
+        self.received.append(message)
+        await writer.send(b"Ack")
+
+
+@pytest.mark.parametrize("payload", [b"hello", b"x" * 100_000])
+def test_receiver_dispatches_and_acks(payload):
+    async def body():
+        port = BASE_PORT + 0
+        handler = EchoHandler()
+        rx = Receiver("127.0.0.1", port, handler)
+        await rx.spawn()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await send_frame(writer, payload)
+        ack = await asyncio.wait_for(read_frame(reader), 5)
+        assert ack == b"Ack"
+        assert handler.received == [payload]
+        writer.close()
+        await rx.shutdown()
+
+    asyncio.run(body())
+
+
+def test_simple_sender():
+    async def body():
+        port = BASE_PORT + 1
+        task = asyncio.create_task(listener(port, b"ping"))
+        await asyncio.sleep(0.1)
+        sender = SimpleSender()
+        await sender.send(("127.0.0.1", port), b"ping")
+        await asyncio.wait_for(task, 5)
+        sender.close()
+
+    asyncio.run(body())
+
+
+def test_simple_broadcast():
+    async def body():
+        ports = [BASE_PORT + 2 + i for i in range(3)]
+        tasks = [asyncio.create_task(listener(p, b"all")) for p in ports]
+        await asyncio.sleep(0.1)
+        sender = SimpleSender()
+        await sender.broadcast([("127.0.0.1", p) for p in ports], b"all")
+        await asyncio.wait_for(asyncio.gather(*tasks), 5)
+        sender.close()
+
+    asyncio.run(body())
+
+
+def test_reliable_send_resolves_with_ack():
+    async def body():
+        port = BASE_PORT + 10
+        task = asyncio.create_task(listener(port, b"important", reply=b"OK"))
+        await asyncio.sleep(0.1)
+        sender = ReliableSender()
+        handle = await sender.send(("127.0.0.1", port), b"important")
+        ack = await asyncio.wait_for(handle, 5)
+        assert ack == b"OK"
+        await asyncio.wait_for(task, 5)
+        sender.close()
+
+    asyncio.run(body())
+
+
+def test_reliable_retry_before_listener_exists():
+    """Reference reliable_sender_tests.rs:50-67: send with nobody listening,
+    then start the listener — backoff reconnect must deliver it."""
+
+    async def body():
+        port = BASE_PORT + 11
+        sender = ReliableSender()
+        handle = await sender.send(("127.0.0.1", port), b"late delivery")
+        await asyncio.sleep(0.4)  # let a connect attempt fail
+        assert not handle.done()
+        task = asyncio.create_task(listener(port, b"late delivery"))
+        ack = await asyncio.wait_for(handle, 10)
+        assert ack == b"Ack"
+        await asyncio.wait_for(task, 5)
+        sender.close()
+
+    asyncio.run(body())
+
+
+def test_reliable_broadcast_quorum_wait():
+    """The proposer's pattern: broadcast, then await 2f+1 ACK handles."""
+
+    async def body():
+        ports = [BASE_PORT + 20 + i for i in range(3)]
+        tasks = [asyncio.create_task(listener(p, b"block")) for p in ports]
+        await asyncio.sleep(0.1)
+        sender = ReliableSender()
+        handles = await sender.broadcast(
+            [("127.0.0.1", p) for p in ports], b"block"
+        )
+        done = 0
+        for fut in asyncio.as_completed(handles, timeout=5):
+            await fut
+            done += 1
+            if done >= 2:  # 2f+1 with f=0 committee of 3 → just exercise wait
+                break
+        assert done == 2
+        await asyncio.wait_for(asyncio.gather(*tasks), 5)
+        sender.close()
+
+    asyncio.run(body())
+
+
+def test_reliable_retransmits_unacked_on_reconnect():
+    """Connection dies after receiving (not ACKing) a frame; the message must
+    be retransmitted on the next connection."""
+
+    async def body():
+        port = BASE_PORT + 30
+        first_conn = asyncio.get_running_loop().create_future()
+
+        async def rude_handler(reader, writer):
+            # read the frame, then slam the door without ACKing
+            await read_frame(reader)
+            writer.close()
+            if not first_conn.done():
+                first_conn.set_result(None)
+
+        rude = await asyncio.start_server(rude_handler, "127.0.0.1", port)
+        sender = ReliableSender()
+        handle = await sender.send(("127.0.0.1", port), b"retry me")
+        await asyncio.wait_for(first_conn, 5)
+        rude.close()
+        await rude.wait_closed()
+        # now a polite listener takes over the port
+        task = asyncio.create_task(listener(port, b"retry me"))
+        ack = await asyncio.wait_for(handle, 10)
+        assert ack == b"Ack"
+        await asyncio.wait_for(task, 5)
+        sender.close()
+
+    asyncio.run(body())

@@ -1,0 +1,131 @@
+"""Store tests — ports of the reference's store_tests.rs (create, read/write,
+missing key, notify_read before/after write) plus WAL crash-recovery cases
+the reference lacks (SURVEY.md §4 gaps)."""
+
+import asyncio
+import os
+
+from hotstuff_tpu.store import Store, WalEngine
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_create_store(tmp_path):
+    store = Store(str(tmp_path / "db"))
+    store.close()
+
+
+def test_read_write_value(tmp_path):
+    async def body():
+        store = Store(str(tmp_path / "db"))
+        await store.write(b"hello", b"world")
+        assert await store.read(b"hello") == b"world"
+        store.close()
+
+    run(body())
+
+
+def test_read_unknown_key(tmp_path):
+    async def body():
+        store = Store(str(tmp_path / "db"))
+        assert await store.read(b"nope") is None
+        store.close()
+
+    run(body())
+
+
+def test_read_notify_existing(tmp_path):
+    async def body():
+        store = Store(str(tmp_path / "db"))
+        await store.write(b"k", b"v")
+        assert await store.notify_read(b"k") == b"v"
+        store.close()
+
+    run(body())
+
+
+def test_read_notify_parks_until_write(tmp_path):
+    async def body():
+        store = Store(str(tmp_path / "db"))
+        waiter = asyncio.create_task(store.notify_read(b"later"))
+        await asyncio.sleep(0.05)
+        assert not waiter.done()
+        await store.write(b"later", b"arrived")
+        assert await asyncio.wait_for(waiter, 1) == b"arrived"
+        # multiple waiters on one key all resolve
+        w1 = asyncio.create_task(store.notify_read(b"multi"))
+        w2 = asyncio.create_task(store.notify_read(b"multi"))
+        await asyncio.sleep(0.05)
+        await store.write(b"multi", b"x")
+        assert await asyncio.wait_for(asyncio.gather(w1, w2), 1) == [b"x", b"x"]
+        store.close()
+
+    run(body())
+
+
+def test_persistence_across_reopen(tmp_path):
+    path = str(tmp_path / "db")
+
+    async def write_phase():
+        store = Store(path)
+        for i in range(100):
+            await store.write(b"key-%d" % i, b"value-%d" % i)
+        await store.read(b"key-0")  # drain the queue
+        store.close()
+
+    async def read_phase():
+        store = Store(path)
+        for i in range(100):
+            assert await store.read(b"key-%d" % i) == b"value-%d" % i
+        store.close()
+
+    run(write_phase())
+    run(read_phase())
+
+
+def test_torn_tail_record_discarded(tmp_path):
+    path = str(tmp_path / "db")
+    eng = WalEngine(path)
+    eng.put(b"good", b"value")
+    eng.close()
+    # simulate a crash mid-append
+    with open(os.path.join(path, "wal.log"), "ab") as f:
+        f.write(b"\x10\x00\x00\x00\x10\x00\x00\x00partial")
+    eng2 = WalEngine(path)
+    assert eng2.get(b"good") == b"value"
+    assert len(eng2) == 1
+    # engine still writable after recovery
+    eng2.put(b"after", b"crash")
+    assert eng2.get(b"after") == b"crash"
+    eng2.close()
+    # records written after recovery must survive a SECOND reopen
+    eng3 = WalEngine(path)
+    assert eng3.get(b"good") == b"value"
+    assert eng3.get(b"after") == b"crash"
+    eng3.close()
+
+
+def test_delete_tombstone_survives_reopen(tmp_path):
+    path = str(tmp_path / "db")
+    eng = WalEngine(path)
+    eng.put(b"a", b"1")
+    eng.put(b"b", b"2")
+    eng.delete(b"a")
+    eng.close()
+    eng2 = WalEngine(path)
+    assert eng2.get(b"a") is None
+    assert eng2.get(b"b") == b"2"
+    eng2.close()
+
+
+def test_overwrite_uses_latest(tmp_path):
+    path = str(tmp_path / "db")
+    eng = WalEngine(path)
+    eng.put(b"k", b"old")
+    eng.put(b"k", b"new")
+    eng.close()
+    eng2 = WalEngine(path)
+    assert eng2.get(b"k") == b"new"
+    eng2.close()

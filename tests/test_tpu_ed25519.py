@@ -1,0 +1,130 @@
+"""TPU ed25519 kernel tests: point ops and batch verification vs the
+pure-Python oracle (crypto/ed25519_ref.py), incl. adversarial inputs."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hotstuff_tpu.crypto import ed25519_ref as ref
+from hotstuff_tpu.tpu import curve, field as F
+from hotstuff_tpu.tpu.ed25519 import BatchVerifier
+
+rng = random.Random(99)
+
+jadd_pt = jax.jit(curve.point_add)
+jdbl_pt = jax.jit(curve.point_double)
+
+
+def rand_point():
+    """Random curve point = [r]B via the oracle."""
+    return ref.point_mul(rng.randrange(1, ref.L), ref.B_POINT)
+
+
+def to_dev_point(p):
+    return tuple(jnp.asarray(v)[None, :] for v in curve.point_to_limbs(p))
+
+
+def assert_same_point(dev_p, ref_p):
+    x = F.int_from_limbs(jax.jit(F.canonical)(F.mul(dev_p[0], jax.jit(F.pow_inv)(dev_p[2])))[0])
+    y = F.int_from_limbs(jax.jit(F.canonical)(F.mul(dev_p[1], jax.jit(F.pow_inv)(dev_p[2])))[0])
+    rx, ry = ref.point_affine(ref_p)
+    assert (x, y) == (rx, ry)
+
+
+def test_point_add_double_matches_oracle():
+    for _ in range(5):
+        p, q = rand_point(), rand_point()
+        assert_same_point(jadd_pt(to_dev_point(p), to_dev_point(q)), ref.point_add(p, q))
+        assert_same_point(jdbl_pt(to_dev_point(p)), ref.point_double(p))
+    # identity edge cases (unified formulas must handle them)
+    ident = tuple(jnp.asarray(v)[None, :] for v in (
+        F.limbs_from_int(0), F.limbs_from_int(1), F.limbs_from_int(1), F.limbs_from_int(0)))
+    p = rand_point()
+    assert_same_point(jadd_pt(to_dev_point(p), ident), p)
+    assert_same_point(jadd_pt(ident, ident), ref.IDENTITY)
+
+
+def _sign_many(n, msg_fn):
+    items = []
+    for i in range(n):
+        seed = bytes([i]) * 32
+        pk = ref.public_from_seed(seed)
+        msg = msg_fn(i)
+        items.append((msg, pk, ref.sign(seed, msg)))
+    return items
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return BatchVerifier()
+
+
+def test_batch_all_valid(verifier):
+    items = _sign_many(5, lambda i: b"msg-%d" % i)
+    out = verifier.verify(*map(list, zip(*items)))
+    assert out.tolist() == [True] * 5
+
+
+def test_batch_mixed_invalid(verifier):
+    items = _sign_many(8, lambda i: b"payload-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+    expected = [True] * 8
+    # corrupt signature R
+    sigs[1] = bytes([sigs[1][0] ^ 1]) + sigs[1][1:]; expected[1] = False
+    # corrupt s half
+    sigs[2] = sigs[2][:40] + bytes([sigs[2][40] ^ 0x80]) + sigs[2][41:]; expected[2] = False
+    # wrong message
+    msgs[3] = b"tampered"; expected[3] = False
+    # wrong key
+    pks[4] = ref.public_from_seed(b"\xaa" * 32); expected[4] = False
+    # non-canonical s (s + L)
+    s_int = int.from_bytes(sigs[5][32:], "little") + ref.L
+    sigs[5] = sigs[5][:32] + s_int.to_bytes(32, "little"); expected[5] = False
+    # undecompressable pubkey (y >= p encodes no point)
+    pks[6] = (ref.P + 1).to_bytes(32, "little"); expected[6] = False
+    out = verifier.verify(msgs, pks, sigs)
+    assert out.tolist() == expected
+    # agreement with the oracle on every item
+    for got, (m, pk, sig) in zip(out.tolist(), zip(msgs, pks, sigs)):
+        assert got == ref.verify(sig, pk, m)
+
+
+def test_rfc_vectors_on_device(verifier):
+    vecs = [
+        ("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60", ""),
+        ("4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb", "72"),
+        ("c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7", "af82"),
+    ]
+    msgs, pks, sigs = [], [], []
+    for seed_hex, msg_hex in vecs:
+        seed, msg = bytes.fromhex(seed_hex), bytes.fromhex(msg_hex)
+        msgs.append(msg)
+        pks.append(ref.public_from_seed(seed))
+        sigs.append(ref.sign(seed, msg))
+    assert verifier.verify(msgs, pks, sigs).tolist() == [True] * 3
+
+
+def test_qc_shape_shared_message(verifier):
+    """The QC-verify shape: many signers, one digest."""
+    digest = hashlib.sha512(b"block").digest()[:32]
+    msgs, pks, sigs = [], [], []
+    for i in range(7):
+        seed = bytes([0x40 + i]) * 32
+        msgs.append(digest)
+        pks.append(ref.public_from_seed(seed))
+        sigs.append(ref.sign(seed, digest))
+    assert verifier.verify(msgs, pks, sigs).all()
+    sigs[3] = sigs[3][:10] + b"\x00" + sigs[3][11:]
+    out = verifier.verify(msgs, pks, sigs)
+    assert out.tolist() == [True, True, True, False, True, True, True]
+
+
+def test_committee_precompute_cache(verifier):
+    pks = [ref.public_from_seed(bytes([i]) * 32) for i in range(4)]
+    verifier.precompute(pks)
+    assert all(pk in verifier._point_cache for pk in pks)

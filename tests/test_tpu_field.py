@@ -1,0 +1,102 @@
+"""Field arithmetic tests: JAX limb ops vs arbitrary-precision ints."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hotstuff_tpu.crypto.ed25519_ref import P
+from hotstuff_tpu.tpu import field as F
+
+rng = random.Random(1234)
+
+# jit everything once — eager dispatch of the unrolled limb ops is ~100x slower
+jadd = jax.jit(F.add)
+jsub = jax.jit(F.sub)
+jmul = jax.jit(F.mul)
+jsqr = jax.jit(F.sqr)
+jinv = jax.jit(F.pow_inv)
+jcanon = jax.jit(F.canonical)
+jeq = jax.jit(F.eq)
+jodd = jax.jit(F.is_odd)
+jmul_small = jax.jit(F.mul_small, static_argnums=1)
+
+
+def rand_int():
+    return rng.randrange(P)
+
+
+def to_dev(x: int):
+    return jnp.asarray(F.limbs_from_int(x))
+
+
+def test_limbs_roundtrip():
+    for _ in range(20):
+        x = rand_int()
+        assert F.int_from_limbs(F.limbs_from_int(x)) == x
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (jadd, lambda a, b: (a + b) % P),
+    (jsub, lambda a, b: (a - b) % P),
+    (jmul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    cases = [(rand_int(), rand_int()) for _ in range(20)]
+    cases += [(0, 0), (P - 1, P - 1), (P - 1, 1), (1, 0), (19, P - 19)]
+    a = jnp.stack([to_dev(x) for x, _ in cases])
+    b = jnp.stack([to_dev(y) for _, y in cases])
+    out = op(a, b)
+    for i, (x, y) in enumerate(cases):
+        got = F.int_from_limbs(out[i]) % P
+        assert got == pyop(x, y), f"case {i}: {x} ? {y}"
+
+
+def test_mul_chain_stays_bounded():
+    # repeated multiplication must keep limbs inside the loose invariant
+    x = to_dev(rand_int())[None, :]
+    y = to_dev(rand_int())[None, :]
+    for _ in range(50):
+        x = jmul(x, y)
+        arr = np.asarray(x)
+        assert arr.min() >= 0
+        assert arr[..., 1:19].max() < 2**13
+        assert arr[..., 19].max() < 256
+        assert arr[..., 0].max() < 2**13 + 1216
+
+
+def test_sqr_and_mul_small():
+    for _ in range(10):
+        x = rand_int()
+        assert F.int_from_limbs(jsqr(to_dev(x))) % P == x * x % P
+        assert F.int_from_limbs(jmul_small(to_dev(x), 608)) % P == x * 608 % P
+
+
+def test_inverse():
+    vals = [rand_int() for _ in range(8)] + [1, 2, P - 1]
+    a = jnp.stack([to_dev(x) for x in vals])
+    inv = jinv(a)
+    for i, x in enumerate(vals):
+        assert F.int_from_limbs(inv[i]) % P == pow(x, P - 2, P)
+
+
+def test_canonical_and_eq():
+    for _ in range(10):
+        x = rand_int()
+        # same value from two different computation paths -> same canonical form
+        a = jmul(to_dev(x), to_dev(1))
+        b = jadd(to_dev(x), to_dev(0))
+        assert np.array_equal(np.asarray(jcanon(a)), F.limbs_from_int(x))
+        assert bool(jeq(a, b))
+        assert not bool(jeq(a, to_dev((x + 1) % P)))
+    # values just below/above p
+    assert bool(jeq(jadd(to_dev(P - 1), to_dev(1)), to_dev(0)))
+    assert bool(jeq(jadd(to_dev(P - 1), to_dev(2)), to_dev(1)))
+
+
+def test_is_odd():
+    for x in [0, 1, 2, P - 1, P - 2, rand_int(), rand_int()]:
+        assert int(jodd(to_dev(x))) == (x % P) & 1
