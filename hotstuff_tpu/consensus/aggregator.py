@@ -1,0 +1,186 @@
+"""Vote/timeout aggregation into certificates — accumulate-then-dispatch.
+
+Parity target: reference ``Aggregator``/``QCMaker``/``TCMaker``
+(consensus/src/aggregator.rs:13-139), restructured per the BASELINE.json
+north star: votes are accumulated *unverified* and the whole signature set
+ships to the ``VerifierBackend`` as ONE batch when a quorum's stake has
+arrived — one batched kernel call per certificate instead of 2f+1
+sequential verifies on the hot path.
+
+Hardening beyond the reference (messages arrive over unauthenticated TCP,
+so deferred verification must not open spoofing holes):
+
+- If the batch fails at quorum, invalid entries are identified
+  per-signature and evicted, their authors are *released* (so the honest
+  authority's real vote can still land — a spoofed garbage vote cannot
+  suppress it) and marked suspect: subsequent votes naming a suspect
+  author are verified eagerly on entry, so garbage floods cost the
+  attacker a rejected verify instead of aggregator state.
+- Aggregation state is bounded: votes/timeouts further than
+  ``ROUND_LOOKAHEAD`` past the node's current round are rejected, and at
+  most ``MAX_DIGEST_CELLS`` distinct block digests are tracked per round
+  (the reference's unbounded maps are a known DoS, aggregator.rs:29-30).
+
+Timeouts are verified on entry by the core (like the reference,
+core.rs:288), so ``TCMaker`` accumulates pre-verified entries and emits
+the TC without re-verification.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..crypto import Digest, PublicKey, Signature
+from ..crypto.service import VerifierBackend
+from .config import Committee
+from .errors import AuthorityReuse, ConsensusError, InvalidSignature, UnknownAuthority
+from .messages import QC, TC, Round, Timeout, Vote
+
+log = logging.getLogger(__name__)
+
+# How far past the current round aggregation state may be created.
+ROUND_LOOKAHEAD = 64
+# Distinct block digests tracked per round (honest case: exactly one).
+MAX_DIGEST_CELLS = 8
+
+
+class AggregationBounds(ConsensusError):
+    def __init__(self, what: str):
+        super().__init__(f"Rejected {what}: aggregation bounds exceeded")
+
+
+class QCMaker:
+    """Accumulates votes over one (round, block-digest) cell into a QC."""
+
+    def __init__(self):
+        self.weight = 0
+        self.votes: list[tuple[PublicKey, Signature]] = []
+        self.used: set[PublicKey] = set()
+        self.suspect: set[PublicKey] = set()  # authors with an evicted sig
+
+    def append(
+        self,
+        vote: Vote,
+        committee: Committee,
+        verifier: VerifierBackend,
+    ) -> QC | None:
+        author = vote.author
+        if author in self.used:
+            raise AuthorityReuse(author)
+        stake = committee.stake(author)
+        if stake <= 0:
+            raise UnknownAuthority(author)
+        if author in self.suspect:
+            # this author's slot was already poisoned once — pay one eager
+            # verify instead of trusting the deferred batch again
+            if not verifier.verify_one(vote.digest(), author, vote.signature):
+                raise InvalidSignature(f"bad signature on vote {vote!r}")
+        self.used.add(author)
+        self.votes.append((author, vote.signature))
+        self.weight += stake
+        if self.weight < committee.quorum_threshold():
+            return None
+
+        # Quorum reached: dispatch the whole set as one batch.
+        if not verifier.verify_shared_msg(vote.digest(), self.votes):
+            self._evict_invalid(vote.digest(), committee, verifier)
+            if self.weight < committee.quorum_threshold():
+                return None  # keep accumulating
+
+        self.weight = 0  # a QC is made at most once
+        return QC(hash=vote.hash, round=vote.round, votes=list(self.votes))
+
+    def _evict_invalid(
+        self, digest: Digest, committee: Committee, verifier: VerifierBackend
+    ) -> None:
+        ok = verifier.verify_many(
+            [digest.to_bytes()] * len(self.votes),
+            [pk.to_bytes() for pk, _ in self.votes],
+            [sig.to_bytes() for _, sig in self.votes],
+        )
+        for (pk, _), valid in zip(self.votes, ok):
+            if not valid:
+                log.warning("Evicting invalid vote signature naming %s", pk)
+                # release the author — the signature was never authenticated,
+                # so this may be a spoof and the real vote must still count —
+                # but demand eager verification from now on
+                self.used.discard(pk)
+                self.suspect.add(pk)
+        self.votes = [v for v, valid in zip(self.votes, ok) if valid]
+        self.weight = sum(committee.stake(pk) for pk, _ in self.votes)
+
+
+class TCMaker:
+    """Accumulates timeouts for one round into a TC.
+
+    Entries are verified by the core before they reach this accumulator
+    (core._handle_timeout, mirroring reference core.rs:288), so the TC is
+    emitted without re-verification — same shape as the reference's
+    TCMaker (aggregator.rs:97-139).
+    """
+
+    def __init__(self):
+        self.weight = 0
+        self.votes: list[tuple[PublicKey, Signature, Round]] = []
+        self.used: set[PublicKey] = set()
+
+    def append(self, timeout: Timeout, committee: Committee) -> TC | None:
+        author = timeout.author
+        if author in self.used:
+            raise AuthorityReuse(author)
+        stake = committee.stake(author)
+        if stake <= 0:
+            raise UnknownAuthority(author)
+        self.used.add(author)
+        self.votes.append((author, timeout.signature, timeout.high_qc.round))
+        self.weight += stake
+        if self.weight < committee.quorum_threshold():
+            return None
+        self.weight = 0  # a TC is made at most once
+        return TC(round=timeout.round, votes=list(self.votes))
+
+
+class Aggregator:
+    """Per-round certificate accumulators with cleanup and DoS bounds."""
+
+    def __init__(self, committee: Committee, verifier: VerifierBackend):
+        self.committee = committee
+        self.verifier = verifier
+        self.votes_aggregators: dict[Round, dict[Digest, QCMaker]] = {}
+        self.timeouts_aggregators: dict[Round, TCMaker] = {}
+
+    def add_vote(self, vote: Vote, current_round: Round | None = None) -> QC | None:
+        if (
+            current_round is not None
+            and vote.round > current_round + ROUND_LOOKAHEAD
+        ):
+            raise AggregationBounds(f"vote for far-future round {vote.round}")
+        makers = self.votes_aggregators.setdefault(vote.round, {})
+        digest = vote.digest()
+        if digest not in makers and len(makers) >= MAX_DIGEST_CELLS:
+            raise AggregationBounds(
+                f"vote digest cell #{len(makers)} in round {vote.round}"
+            )
+        maker = makers.setdefault(digest, QCMaker())
+        return maker.append(vote, self.committee, self.verifier)
+
+    def add_timeout(
+        self, timeout: Timeout, current_round: Round | None = None
+    ) -> TC | None:
+        if (
+            current_round is not None
+            and timeout.round > current_round + ROUND_LOOKAHEAD
+        ):
+            raise AggregationBounds(
+                f"timeout for far-future round {timeout.round}"
+            )
+        maker = self.timeouts_aggregators.setdefault(timeout.round, TCMaker())
+        return maker.append(timeout, self.committee)
+
+    def cleanup(self, round_: Round) -> None:
+        self.votes_aggregators = {
+            r: v for r, v in self.votes_aggregators.items() if r >= round_
+        }
+        self.timeouts_aggregators = {
+            r: v for r, v in self.timeouts_aggregators.items() if r >= round_
+        }

@@ -1,0 +1,139 @@
+"""Committee and protocol parameters.
+
+Parity target: reference ``consensus/src/config.rs:10-85`` — ``Parameters``
+{timeout_delay: 5000 ms, sync_retry_delay: 10000 ms}, ``Committee`` mapping
+public keys to {stake, address} with epoch number and the BFT quorum rule
+``2N/3 + 1`` (= N - f for N = 3f + 1 + k).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from ..crypto import PublicKey
+
+log = logging.getLogger(__name__)
+
+Address = tuple[str, int]
+
+
+def parse_address(s: str) -> Address:
+    host, _, port = s.rpartition(":")
+    return host, int(port)
+
+
+def format_address(a: Address) -> str:
+    return f"{a[0]}:{a[1]}"
+
+
+@dataclass
+class Parameters:
+    """Protocol timing knobs (milliseconds), JSON round-trippable."""
+
+    timeout_delay: int = 5_000
+    sync_retry_delay: int = 10_000
+
+    def log(self) -> None:
+        # NOTE: these log entries are used to compute performance
+        # (reference config.rs:26-30 — the harness scrapes them).
+        log.info("Timeout delay set to %s ms", self.timeout_delay)
+        log.info("Sync retry delay set to %s ms", self.sync_retry_delay)
+
+    def to_json(self) -> dict:
+        return {
+            "timeout_delay": self.timeout_delay,
+            "sync_retry_delay": self.sync_retry_delay,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "Parameters":
+        default = cls()
+        return cls(
+            timeout_delay=int(data.get("timeout_delay", default.timeout_delay)),
+            sync_retry_delay=int(
+                data.get("sync_retry_delay", default.sync_retry_delay)
+            ),
+        )
+
+
+@dataclass
+class Authority:
+    stake: int
+    address: Address
+
+
+@dataclass
+class Committee:
+    """The validator set: voting power and network address per authority."""
+
+    authorities: dict[PublicKey, Authority] = field(default_factory=dict)
+    epoch: int = 1
+
+    @classmethod
+    def new(
+        cls, info: list[tuple[PublicKey, int, Address]], epoch: int = 1
+    ) -> "Committee":
+        return cls(
+            authorities={
+                name: Authority(stake, address) for name, stake, address in info
+            },
+            epoch=epoch,
+        )
+
+    def size(self) -> int:
+        return len(self.authorities)
+
+    def stake(self, name: PublicKey) -> int:
+        auth = self.authorities.get(name)
+        return auth.stake if auth is not None else 0
+
+    def total_votes(self) -> int:
+        return sum(a.stake for a in self.authorities.values())
+
+    def quorum_threshold(self) -> int:
+        # If N = 3f + 1 + k (0 <= k < 3) then 2N/3 + 1 = 2f + 1 + k = N - f
+        # (reference config.rs:67-72).
+        return 2 * self.total_votes() // 3 + 1
+
+    def address(self, name: PublicKey) -> Address | None:
+        auth = self.authorities.get(name)
+        return auth.address if auth is not None else None
+
+    def broadcast_addresses(
+        self, myself: PublicKey
+    ) -> list[tuple[PublicKey, Address]]:
+        """Every authority's (key, address) except our own."""
+        return [
+            (name, auth.address)
+            for name, auth in self.authorities.items()
+            if name != myself
+        ]
+
+    def sorted_keys(self) -> list[PublicKey]:
+        return sorted(self.authorities.keys())
+
+    def to_json(self) -> dict:
+        return {
+            "authorities": {
+                pk.encode_base64(): {
+                    "stake": a.stake,
+                    "address": format_address(a.address),
+                }
+                for pk, a in self.authorities.items()
+            },
+            "epoch": self.epoch,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "Committee":
+        return cls(
+            authorities={
+                PublicKey.decode_base64(pk): Authority(
+                    stake=int(entry["stake"]),
+                    address=parse_address(entry["address"]),
+                )
+                for pk, entry in data["authorities"].items()
+            },
+            epoch=int(data.get("epoch", 1)),
+        )

@@ -1,0 +1,180 @@
+"""Consensus wiring: builds the channel topology and spawns all actors.
+
+Parity target: reference ``Consensus::spawn`` + ``ConsensusReceiverHandler``
+(consensus/src/consensus.rs:42-169). Topology:
+
+    NetworkReceiver -> {core, helper, producer->proposer}
+    Core <-> Proposer (Make/Cleanup, loopback)
+    Synchronizer -> Core (loopback)
+    Core -> tx_commit (application layer)
+
+Dispatch rules (consensus.rs:133-168): SyncRequest -> helper;
+Propose -> ACK on the same socket, then core; Producer -> ACK, then
+proposer; Vote/Timeout/TC -> core, no ACK.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto import PublicKey, SignatureService
+from ..crypto.service import CpuVerifier, VerifierBackend
+from ..network import Receiver as NetworkReceiver
+from ..network import Writer
+from ..store import Store
+from .config import Committee, Parameters
+from .core import Core
+from .errors import SerializationError
+from .helper import Helper
+from .leader import LeaderElector
+from .proposer import Proposer
+from .synchronizer import Synchronizer
+from .wire import ACK, TAG_PRODUCER, TAG_PROPOSE, TAG_SYNC_REQUEST, decode_message
+
+log = logging.getLogger(__name__)
+
+CHANNEL_CAPACITY = 1_000
+
+
+class ConsensusReceiverHandler:
+    def __init__(
+        self,
+        tx_consensus: asyncio.Queue,
+        tx_helper: asyncio.Queue,
+        tx_producer: asyncio.Queue,
+    ):
+        self.tx_consensus = tx_consensus
+        self.tx_helper = tx_helper
+        self.tx_producer = tx_producer
+
+    async def dispatch(self, writer: Writer, message: bytes) -> None:
+        try:
+            tag, payload = decode_message(message)
+        except SerializationError as e:
+            log.warning("Dropping malformed message: %s", e)
+            return
+        if tag == TAG_SYNC_REQUEST:
+            await self.tx_helper.put(payload)
+        elif tag == TAG_PROPOSE:
+            try:
+                await writer.send(ACK)
+            except (ConnectionError, OSError):
+                pass
+            await self.tx_consensus.put((tag, payload))
+        elif tag == TAG_PRODUCER:
+            try:
+                await writer.send(ACK)
+            except (ConnectionError, OSError):
+                pass
+            await self.tx_producer.put(payload)
+        else:
+            await self.tx_consensus.put((tag, payload))
+
+
+class Consensus:
+    """Owns the spawned actor stack of one node's protocol engine."""
+
+    def __init__(self):
+        self.receiver: NetworkReceiver | None = None
+        self.core: Core | None = None
+        self.proposer: Proposer | None = None
+        self.helper: Helper | None = None
+        self.synchronizer: Synchronizer | None = None
+        self.tx_producer: asyncio.Queue | None = None
+        self._tasks: list[asyncio.Task] = []
+
+    @classmethod
+    async def spawn(
+        cls,
+        name: PublicKey,
+        committee: Committee,
+        parameters: Parameters,
+        signature_service: SignatureService,
+        store: Store,
+        tx_commit: asyncio.Queue,
+        verifier: VerifierBackend | None = None,
+        bind_host: str = "0.0.0.0",
+    ) -> "Consensus":
+        self = cls()
+        # NOTE: this log entry is used to compute performance.
+        parameters.log()
+        if verifier is None:
+            verifier = CpuVerifier()
+
+        tx_producer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        tx_consensus: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        tx_loopback: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        tx_proposer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        tx_helper: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        self.tx_producer = tx_producer
+
+        address = committee.address(name)
+        if address is None:
+            raise ValueError("Our public key is not in the committee")
+        # Bind on all interfaces, listen on our committee port
+        # (consensus.rs:61-73 rewrites the IP to 0.0.0.0).
+        self.receiver = NetworkReceiver(
+            bind_host,
+            address[1],
+            ConsensusReceiverHandler(tx_consensus, tx_helper, tx_producer),
+        )
+        await self.receiver.spawn()
+        log.info(
+            "Node %s listening to consensus messages on %s:%d",
+            name,
+            bind_host,
+            address[1],
+        )
+
+        leader_elector = LeaderElector(committee)
+        self.synchronizer = Synchronizer(
+            name,
+            committee,
+            store,
+            tx_loopback,
+            parameters.sync_retry_delay,
+        )
+
+        self.core = Core(
+            name,
+            committee,
+            signature_service,
+            verifier,
+            store,
+            leader_elector,
+            self.synchronizer,
+            parameters.timeout_delay,
+            rx_message=tx_consensus,
+            rx_loopback=tx_loopback,
+            tx_proposer=tx_proposer,
+            tx_commit=tx_commit,
+        )
+        self._tasks.append(self.core.spawn())
+
+        self.proposer = Proposer(
+            name,
+            committee,
+            signature_service,
+            rx_producer=tx_producer,
+            rx_message=tx_proposer,
+            tx_loopback=tx_loopback,
+            store=store,
+        )
+        self._tasks.append(self.proposer.spawn())
+
+        self.helper = Helper(committee, store, rx_requests=tx_helper)
+        self._tasks.append(self.helper.spawn())
+        return self
+
+    async def shutdown(self) -> None:
+        if self.receiver is not None:
+            await self.receiver.shutdown()
+        for component in (self.core, self.proposer, self.helper):
+            if component is not None:
+                component.shutdown()
+        if self.synchronizer is not None:
+            self.synchronizer.shutdown()
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()

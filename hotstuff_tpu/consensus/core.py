@@ -1,0 +1,488 @@
+"""The consensus core: the 2-chain HotStuff state machine.
+
+Parity target: reference ``Core`` (consensus/src/core.rs:31-495) — one
+actor selecting over {network messages, loopback blocks, round timer},
+holding {round, last_voted_round, last_committed_round, high_qc}, with:
+
+- the Jolteon voting rule (safety_rule_1: round > last_voted_round;
+  safety_rule_2: extends the previous round's QC, or extends a TC for the
+  previous round while qc.round >= max(tc.high_qc_rounds)) — core.rs:160-177;
+- the 2-chain commit rule: committing b0 when b0 <- b1 <- block and
+  b0.round + 1 == b1.round — core.rs:384-386;
+- view change via Timeout/TC aggregation — core.rs:220-318;
+- crash-recovery persistence of ConsensusState after every state-changing
+  iteration (the fork's addition, core.rs:52-58, 484-492);
+- the per-round payload index + latest-round bookkeeping the fork's
+  proposer feeds on (core.rs:112-148).
+
+Verification is accumulate-then-dispatch (BASELINE.json): votes/timeouts
+enter the aggregator unverified and each certificate's signature set is
+batch-verified once at quorum, on the pluggable VerifierBackend (CPU or
+TPU kernel).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto import PublicKey, SignatureService
+from ..crypto.service import VerifierBackend
+from ..network import SimpleSender
+from ..store import Store
+from ..utils.codec import Decoder, Encoder
+from .aggregator import Aggregator
+from .config import Committee
+from .errors import ConsensusError, SerializationError, WrongLeader
+from .leader import LeaderElector
+from .messages import QC, TC, Block, Round, Timeout, Vote
+from .synchronizer import Synchronizer
+from .timer import Timer
+from .wire import (
+    TAG_PROPOSE,
+    TAG_TC,
+    TAG_TIMEOUT,
+    TAG_VOTE,
+    encode_tc,
+    encode_timeout,
+    encode_vote,
+)
+
+log = logging.getLogger(__name__)
+
+CONSENSUS_STATE_KEY = b"consensus_state"
+LATEST_ROUND_KEY = b"latest_round"
+
+
+def round_key(round_: Round) -> bytes:
+    """Store key of the per-round payload-digest index (big-endian, like
+    the reference's ``to_be_bytes`` keys, core.rs:117-146)."""
+    return round_.to_bytes(8, "big")
+
+
+def encode_payload_index(digests: list) -> bytes:
+    enc = Encoder().u32(len(digests))
+    for d in digests:
+        enc.raw(d.to_bytes())
+    return enc.finish()
+
+
+def decode_payload_index(data: bytes) -> list:
+    from ..crypto import Digest
+
+    dec = Decoder(data)
+    n = dec.u32()
+    out = [Digest(dec.raw(Digest.SIZE)) for _ in range(n)]
+    dec.finish()
+    return out
+
+
+class ConsensusState:
+    """The persisted crash-recovery snapshot (core.rs:52-58)."""
+
+    __slots__ = ("round", "last_voted_round", "last_committed_round", "high_qc")
+
+    def __init__(
+        self,
+        round_: Round = 1,
+        last_voted_round: Round = 0,
+        last_committed_round: Round = 0,
+        high_qc: QC | None = None,
+    ):
+        self.round = round_
+        self.last_voted_round = last_voted_round
+        self.last_committed_round = last_committed_round
+        self.high_qc = high_qc if high_qc is not None else QC.genesis()
+
+    def serialize(self) -> bytes:
+        enc = (
+            Encoder()
+            .u64(self.round)
+            .u64(self.last_voted_round)
+            .u64(self.last_committed_round)
+        )
+        self.high_qc.encode(enc)
+        return enc.finish()
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "ConsensusState":
+        dec = Decoder(data)
+        state = cls(dec.u64(), dec.u64(), dec.u64(), QC.decode(dec))
+        dec.finish()
+        return state
+
+
+class ProposerMessage:
+    """Core -> Proposer commands (reference proposer.rs:17-21)."""
+
+    __slots__ = ("kind", "round", "qc", "tc", "rounds")
+
+    MAKE = "make"
+    CLEANUP = "cleanup"
+
+    def __init__(self, kind, round_=0, qc=None, tc=None, rounds=()):
+        self.kind = kind
+        self.round = round_
+        self.qc = qc
+        self.tc = tc
+        self.rounds = list(rounds)
+
+    @classmethod
+    def make(cls, round_: Round, qc: QC, tc: TC | None) -> "ProposerMessage":
+        return cls(cls.MAKE, round_=round_, qc=qc, tc=tc)
+
+    @classmethod
+    def cleanup(cls, rounds: list[Round]) -> "ProposerMessage":
+        return cls(cls.CLEANUP, rounds=rounds)
+
+
+class Core:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        signature_service: SignatureService,
+        verifier: VerifierBackend,
+        store: Store,
+        leader_elector: LeaderElector,
+        synchronizer: Synchronizer,
+        timeout_delay_ms: int,
+        rx_message: asyncio.Queue,
+        rx_loopback: asyncio.Queue,
+        tx_proposer: asyncio.Queue,
+        tx_commit: asyncio.Queue,
+        network: SimpleSender | None = None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.signature_service = signature_service
+        self.verifier = verifier
+        self.store = store
+        self.leader_elector = leader_elector
+        self.synchronizer = synchronizer
+        self.rx_message = rx_message
+        self.rx_loopback = rx_loopback
+        self.tx_proposer = tx_proposer
+        self.tx_commit = tx_commit
+        self.round: Round = 1
+        self.last_voted_round: Round = 0
+        self.last_committed_round: Round = 0
+        self.high_qc: QC = QC.genesis()
+        self.timer = Timer(timeout_delay_ms)
+        self.aggregator = Aggregator(committee, verifier)
+        self.network = network if network is not None else SimpleSender()
+        self.state_changed = False
+        self._task: asyncio.Task | None = None
+        # per-node logger so multi-node (in-process) runs are attributable
+        self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
+
+    # ---- persistence (fork additions, core.rs:76-86, 112-153) --------------
+
+    async def load_state(self) -> None:
+        data = await self.store.read(CONSENSUS_STATE_KEY)
+        if data is None:
+            return
+        state = ConsensusState.deserialize(data)
+        self.round = state.round
+        self.last_voted_round = state.last_voted_round
+        self.last_committed_round = state.last_committed_round
+        self.high_qc = state.high_qc
+        self.log.info("Recovered consensus state at round %d", self.round)
+
+    async def persist_state(self) -> None:
+        state = ConsensusState(
+            self.round,
+            self.last_voted_round,
+            self.last_committed_round,
+            self.high_qc,
+        )
+        await self.store.write(CONSENSUS_STATE_KEY, state.serialize())
+
+    async def store_block(self, block: Block) -> None:
+        await self.store.write(block.digest().to_bytes(), block.serialize())
+
+        # Maintain the per-round payload index + latest-round key the
+        # proposer's payload buffering feeds on (core.rs:117-148).
+        latest_raw = await self.store.read(LATEST_ROUND_KEY)
+        latest = int.from_bytes(latest_raw, "big") if latest_raw else 0
+        if latest == block.round:
+            raw = await self.store.read(round_key(block.round))
+            payloads = decode_payload_index(raw) if raw else []
+            if block.payload not in payloads:
+                payloads.append(block.payload)
+        elif latest < block.round:
+            payloads = [block.payload]
+        else:
+            self.log.warning("The block round is less than the last round")
+            return
+        await self.store.write(round_key(block.round), encode_payload_index(payloads))
+        await self.store.write(LATEST_ROUND_KEY, round_key(block.round))
+
+    # ---- voting and committing ---------------------------------------------
+
+    def _increase_last_voted_round(self, target: Round) -> None:
+        self.last_voted_round = max(self.last_voted_round, target)
+        self.state_changed = True
+
+    async def _make_vote(self, block: Block) -> Vote | None:
+        safety_rule_1 = block.round > self.last_voted_round
+        safety_rule_2 = block.qc.round + 1 == block.round
+        if block.tc is not None:
+            can_extend = block.tc.round + 1 == block.round
+            can_extend &= block.qc.round >= max(block.tc.high_qc_rounds())
+            safety_rule_2 |= can_extend
+        if not (safety_rule_1 and safety_rule_2):
+            return None
+
+        # Ensure we won't vote for contradicting blocks.
+        self._increase_last_voted_round(block.round)
+        vote = Vote.for_block(block, self.name)
+        vote.signature = await self.signature_service.request_signature(
+            vote.digest()
+        )
+        return vote
+
+    async def _commit(self, block: Block) -> None:
+        if self.last_committed_round >= block.round:
+            return
+
+        # Commit the entire chain up to `block` (needed after view-change),
+        # oldest first.
+        to_commit = [block]
+        parent = block
+        while self.last_committed_round + 1 < parent.round:
+            ancestor = await self.synchronizer.get_parent_block(parent)
+            if ancestor is None:
+                raise SerializationError(
+                    "missing ancestor while committing a delivered chain"
+                )
+            to_commit.append(ancestor)
+            parent = ancestor
+
+        self.last_committed_round = block.round
+        self.state_changed = True
+
+        for b in reversed(to_commit):
+            self.log.debug("Committed %r", b)
+            await self.tx_commit.put(b)
+        # NOTE: this log entry is used to compute performance.
+        self.log.info("Committed block %d -> %s", block.round, block.digest())
+
+    def _update_high_qc(self, qc: QC) -> None:
+        if qc.round > self.high_qc.round:
+            self.high_qc = qc
+            self.state_changed = True
+
+    # ---- round advancement and proposals -----------------------------------
+
+    def _advance_round(self, round_: Round) -> None:
+        if round_ < self.round:
+            return
+        self.timer.reset()
+        self.round = round_ + 1
+        self.state_changed = True
+        self.log.debug("Moved to round %d", self.round)
+        self.aggregator.cleanup(self.round)
+
+    async def _generate_proposal(self, tc: TC | None) -> None:
+        await self.tx_proposer.put(
+            ProposerMessage.make(self.round, self.high_qc, tc)
+        )
+
+    async def _cleanup_proposer(self, b0: Block, b1: Block, block: Block) -> None:
+        await self.tx_proposer.put(
+            ProposerMessage.cleanup([b0.round, b1.round, block.round])
+        )
+
+    def _process_qc(self, qc: QC) -> None:
+        self._advance_round(qc.round)
+        self._update_high_qc(qc)
+
+    # ---- message handlers ---------------------------------------------------
+
+    async def _handle_vote(self, vote: Vote) -> None:
+        self.log.debug("Processing %r", vote)
+        if vote.round < self.round:
+            return
+        # Accumulate-then-dispatch: authority/stake checks happen on entry,
+        # signatures are batch-verified at quorum inside the aggregator.
+        qc = self.aggregator.add_vote(vote, self.round)
+        if qc is not None:
+            self.log.debug("Assembled %r", qc)
+            self._process_qc(qc)
+            if self.name == self.leader_elector.get_leader(self.round):
+                await self._generate_proposal(None)
+
+    async def _handle_timeout(self, timeout: Timeout) -> None:
+        self.log.debug("Processing %r", timeout)
+        if timeout.round < self.round:
+            return
+        # Verify on entry like the reference (core.rs:288): the author's
+        # single signature is checked FIRST (cheap), so a spoofed timeout
+        # cannot force the expensive embedded-QC batch verify — and the
+        # TCMaker can then emit TCs from pre-verified entries.
+        timeout.verify(self.committee, self.verifier)
+        self._process_qc(timeout.high_qc)
+
+        tc = self.aggregator.add_timeout(timeout, self.round)
+        if tc is not None:
+            self.log.debug("Assembled %r", tc)
+            self._advance_round(tc.round)
+
+            addresses = [
+                addr for _, addr in self.committee.broadcast_addresses(self.name)
+            ]
+            await self.network.broadcast(addresses, encode_tc(tc))
+
+            if self.name == self.leader_elector.get_leader(self.round):
+                await self._generate_proposal(tc)
+
+    async def _local_timeout_round(self) -> None:
+        self.log.warning("Timeout reached for round %d", self.round)
+        self._increase_last_voted_round(self.round)
+        timeout = Timeout(high_qc=self.high_qc, round=self.round, author=self.name)
+        timeout.signature = await self.signature_service.request_signature(
+            timeout.digest()
+        )
+        self.log.debug("Created %r", timeout)
+        self.timer.reset()
+
+        addresses = [
+            addr for _, addr in self.committee.broadcast_addresses(self.name)
+        ]
+        await self.network.broadcast(addresses, encode_timeout(timeout))
+        await self._handle_timeout(timeout)
+
+    async def _process_block(self, block: Block) -> None:
+        self.log.debug("Processing %r", block)
+
+        # b0 <- |qc0; b1| <- |qc1; block|: suspend if ancestors are missing
+        # (the synchronizer will re-inject the block via loopback).
+        ancestors = await self.synchronizer.get_ancestors(block)
+        if ancestors is None:
+            self.log.debug("Processing of %s suspended: missing parent", block.digest())
+            return
+        b0, b1 = ancestors
+
+        await self.store_block(block)
+        await self._cleanup_proposer(b0, b1, block)
+
+        # 2-chain commit rule.
+        if b0.round + 1 == b1.round:
+            await self._commit(b0)
+
+        # Prevents bad leaders from proposing blocks far in the future.
+        if block.round != self.round:
+            return
+
+        vote = await self._make_vote(block)
+        if vote is not None:
+            self.log.debug("Created %r", vote)
+            next_leader = self.leader_elector.get_leader(self.round + 1)
+            if next_leader == self.name:
+                await self._handle_vote(vote)
+            else:
+                address = self.committee.address(next_leader)
+                await self.network.send(address, encode_vote(vote))
+
+    async def _handle_proposal(self, block: Block) -> None:
+        digest = block.digest()
+        expected = self.leader_elector.get_leader(block.round)
+        if block.author != expected:
+            raise WrongLeader(digest, block.author, block.round)
+        block.verify(self.committee, self.verifier)
+        self._process_qc(block.qc)
+        if block.tc is not None:
+            self._advance_round(block.tc.round)
+        await self._process_block(block)
+
+    async def _handle_tc(self, tc: TC) -> None:
+        # staleness check first: every node broadcasts assembled TCs, so
+        # stale copies are routine — drop them before paying the 2f+1
+        # batch verify
+        if tc.round < self.round:
+            return
+        tc.verify(self.committee, self.verifier)
+        self._advance_round(tc.round)
+        if self.name == self.leader_elector.get_leader(self.round):
+            await self._generate_proposal(tc)
+
+    # ---- the select loop -----------------------------------------------------
+
+    async def _dispatch(self, tagged) -> None:
+        tag, payload = tagged
+        if tag == TAG_PROPOSE:
+            await self._handle_proposal(payload)
+        elif tag == TAG_VOTE:
+            await self._handle_vote(payload)
+        elif tag == TAG_TIMEOUT:
+            await self._handle_timeout(payload)
+        elif tag == TAG_TC:
+            await self._handle_tc(payload)
+        else:
+            self.log.error("Unexpected protocol message tag %s in core", tag)
+
+    async def run(self) -> None:
+        await self.load_state()
+
+        # Bootstrap: propose if we lead the (possibly recovered) round.
+        self.timer.reset()
+        if self.name == self.leader_elector.get_leader(self.round):
+            await self._generate_proposal(None)
+
+        msg_task = asyncio.ensure_future(self.rx_message.get())
+        loop_task = asyncio.ensure_future(self.rx_loopback.get())
+        timer_task = asyncio.ensure_future(self.timer.wait())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    {msg_task, loop_task, timer_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                # IMPORTANT: replace a completed branch task *before* running
+                # its handler — a handler raising (e.g. benign AuthorityReuse
+                # on a re-broadcast timeout) must not leave the completed task
+                # in the select set, or the loop would re-fire the same branch
+                # with the same payload forever.
+                if msg_task in done:
+                    message = msg_task.result()
+                    msg_task = asyncio.ensure_future(self.rx_message.get())
+                    try:
+                        await self._dispatch(message)
+                    except ConsensusError as e:
+                        self.log.warning("%s", e)
+                if loop_task in done:
+                    block = loop_task.result()
+                    loop_task = asyncio.ensure_future(self.rx_loopback.get())
+                    try:
+                        await self._process_block(block)
+                    except ConsensusError as e:
+                        self.log.warning("%s", e)
+                if timer_task in done:
+                    timer_task = asyncio.ensure_future(self.timer.wait())
+                    # skip stale fires: a message handled above may have
+                    # advanced the round and reset the deadline after this
+                    # wait completed (Timer.expired docstring)
+                    if self.timer.expired():
+                        try:
+                            await self._local_timeout_round()
+                        except ConsensusError as e:
+                            self.log.warning("%s", e)
+                if self.state_changed:
+                    await self.persist_state()
+                    self.state_changed = False
+        finally:
+            for t in (msg_task, loop_task, timer_task):
+                t.cancel()
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.get_running_loop().create_task(
+            self.run(), name="consensus-core"
+        )
+        return self._task
+
+    def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.network.close()

@@ -1,0 +1,24 @@
+"""Leader election.
+
+Parity target: ``RRLeaderElector`` (reference consensus/src/leader.rs:5-21):
+round-robin over the sorted committee public keys. The sorted key list is
+computed once (the reference re-sorts per call; the committee is immutable
+within an epoch).
+"""
+
+from __future__ import annotations
+
+from ..crypto import PublicKey
+from .config import Committee
+from .messages import Round
+
+
+class RoundRobinLeaderElector:
+    def __init__(self, committee: Committee):
+        self._keys: list[PublicKey] = committee.sorted_keys()
+
+    def get_leader(self, round_: Round) -> PublicKey:
+        return self._keys[round_ % len(self._keys)]
+
+
+LeaderElector = RoundRobinLeaderElector

@@ -1,0 +1,336 @@
+"""Protocol messages: Block, Vote, QC, Timeout, TC.
+
+Parity target: reference ``consensus/src/messages.rs`` (16-324). Same
+protocol objects and verification rules, restructured for the TPU crypto
+backend: every ``verify`` takes a ``VerifierBackend`` so certificate
+signature checks ship as *batches* (QC: one shared digest, the
+``verify_shared_msg`` shape; TC: distinct digests, the ``verify_many``
+shape) instead of a sequential per-signature loop — the BASELINE.json
+accumulate-then-dispatch rewrite.
+
+Digest preimages (all SHA-512 truncated to 32 bytes):
+- block:   author ‖ round_le8 ‖ payload ‖ qc.hash   (messages.rs:80-87)
+- vote:    block_hash ‖ round_le8                   (messages.rs:148-153)
+- qc:      hash ‖ round_le8                         (messages.rs:205-210)
+- timeout: round_le8 ‖ high_qc.round_le8            (messages.rs:266-271)
+TC entries sign the timeout digest for (tc.round, entry.high_qc_round)
+(messages.rs:305-311).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..crypto import Digest, PublicKey, Signature, sha512_trunc
+from ..crypto.service import VerifierBackend
+from ..utils.codec import Decoder, Encoder
+from .config import Committee
+from .errors import (
+    AuthorityReuse,
+    InvalidSignature,
+    QCRequiresQuorum,
+    TCRequiresQuorum,
+    UnknownAuthority,
+)
+
+Round = int
+
+
+def _round_le(r: Round) -> bytes:
+    return struct.pack("<Q", r)
+
+
+def _check_certificate_weight(
+    votes_authors: list[PublicKey], committee: Committee, quorum_error
+) -> None:
+    """Shared QC/TC stake rule: no authority reuse, all known, 2f+1 stake."""
+    weight = 0
+    used: set[PublicKey] = set()
+    for name in votes_authors:
+        if name in used:
+            raise AuthorityReuse(name)
+        stake = committee.stake(name)
+        if stake <= 0:
+            raise UnknownAuthority(name)
+        used.add(name)
+        weight += stake
+    if weight < committee.quorum_threshold():
+        raise quorum_error()
+
+
+@dataclass
+class QC:
+    """Quorum certificate: 2f+1 vote signatures over one block digest."""
+
+    hash: Digest = field(default_factory=Digest)
+    round: Round = 0
+    votes: list[tuple[PublicKey, Signature]] = field(default_factory=list)
+
+    @classmethod
+    def genesis(cls) -> "QC":
+        return cls()
+
+    def is_genesis(self) -> bool:
+        return self.hash == Digest() and self.round == 0 and not self.votes
+
+    def timeout(self) -> bool:
+        return self.hash == Digest() and self.round != 0
+
+    def digest(self) -> Digest:
+        return Digest(sha512_trunc(self.hash.to_bytes() + _round_le(self.round)))
+
+    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+        _check_certificate_weight(
+            [pk for pk, _ in self.votes], committee, QCRequiresQuorum
+        )
+        # One batched verification over the shared vote digest — the hot
+        # kernel (reference messages.rs:195 → crypto verify_batch).
+        if not verifier.verify_shared_msg(self.digest(), self.votes):
+            raise InvalidSignature(f"bad signature in QC for {self.hash}")
+
+    # equality on (hash, round) only, like the reference (messages.rs:213-217)
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, QC)
+            and self.hash == other.hash
+            and self.round == other.round
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.hash, self.round))
+
+    def encode(self, enc: Encoder) -> None:
+        enc.raw(self.hash.to_bytes()).u64(self.round).u32(len(self.votes))
+        for pk, sig in self.votes:
+            enc.raw(pk.to_bytes()).raw(sig.to_bytes())
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "QC":
+        h = Digest(dec.raw(Digest.SIZE))
+        rnd = dec.u64()
+        n = dec.u32()
+        votes = [
+            (PublicKey(dec.raw(PublicKey.SIZE)), Signature(dec.raw(Signature.SIZE)))
+            for _ in range(n)
+        ]
+        return cls(hash=h, round=rnd, votes=votes)
+
+    def __repr__(self) -> str:
+        return f"QC({self.hash}, {self.round})"
+
+
+@dataclass
+class TC:
+    """Timeout certificate: 2f+1 timeout signatures for one round."""
+
+    round: Round = 0
+    # (author, signature, author's high_qc round)
+    votes: list[tuple[PublicKey, Signature, Round]] = field(default_factory=list)
+
+    def high_qc_rounds(self) -> list[Round]:
+        return [r for _, _, r in self.votes]
+
+    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+        _check_certificate_weight(
+            [pk for pk, _, _ in self.votes], committee, TCRequiresQuorum
+        )
+        # Each entry signs a different digest (its own high_qc_round), so
+        # this is the distinct-message batch shape (reference verifies these
+        # sequentially, messages.rs:305-311 — here one dispatched batch).
+        digests = [
+            timeout_digest(self.round, hq_round).to_bytes()
+            for _, _, hq_round in self.votes
+        ]
+        ok = verifier.verify_many(
+            digests,
+            [pk.to_bytes() for pk, _, _ in self.votes],
+            [sig.to_bytes() for _, sig, _ in self.votes],
+        )
+        if not all(ok):
+            raise InvalidSignature(f"bad signature in TC for round {self.round}")
+
+    def encode(self, enc: Encoder) -> None:
+        enc.u64(self.round).u32(len(self.votes))
+        for pk, sig, hq in self.votes:
+            enc.raw(pk.to_bytes()).raw(sig.to_bytes()).u64(hq)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "TC":
+        rnd = dec.u64()
+        n = dec.u32()
+        votes = [
+            (
+                PublicKey(dec.raw(PublicKey.SIZE)),
+                Signature(dec.raw(Signature.SIZE)),
+                dec.u64(),
+            )
+            for _ in range(n)
+        ]
+        return cls(round=rnd, votes=votes)
+
+    def __repr__(self) -> str:
+        return f"TC({self.round}, {self.high_qc_rounds()})"
+
+
+def timeout_digest(round_: Round, high_qc_round: Round) -> Digest:
+    """The digest a Timeout (and thus each TC entry) signs."""
+    return Digest(sha512_trunc(_round_le(round_) + _round_le(high_qc_round)))
+
+
+@dataclass
+class Block:
+    """A proposal: extends the block certified by ``qc`` with one payload
+    digest (the fork's single-digest payload, reference messages.rs:16-23)."""
+
+    qc: QC = field(default_factory=QC)
+    tc: TC | None = None
+    author: PublicKey = field(default_factory=PublicKey)
+    round: Round = 0
+    payload: Digest = field(default_factory=Digest)
+    signature: Signature = field(default_factory=Signature)
+
+    @classmethod
+    def genesis(cls) -> "Block":
+        return cls()
+
+    @property
+    def parent(self) -> Digest:
+        return self.qc.hash
+
+    def digest(self) -> Digest:
+        return Digest(
+            sha512_trunc(
+                self.author.to_bytes()
+                + _round_le(self.round)
+                + self.payload.to_bytes()
+                + self.qc.hash.to_bytes()
+            )
+        )
+
+    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+        if committee.stake(self.author) <= 0:
+            raise UnknownAuthority(self.author)
+        if not verifier.verify_one(self.digest(), self.author, self.signature):
+            raise InvalidSignature(f"bad author signature on block {self}")
+        if not self.qc.is_genesis():
+            self.qc.verify(committee, verifier)
+        if self.tc is not None:
+            self.tc.verify(committee, verifier)
+
+    def encode(self, enc: Encoder) -> None:
+        self.qc.encode(enc)
+        enc.flag(self.tc is not None)
+        if self.tc is not None:
+            self.tc.encode(enc)
+        enc.raw(self.author.to_bytes()).u64(self.round)
+        enc.raw(self.payload.to_bytes()).raw(self.signature.to_bytes())
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Block":
+        qc = QC.decode(dec)
+        tc = TC.decode(dec) if dec.flag() else None
+        author = PublicKey(dec.raw(PublicKey.SIZE))
+        rnd = dec.u64()
+        payload = Digest(dec.raw(Digest.SIZE))
+        sig = Signature(dec.raw(Signature.SIZE))
+        return cls(qc=qc, tc=tc, author=author, round=rnd, payload=payload, signature=sig)
+
+    def serialize(self) -> bytes:
+        enc = Encoder()
+        self.encode(enc)
+        return enc.finish()
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Block":
+        dec = Decoder(data)
+        block = cls.decode(dec)
+        dec.finish()
+        return block
+
+    def __repr__(self) -> str:
+        return (
+            f"{self.digest()}: B({self.author}, {self.round}, "
+            f"{self.qc!r}, {self.payload})"
+        )
+
+    def __str__(self) -> str:
+        return f"B{self.round}"
+
+
+@dataclass
+class Vote:
+    """A vote over a block digest, addressed to the next leader."""
+
+    hash: Digest
+    round: Round
+    author: PublicKey
+    signature: Signature = field(default_factory=Signature)
+
+    @classmethod
+    def for_block(cls, block: Block, author: PublicKey) -> "Vote":
+        """Unsigned vote; the caller signs ``digest()`` via SignatureService."""
+        return cls(hash=block.digest(), round=block.round, author=author)
+
+    def digest(self) -> Digest:
+        return Digest(sha512_trunc(self.hash.to_bytes() + _round_le(self.round)))
+
+    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+        if committee.stake(self.author) <= 0:
+            raise UnknownAuthority(self.author)
+        if not verifier.verify_one(self.digest(), self.author, self.signature):
+            raise InvalidSignature(f"bad signature on vote {self}")
+
+    def encode(self, enc: Encoder) -> None:
+        enc.raw(self.hash.to_bytes()).u64(self.round)
+        enc.raw(self.author.to_bytes()).raw(self.signature.to_bytes())
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Vote":
+        return cls(
+            hash=Digest(dec.raw(Digest.SIZE)),
+            round=dec.u64(),
+            author=PublicKey(dec.raw(PublicKey.SIZE)),
+            signature=Signature(dec.raw(Signature.SIZE)),
+        )
+
+    def __repr__(self) -> str:
+        return f"V({self.author}, {self.round}, {self.hash})"
+
+
+@dataclass
+class Timeout:
+    """A round-timeout complaint carrying the sender's highest QC."""
+
+    high_qc: QC
+    round: Round
+    author: PublicKey
+    signature: Signature = field(default_factory=Signature)
+
+    def digest(self) -> Digest:
+        return timeout_digest(self.round, self.high_qc.round)
+
+    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+        if committee.stake(self.author) <= 0:
+            raise UnknownAuthority(self.author)
+        if not verifier.verify_one(self.digest(), self.author, self.signature):
+            raise InvalidSignature(f"bad signature on timeout {self}")
+        if not self.high_qc.is_genesis():
+            self.high_qc.verify(committee, verifier)
+
+    def encode(self, enc: Encoder) -> None:
+        self.high_qc.encode(enc)
+        enc.u64(self.round)
+        enc.raw(self.author.to_bytes()).raw(self.signature.to_bytes())
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Timeout":
+        return cls(
+            high_qc=QC.decode(dec),
+            round=dec.u64(),
+            author=PublicKey(dec.raw(PublicKey.SIZE)),
+            signature=Signature(dec.raw(Signature.SIZE)),
+        )
+
+    def __repr__(self) -> str:
+        return f"TV({self.author}, {self.round}, {self.high_qc!r})"

@@ -1,0 +1,143 @@
+"""Synchronizer: parent-block fetch and re-injection.
+
+Parity target: reference ``Synchronizer`` (consensus/src/synchronizer.rs:
+24-149). ``get_parent_block`` answers from the store, or — on a miss —
+hands the orphan block to an inner task that (a) sends a SyncRequest to the
+block's author, (b) parks a waiter on ``store.notify_read(parent)``, and
+(c) re-broadcasts requests older than ``sync_retry_delay`` to the whole
+committee every TIMER_ACCURACY tick (the "perfect point-to-point link"
+retry, synchronizer.rs:84-105). When the parent is finally written, the
+suspended child block is re-sent to the core via the loopback channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..crypto import Digest, PublicKey
+from ..network import SimpleSender
+from ..store import Store
+from .config import Committee
+from .errors import SerializationError
+from .messages import Block
+from .wire import encode_sync_request
+
+log = logging.getLogger(__name__)
+
+TIMER_ACCURACY_S = 5.0
+
+
+class Synchronizer:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        store: Store,
+        tx_loopback: asyncio.Queue,
+        sync_retry_delay_ms: int,
+        network: SimpleSender | None = None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.store = store
+        self.tx_loopback = tx_loopback
+        self.sync_retry_delay = sync_retry_delay_ms / 1000.0
+        self.network = network if network is not None else SimpleSender()
+
+        self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
+        self._pending: set[Digest] = set()  # child digests being synced
+        self._requests: dict[Digest, float] = {}  # parent digest -> first-ask time
+        self._waiters: set[asyncio.Task] = set()
+        self._retry_task: asyncio.Task | None = None
+
+    def _ensure_retry_task(self) -> None:
+        if self._retry_task is None or self._retry_task.done():
+            self._retry_task = asyncio.get_running_loop().create_task(
+                self._retry_loop(), name="synchronizer-retry"
+            )
+
+    async def _retry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(TIMER_ACCURACY_S)
+            now = time.monotonic()
+            for digest, asked_at in list(self._requests.items()):
+                if asked_at + self.sync_retry_delay < now:
+                    self.log.debug("Requesting sync for block %s (retry)", digest)
+                    addresses = [
+                        addr
+                        for _, addr in self.committee.broadcast_addresses(self.name)
+                    ]
+                    message = encode_sync_request(digest, self.name)
+                    await self.network.broadcast(addresses, message)
+
+    async def _waiter(self, parent: Digest, child: Block) -> None:
+        """Park on the store until the parent exists, then loop the child
+        block back into the core (synchronizer.rs:74-83, 115-118)."""
+        try:
+            await self.store.notify_read(parent.to_bytes())
+        except asyncio.CancelledError:
+            return
+        self._pending.discard(child.digest())
+        self._requests.pop(parent, None)
+        await self.tx_loopback.put(child)
+
+    async def _request_parent(self, block: Block) -> None:
+        if block.digest() in self._pending:
+            return
+        self._pending.add(block.digest())
+        parent = block.parent
+        task = asyncio.get_running_loop().create_task(
+            self._waiter(parent, block), name=f"sync-wait-{parent}"
+        )
+        self._waiters.add(task)
+        task.add_done_callback(self._waiters.discard)
+
+        if parent not in self._requests:
+            self.log.debug("Requesting sync for block %s", parent)
+            self._requests[parent] = time.monotonic()
+            address = self.committee.address(block.author)
+            if address is not None:
+                await self.network.send(
+                    address, encode_sync_request(parent, self.name)
+                )
+        self._ensure_retry_task()
+
+    async def get_parent_block(self, block: Block) -> Block | None:
+        """The block certified by ``block.qc``; None if it must be fetched
+        (in which case processing of ``block`` is suspended)."""
+        if block.qc.is_genesis():
+            return Block.genesis()
+        data = await self.store.read(block.parent.to_bytes())
+        if data is not None:
+            try:
+                return Block.deserialize(data)
+            except Exception as e:
+                raise SerializationError(f"corrupt block in store: {e}") from e
+        await self._request_parent(block)
+        return None
+
+    async def get_ancestors(self, block: Block) -> tuple[Block, Block] | None:
+        """(b0, b1) with b0 <- |qc0; b1| <- |qc1; block|, or None if the
+        parent chain is not yet locally available."""
+        b1 = await self.get_parent_block(block)
+        if b1 is None:
+            return None
+        b0 = await self.get_parent_block(b1)
+        if b0 is None:
+            # Delivered blocks have stored ancestors (synchronizer.rs:142-146);
+            # reaching here means the store lost data.
+            raise SerializationError(
+                f"missing ancestor of delivered block {b1.digest()}"
+            )
+        return b0, b1
+
+    def shutdown(self) -> None:
+        if self._retry_task is not None:
+            self._retry_task.cancel()
+            self._retry_task = None
+        for task in list(self._waiters):
+            task.cancel()
+        self._waiters.clear()
+        self.network.close()

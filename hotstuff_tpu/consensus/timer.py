@@ -1,0 +1,42 @@
+"""Resettable round timer.
+
+Parity target: reference ``Timer`` (consensus/src/timer.rs:10-34): a
+future that completes ``duration`` ms after the last ``reset()``. Here the
+deadline is re-checked after every sleep, so a ``reset()`` while a
+``wait()`` is outstanding simply extends the sleep instead of requiring
+task cancellation — the core's select loop keeps one wait task alive
+across resets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class Timer:
+    def __init__(self, duration_ms: int):
+        self.duration = duration_ms / 1000.0
+        self._deadline: float | None = None
+
+    def reset(self) -> None:
+        self._deadline = asyncio.get_running_loop().time() + self.duration
+
+    def expired(self) -> bool:
+        """Is the *current* deadline in the past? A ``wait()`` that completed
+        before a subsequent ``reset()`` is stale — the reference's tokio
+        ``Sleep`` un-readies itself on reset (timer.rs:21-26); callers
+        re-check this to get the same semantics."""
+        return (
+            self._deadline is not None
+            and asyncio.get_running_loop().time() >= self._deadline
+        )
+
+    async def wait(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self._deadline is None:
+            self._deadline = loop.time() + self.duration
+        while True:
+            remaining = self._deadline - loop.time()
+            if remaining <= 0:
+                return
+            await asyncio.sleep(remaining)

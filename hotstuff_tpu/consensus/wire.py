@@ -1,0 +1,89 @@
+"""The consensus wire protocol: the tagged message union.
+
+Parity target: ``ConsensusMessage`` (reference consensus/src/consensus.rs:
+30-38): Propose(Block), Vote, Timeout, TC, SyncRequest(digest, origin),
+Producer(digest) — the fork's payload-ingest message.
+"""
+
+from __future__ import annotations
+
+from ..crypto import Digest, PublicKey
+from ..utils.codec import CodecError, Decoder, Encoder
+from .errors import SerializationError
+from .messages import TC, Block, Timeout, Vote
+
+TAG_PROPOSE = 0
+TAG_VOTE = 1
+TAG_TIMEOUT = 2
+TAG_TC = 3
+TAG_SYNC_REQUEST = 4
+TAG_PRODUCER = 5
+
+ACK = b"Ack"
+
+
+def encode_propose(block: Block) -> bytes:
+    enc = Encoder().u8(TAG_PROPOSE)
+    block.encode(enc)
+    return enc.finish()
+
+
+def encode_vote(vote: Vote) -> bytes:
+    enc = Encoder().u8(TAG_VOTE)
+    vote.encode(enc)
+    return enc.finish()
+
+
+def encode_timeout(timeout: Timeout) -> bytes:
+    enc = Encoder().u8(TAG_TIMEOUT)
+    timeout.encode(enc)
+    return enc.finish()
+
+
+def encode_tc(tc: TC) -> bytes:
+    enc = Encoder().u8(TAG_TC)
+    tc.encode(enc)
+    return enc.finish()
+
+
+def encode_sync_request(missing: Digest, origin: PublicKey) -> bytes:
+    return (
+        Encoder()
+        .u8(TAG_SYNC_REQUEST)
+        .raw(missing.to_bytes())
+        .raw(origin.to_bytes())
+        .finish()
+    )
+
+
+def encode_producer(payload: Digest) -> bytes:
+    return Encoder().u8(TAG_PRODUCER).raw(payload.to_bytes()).finish()
+
+
+def decode_message(data: bytes):
+    """bytes -> (tag, payload). Raises SerializationError on malformed input.
+
+    Payload by tag: Propose -> Block, Vote -> Vote, Timeout -> Timeout,
+    TC -> TC, SyncRequest -> (Digest, PublicKey), Producer -> Digest.
+    """
+    try:
+        dec = Decoder(data)
+        tag = dec.u8()
+        if tag == TAG_PROPOSE:
+            out = Block.decode(dec)
+        elif tag == TAG_VOTE:
+            out = Vote.decode(dec)
+        elif tag == TAG_TIMEOUT:
+            out = Timeout.decode(dec)
+        elif tag == TAG_TC:
+            out = TC.decode(dec)
+        elif tag == TAG_SYNC_REQUEST:
+            out = (Digest(dec.raw(Digest.SIZE)), PublicKey(dec.raw(PublicKey.SIZE)))
+        elif tag == TAG_PRODUCER:
+            out = Digest(dec.raw(Digest.SIZE))
+        else:
+            raise CodecError(f"unknown message tag {tag}")
+        dec.finish()
+        return tag, out
+    except CodecError as e:
+        raise SerializationError(str(e)) from e

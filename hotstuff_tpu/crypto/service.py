@@ -29,6 +29,16 @@ class VerifierBackend(Protocol):
         """All signatures over one shared digest (QC verify shape)."""
         ...
 
+    def verify_many(
+        self,
+        digests: list[bytes],
+        pks: list[bytes],
+        sigs: list[bytes],
+    ) -> list[bool]:
+        """Per-item validity over distinct messages (TC verify / eviction
+        shape)."""
+        ...
+
 
 class CpuVerifier:
     """Default backend: per-signature OpenSSL verification."""
@@ -50,6 +60,16 @@ class CpuVerifier:
             return True
         except CryptoError:
             return False
+
+    def verify_many(
+        self,
+        digests: list[bytes],
+        pks: list[bytes],
+        sigs: list[bytes],
+    ) -> list[bool]:
+        from .signature import batch_verify_arrays
+
+        return batch_verify_arrays(digests, pks, sigs)
 
 
 class SignatureService:

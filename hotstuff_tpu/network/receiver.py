@@ -45,6 +45,7 @@ class Receiver:
         self.port = port
         self.handler = handler
         self._server: asyncio.AbstractServer | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
 
     async def spawn(self) -> None:
         self._server = await asyncio.start_server(
@@ -57,6 +58,7 @@ class Receiver:
     ) -> None:
         peer = stream_writer.get_extra_info("peername")
         log.debug("Incoming connection from %s", peer)
+        self._writers.add(stream_writer)
         writer = Writer(stream_writer)
         try:
             while True:
@@ -70,10 +72,16 @@ class Receiver:
         ):
             log.debug("Connection from %s closed", peer)
         finally:
+            self._writers.discard(stream_writer)
             stream_writer.close()
 
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Persistent peers hold their connections open; close them so
+            # wait_closed() (which in 3.12 waits on every live connection)
+            # can complete.
+            for w in list(self._writers):
+                w.close()
             await self._server.wait_closed()
             self._server = None

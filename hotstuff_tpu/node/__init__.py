@@ -1,0 +1,27 @@
+"""Node layer: CLI, composition root, JSON config I/O, benchmark client.
+
+Parity map (SURVEY.md §2.5): keys/run/deploy subcommands, Node struct,
+Export-style config files, producer-path client — reference crate
+``node/``.
+"""
+
+from .config import (
+    ConfigError,
+    Secret,
+    read_committee,
+    read_parameters,
+    write_committee,
+    write_parameters,
+)
+from .node import Node, make_verifier
+
+__all__ = [
+    "ConfigError",
+    "Secret",
+    "read_committee",
+    "read_parameters",
+    "write_committee",
+    "write_parameters",
+    "Node",
+    "make_verifier",
+]

@@ -1,0 +1,165 @@
+"""Benchmark client: an open-loop producer-path load generator.
+
+The reference's client (node/src/client.rs:40-153) still speaks the
+deleted mempool's "front" port and can't drive the fork (SURVEY.md §2.5
+stale-fork caveat). This client speaks the fork's actual ingest path:
+``Producer(Digest)`` messages on the consensus port
+(consensus/src/consensus.rs:151-160), broadcast to every node so any
+round's leader can propose the payload.
+
+Kept from the reference's methodology (client.rs:103-152):
+- wait for every node's port to be listening, then an extra warm-up;
+- open-loop rate control in PRECISION bursts per second;
+- one tagged sample payload per burst, logged for latency measurement;
+- a "rate too high" warning when a burst overruns its slot.
+
+NOTE: the sample log entries are used to compute performance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from ..crypto import Digest
+from ..network.framing import read_frame, send_frame
+from .config import read_committee
+
+log = logging.getLogger("client")
+
+PRECISION = 20  # bursts per second
+BURST_INTERVAL = 1.0 / PRECISION
+
+
+class _NodeConn:
+    """One persistent framed connection; ACK frames are drained."""
+
+    def __init__(self, address):
+        self.address = address
+        self.writer: asyncio.StreamWriter | None = None
+        self._sink: asyncio.Task | None = None
+
+    async def connect(self) -> None:
+        reader, self.writer = await asyncio.open_connection(*self.address)
+        self._sink = asyncio.ensure_future(self._drain(reader))
+
+    @staticmethod
+    async def _drain(reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                await read_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+
+    async def send(self, payload: bytes) -> None:
+        await send_frame(self.writer, payload)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.cancel()
+        if self.writer is not None:
+            self.writer.close()
+
+
+async def wait_for_nodes(addresses, poll=0.1) -> None:
+    for address in addresses:
+        while True:
+            try:
+                _, w = await asyncio.open_connection(*address)
+                w.close()
+                break
+            except OSError:
+                await asyncio.sleep(poll)
+
+
+async def run_client(
+    addresses,
+    rate: int,
+    duration: float,
+    warmup: float = 0.0,
+) -> int:
+    """Send ``rate`` producer payloads/s for ``duration`` seconds to every
+    node. Returns the number of payloads sent (per node)."""
+    from ..consensus.wire import encode_producer
+
+    log.info("Waiting for all nodes to be online...")
+    await wait_for_nodes(addresses)
+    if warmup:
+        await asyncio.sleep(warmup)
+
+    conns = [_NodeConn(a) for a in addresses]
+    for c in conns:
+        await c.connect()
+
+    burst = max(1, rate // PRECISION)
+    log.info("Start sending transactions")
+    # NOTE: this log entry is used to compute performance.
+    log.info("Transactions rate: %d tx/s", rate)
+
+    loop = asyncio.get_running_loop()
+    start = loop.time()
+    sent = 0
+    counter = 0
+    try:
+        while loop.time() - start < duration:
+            slot_start = loop.time()
+            for i in range(burst):
+                digest = Digest.random()
+                if i == 0:
+                    # NOTE: this log entry is used to compute performance.
+                    log.info("Sending sample payload %s", digest)
+                message = encode_producer(digest)
+                for c in conns:
+                    await c.send(message)
+                sent += 1
+            counter += 1
+            elapsed = loop.time() - slot_start
+            if elapsed > BURST_INTERVAL:
+                # NOTE: this log entry is used to compute performance.
+                log.warning("Transaction rate too high for this client")
+            else:
+                await asyncio.sleep(BURST_INTERVAL - elapsed)
+    except (ConnectionError, OSError) as e:
+        log.error("Failed to send transaction: %s", e)
+    finally:
+        for c in conns:
+            c.close()
+    return sent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Producer-path benchmark client"
+    )
+    parser.add_argument(
+        "--committee", required=True, help="committee JSON file"
+    )
+    parser.add_argument("--rate", type=int, default=1_000, help="payloads/s")
+    parser.add_argument(
+        "--duration", type=float, default=20.0, help="send window (s)"
+    )
+    parser.add_argument(
+        "--warmup", type=float, default=2.0, help="settle time after ports open"
+    )
+    parser.add_argument("-v", "--verbose", action="count", default=1)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=[logging.ERROR, logging.INFO, logging.DEBUG][min(args.verbose, 2)],
+        format="%(asctime)s.%(msecs)03dZ [%(levelname)s] %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+    )
+
+    committee = read_committee(args.committee)
+    addresses = [a.address for a in committee.authorities.values()]
+    sent = asyncio.run(
+        run_client(addresses, args.rate, args.duration, args.warmup)
+    )
+    log.info("Sent %d payloads", sent)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

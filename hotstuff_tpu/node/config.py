@@ -1,0 +1,84 @@
+"""Node-level JSON config I/O.
+
+Parity target: reference ``node/src/config.rs:21-85`` — the ``Export``
+read/write-JSON-file pattern for ``Secret`` keypair files, committee
+files, and parameters files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..consensus import Committee, Parameters
+from ..crypto import PublicKey, SecretKey, generate_production_keypair
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _read_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ConfigError(f"Failed to read config file '{path}': {e}") from e
+
+
+def _write_json(path: str, data: dict) -> None:
+    try:
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        raise ConfigError(f"Failed to write config file '{path}': {e}") from e
+
+
+class Secret:
+    """A node's identity: {name, secret} as base64 JSON
+    (reference node/src/config.rs:52-68)."""
+
+    def __init__(self, name: PublicKey, secret: SecretKey):
+        self.name = name
+        self.secret = secret
+
+    @classmethod
+    def new(cls) -> "Secret":
+        return cls(*generate_production_keypair())
+
+    def write(self, path: str) -> None:
+        _write_json(
+            path,
+            {
+                "name": self.name.encode_base64(),
+                "secret": self.secret.encode_base64(),
+            },
+        )
+        os.chmod(path, 0o600)
+
+    @classmethod
+    def read(cls, path: str) -> "Secret":
+        data = _read_json(path)
+        return cls(
+            PublicKey.decode_base64(data["name"]),
+            SecretKey.decode_base64(data["secret"]),
+        )
+
+
+def write_committee(committee: Committee, path: str) -> None:
+    _write_json(path, {"consensus": committee.to_json()})
+
+
+def read_committee(path: str) -> Committee:
+    data = _read_json(path)
+    return Committee.from_json(data.get("consensus", data))
+
+
+def write_parameters(parameters: Parameters, path: str) -> None:
+    _write_json(path, {"consensus": parameters.to_json()})
+
+
+def read_parameters(path: str) -> Parameters:
+    data = _read_json(path)
+    return Parameters.from_json(data.get("consensus", data))

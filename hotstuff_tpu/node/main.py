@@ -1,0 +1,126 @@
+"""The node CLI: keys / run / deploy.
+
+Parity target: reference ``node/src/main.rs:15-148`` — ``keys`` writes a
+fresh keypair file, ``run`` boots a node from config files, ``deploy``
+spins up a whole local committee in one process (the in-process testbed,
+main.rs:102-148). ``-v`` repeats raise verbosity; millisecond timestamps
+are always on (the reference gates them behind the `benchmark` feature —
+they're the tracing schema here, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from ..consensus import Committee, Parameters
+from .config import (
+    Secret,
+    read_committee,
+    write_committee,
+    write_parameters,
+)
+from .node import Node
+
+log = logging.getLogger("node")
+
+LEVELS = [logging.ERROR, logging.WARNING, logging.INFO, logging.DEBUG]
+
+
+def setup_logging(verbosity: int) -> None:
+    logging.basicConfig(
+        level=LEVELS[min(verbosity, 3)],
+        format="%(asctime)s.%(msecs)03dZ [%(levelname)s] %(name)s %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+    )
+
+
+async def _run_node(args) -> None:
+    node = await Node.new(
+        committee_file=args.committee,
+        key_file=args.keys,
+        store_path=args.store,
+        parameters_file=args.parameters,
+        verifier_backend=args.verifier,
+    )
+    await node.analyze_block()
+
+
+async def _deploy_testbed(nodes: int, base_port: int) -> None:
+    """In-process local testbed (reference main.rs:102-148): n fresh
+    keypairs, committee.json + node_i.json on disk, every node spawned as
+    a task in this process, commit channels drained."""
+    keys = [Secret.new() for _ in range(nodes)]
+    committee = Committee.new(
+        [
+            (secret.name, 1, ("127.0.0.1", base_port + i))
+            for i, secret in enumerate(keys)
+        ]
+    )
+    write_committee(committee, ".committee.json")
+    write_parameters(Parameters(), ".parameters.json")
+    for i, secret in enumerate(keys):
+        secret.write(f".node_{i}.json")
+
+    booted = []
+    for i in range(nodes):
+        node = await Node.new(
+            committee_file=".committee.json",
+            key_file=f".node_{i}.json",
+            store_path=f".db_{i}",
+            parameters_file=".parameters.json",
+            bind_host="127.0.0.1",
+        )
+        booted.append(node)
+    log.info("Deployed %d-node local testbed on base port %d", nodes, base_port)
+    await asyncio.gather(*(n.analyze_block() for n in booted))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hotstuff-tpu-node",
+        description="A TPU-native implementation of 2-chain HotStuff",
+    )
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_keys = sub.add_parser("keys", help="generate a new keypair file")
+    p_keys.add_argument("--filename", required=True)
+
+    p_run = sub.add_parser("run", help="run a node")
+    p_run.add_argument("--keys", required=True)
+    p_run.add_argument("--committee", required=True)
+    p_run.add_argument("--store", required=True)
+    p_run.add_argument("--parameters", default=None)
+    p_run.add_argument(
+        "--verifier",
+        choices=["cpu", "tpu"],
+        default="cpu",
+        help="signature verification backend",
+    )
+
+    p_dep = sub.add_parser("deploy", help="deploy a local testbed")
+    p_dep.add_argument("--nodes", type=int, required=True)
+    p_dep.add_argument("--base-port", type=int, default=25_200)
+
+    args = parser.parse_args(argv)
+    setup_logging(args.verbose)
+
+    if args.command == "keys":
+        Secret.new().write(args.filename)
+        return 0
+    if args.command == "run":
+        # sanity-check the committee file before booting
+        read_committee(args.committee)
+        asyncio.run(_run_node(args))
+        return 0
+    if args.command == "deploy":
+        asyncio.run(_deploy_testbed(args.nodes, args.base_port))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Node: the composition root wiring store, crypto, and consensus.
+
+Parity target: reference ``Node`` (node/src/node.rs:16-65): read the
+committee/secret/parameters files, open the store, start the signature
+service, spawn Consensus, and expose (and optionally drain) the commit
+channel.
+
+TPU addition: ``verifier_backend`` selects where signature batches are
+verified — "cpu" (default) or "tpu" (the JAX batch kernel,
+hotstuff_tpu/tpu/ed25519.py) — the SignatureService-boundary plug point
+from BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..consensus import Consensus, Parameters
+from ..crypto import SignatureService
+from ..crypto.service import CpuVerifier, VerifierBackend
+from ..store import Store
+from .config import Secret, read_committee, read_parameters
+
+log = logging.getLogger(__name__)
+
+
+def make_verifier(kind: str) -> VerifierBackend:
+    if kind == "cpu":
+        return CpuVerifier()
+    if kind == "tpu":
+        from ..tpu.ed25519 import BatchVerifier
+
+        return BatchVerifier()
+    raise ValueError(f"unknown verifier backend '{kind}'")
+
+
+class Node:
+    CHANNEL_CAPACITY = 1_000
+
+    def __init__(self):
+        self.commit: asyncio.Queue | None = None
+        self.consensus: Consensus | None = None
+        self.store: Store | None = None
+
+    @classmethod
+    async def new(
+        cls,
+        committee_file: str,
+        key_file: str,
+        store_path: str,
+        parameters_file: str | None = None,
+        verifier_backend: str = "cpu",
+        bind_host: str = "0.0.0.0",
+    ) -> "Node":
+        self = cls()
+        committee = read_committee(committee_file)
+        secret = Secret.read(key_file)
+        parameters = (
+            read_parameters(parameters_file) if parameters_file else Parameters()
+        )
+
+        self.store = Store(store_path)
+        signature_service = SignatureService(secret.secret)
+        verifier = make_verifier(verifier_backend)
+        if hasattr(verifier, "precompute"):
+            # warm the TPU backend's committee point cache (epoch setup)
+            verifier.precompute(
+                [pk.to_bytes() for pk in committee.authorities]
+            )
+
+        self.commit = asyncio.Queue(maxsize=self.CHANNEL_CAPACITY)
+        self.consensus = await Consensus.spawn(
+            secret.name,
+            committee,
+            parameters,
+            signature_service,
+            self.store,
+            self.commit,
+            verifier=verifier,
+            bind_host=bind_host,
+        )
+        log.info("Node %s successfully booted", secret.name)
+        return self
+
+    async def analyze_block(self) -> None:
+        """Drain the commit channel — the application layer stub
+        (node/src/node.rs:61-65)."""
+        while True:
+            _block = await self.commit.get()
+            # Here the application would execute the committed payload.
+
+    async def shutdown(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.shutdown()
+        if self.store is not None:
+            self.store.close()

@@ -182,6 +182,14 @@ class BatchVerifier:
 
     name = "tpu"
 
+    def verify_many(
+        self,
+        digests: list[bytes],
+        pks: list[bytes],
+        sigs: list[bytes],
+    ) -> list[bool]:
+        return [bool(v) for v in self.verify(digests, pks, sigs)]
+
     def verify_one(self, digest, pk, sig) -> bool:
         return bool(
             self.verify([digest.to_bytes()], [pk.to_bytes()], [sig.to_bytes()])[0]

@@ -1,0 +1,106 @@
+"""Deterministic binary wire codec.
+
+The reference serializes every protocol message with bincode
+(reference ``consensus/src/consensus.rs:30-38`` and friends). This is the
+framework's equivalent: a tiny, explicit, deterministic little-endian
+codec — fixed-width ints, u32-length-prefixed variable bytes, 1-byte
+option flags — so the wire format is fully specified here rather than
+inherited from a serialization library.
+"""
+
+from __future__ import annotations
+
+
+class CodecError(Exception):
+    """Malformed or truncated wire data."""
+
+
+class Encoder:
+    __slots__ = ("_parts",)
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def u8(self, v: int) -> "Encoder":
+        self._parts.append(v.to_bytes(1, "little"))
+        return self
+
+    def u32(self, v: int) -> "Encoder":
+        self._parts.append(v.to_bytes(4, "little"))
+        return self
+
+    def u64(self, v: int) -> "Encoder":
+        self._parts.append(v.to_bytes(8, "little"))
+        return self
+
+    def u128(self, v: int) -> "Encoder":
+        self._parts.append(v.to_bytes(16, "little"))
+        return self
+
+    def raw(self, b: bytes) -> "Encoder":
+        """Fixed-size bytes: no length prefix (caller knows the size)."""
+        self._parts.append(b)
+        return self
+
+    def var_bytes(self, b: bytes) -> "Encoder":
+        self.u32(len(b))
+        self._parts.append(b)
+        return self
+
+    def flag(self, present: bool) -> "Encoder":
+        return self.u8(1 if present else 0)
+
+    def finish(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Decoder:
+    __slots__ = ("_data", "_pos")
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise CodecError(
+                f"truncated: need {n} bytes at offset {self._pos}, "
+                f"have {len(self._data) - self._pos}"
+            )
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u32(self) -> int:
+        return int.from_bytes(self._take(4), "little")
+
+    def u64(self) -> int:
+        return int.from_bytes(self._take(8), "little")
+
+    def u128(self) -> int:
+        return int.from_bytes(self._take(16), "little")
+
+    def raw(self, n: int) -> bytes:
+        return self._take(n)
+
+    def var_bytes(self, max_len: int = 1 << 24) -> bytes:
+        n = self.u32()
+        if n > max_len:
+            raise CodecError(f"length {n} exceeds cap {max_len}")
+        return self._take(n)
+
+    def flag(self) -> bool:
+        v = self.u8()
+        if v not in (0, 1):
+            raise CodecError(f"invalid option flag {v}")
+        return v == 1
+
+    def finish(self) -> None:
+        """Assert the input was fully consumed."""
+        if self._pos != len(self._data):
+            raise CodecError(
+                f"{len(self._data) - self._pos} trailing bytes after decode"
+            )

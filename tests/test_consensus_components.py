@@ -1,0 +1,121 @@
+"""Component tests: timer, synchronizer, helper (reference
+timer_tests.rs, synchronizer_tests.rs:5-110, helper_tests.rs:7-37).
+"""
+
+import asyncio
+
+from hotstuff_tpu.consensus import Block, Synchronizer, Timer
+from hotstuff_tpu.consensus.helper import Helper
+from hotstuff_tpu.consensus.wire import (
+    TAG_PROPOSE,
+    decode_message,
+    encode_sync_request,
+)
+from hotstuff_tpu.store import Store
+
+from .common import async_test, chain, committee, fresh_base_port, keys, listener
+
+
+@async_test
+async def test_timer_fires_after_delay():
+    timer = Timer(50)
+    timer.reset()
+    await asyncio.wait_for(timer.wait(), timeout=1.0)
+
+
+@async_test
+async def test_timer_reset_postpones():
+    timer = Timer(100)
+    timer.reset()
+    waiter = asyncio.ensure_future(timer.wait())
+    await asyncio.sleep(0.06)
+    timer.reset()  # push the deadline out
+    await asyncio.sleep(0.06)
+    assert not waiter.done()  # old deadline passed but reset extended it
+    await asyncio.wait_for(waiter, timeout=1.0)
+
+
+@async_test
+async def test_synchronizer_parent_hit(tmp_path):
+    store = Store(str(tmp_path / "db"))
+    base = fresh_base_port()
+    blocks = chain(2)
+    await store.write(blocks[0].digest().to_bytes(), blocks[0].serialize())
+    sync = Synchronizer(
+        keys()[0][0], committee(base), store, asyncio.Queue(), 10_000
+    )
+    parent = await sync.get_parent_block(blocks[1])
+    assert parent is not None
+    assert parent.digest() == blocks[0].digest()
+    sync.shutdown()
+    store.close()
+
+
+@async_test
+async def test_synchronizer_genesis(tmp_path):
+    store = Store(str(tmp_path / "db"))
+    base = fresh_base_port()
+    sync = Synchronizer(
+        keys()[0][0], committee(base), store, asyncio.Queue(), 10_000
+    )
+    parent = await sync.get_parent_block(chain(1)[0])
+    assert parent == Block.genesis()
+    sync.shutdown()
+    store.close()
+
+
+@async_test
+async def test_synchronizer_miss_requests_then_loopback(tmp_path):
+    """Store miss: a SyncRequest goes to the block author; once the parent
+    is written, the suspended child comes back on the loopback channel
+    (synchronizer_tests.rs miss case)."""
+    store = Store(str(tmp_path / "db"))
+    base = fresh_base_port()
+    blocks = chain(2)
+    name = keys()[0][0]
+    loopback: asyncio.Queue = asyncio.Queue()
+    sync = Synchronizer(name, committee(base), store, loopback, 10_000)
+
+    # the author of blocks[1] will receive the sync request
+    author_port = base + [pk for pk, _ in keys()].index(blocks[1].author)
+    expected = encode_sync_request(blocks[0].digest(), name)
+    listen = asyncio.ensure_future(listener(author_port, expected))
+    await asyncio.sleep(0.05)
+
+    assert await sync.get_parent_block(blocks[1]) is None
+    await asyncio.wait_for(listen, timeout=2.0)
+
+    # writing the parent wakes the waiter and re-injects the child
+    await store.write(blocks[0].digest().to_bytes(), blocks[0].serialize())
+    child = await asyncio.wait_for(loopback.get(), timeout=2.0)
+    assert child.digest() == blocks[1].digest()
+    sync.shutdown()
+    store.close()
+
+
+@async_test
+async def test_helper_replies_to_sync_request(tmp_path):
+    """Helper reads the requested block and sends it back as a Propose
+    (helper_tests.rs:7-37)."""
+    store = Store(str(tmp_path / "db"))
+    base = fresh_base_port()
+    com = committee(base)
+    block = chain(1)[0]
+    await store.write(block.digest().to_bytes(), block.serialize())
+
+    requests: asyncio.Queue = asyncio.Queue()
+    helper = Helper(com, store, requests)
+    helper.spawn()
+
+    requester = keys()[1][0]
+    requester_port = base + 1
+    listen = asyncio.ensure_future(listener(requester_port))
+    await asyncio.sleep(0.05)
+
+    await requests.put((block.digest(), requester))
+    frame = await asyncio.wait_for(listen, timeout=2.0)
+    tag, payload = decode_message(frame)
+    assert tag == TAG_PROPOSE
+    assert payload.digest() == block.digest()
+    helper.shutdown()
+    store.close()

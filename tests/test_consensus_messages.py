@@ -1,0 +1,188 @@
+"""Protocol-object tests: codec round-trips and verification rules.
+
+Ports the reference's messages_tests.rs:7-55 (QC verify success /
+authority reuse / unknown authority / insufficient stake) plus wire-codec
+coverage for every message type.
+"""
+
+import pytest
+
+from hotstuff_tpu.consensus import (
+    QC,
+    TC,
+    AuthorityReuse,
+    Block,
+    InvalidSignature,
+    QCRequiresQuorum,
+    TCRequiresQuorum,
+    Timeout,
+    UnknownAuthority,
+    Vote,
+    timeout_digest,
+)
+from hotstuff_tpu.consensus.wire import (
+    TAG_PRODUCER,
+    TAG_PROPOSE,
+    TAG_SYNC_REQUEST,
+    TAG_TC,
+    TAG_TIMEOUT,
+    TAG_VOTE,
+    decode_message,
+    encode_producer,
+    encode_propose,
+    encode_sync_request,
+    encode_tc,
+    encode_timeout,
+    encode_vote,
+)
+from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+from hotstuff_tpu.crypto.service import CpuVerifier
+
+from .common import (
+    chain,
+    committee,
+    keys,
+    qc_for_block,
+    signed_block,
+    signed_timeout,
+    signed_vote,
+)
+
+VERIFIER = CpuVerifier()
+COMMITTEE = committee(9_000)
+
+
+def test_block_roundtrip():
+    blocks = chain(3)
+    b = blocks[-1]
+    again = Block.deserialize(b.serialize())
+    assert again.digest() == b.digest()
+    assert again.qc == b.qc
+    assert again.round == b.round
+    assert again.signature == b.signature
+
+
+def test_wire_roundtrip_all_tags():
+    blocks = chain(2)
+    pk, sk = keys()[0]
+    vote = signed_vote(blocks[0], pk, sk)
+    timeout = signed_timeout(QC.genesis(), 3, pk, sk)
+    tc = TC(round=3, votes=[(pk, timeout.signature, 0)])
+    digest = Digest.random()
+
+    for encoded, tag in [
+        (encode_propose(blocks[1]), TAG_PROPOSE),
+        (encode_vote(vote), TAG_VOTE),
+        (encode_timeout(timeout), TAG_TIMEOUT),
+        (encode_tc(tc), TAG_TC),
+        (encode_sync_request(digest, pk), TAG_SYNC_REQUEST),
+        (encode_producer(digest), TAG_PRODUCER),
+    ]:
+        got_tag, payload = decode_message(encoded)
+        assert got_tag == tag
+        assert payload is not None
+
+
+def test_verify_valid_block():
+    blocks = chain(2)
+    blocks[1].verify(COMMITTEE, VERIFIER)  # should not raise
+
+
+def test_verify_wrong_signature():
+    blocks = chain(2)
+    b = blocks[1]
+    b.signature = Signature(b"\x01" * 64)
+    with pytest.raises(InvalidSignature):
+        b.verify(COMMITTEE, VERIFIER)
+
+
+def test_verify_valid_qc():
+    block = chain(1)[0]
+    qc_for_block(block).verify(COMMITTEE, VERIFIER)  # should not raise
+
+
+def test_qc_authority_reuse():
+    block = chain(1)[0]
+    qc = qc_for_block(block)
+    qc.votes.append(qc.votes[0])  # duplicate first voter
+    with pytest.raises(AuthorityReuse):
+        qc.verify(COMMITTEE, VERIFIER)
+
+
+def test_qc_unknown_authority():
+    block = chain(1)[0]
+    qc = qc_for_block(block)
+    outsider_pk, outsider_sk = generate_keypair(b"\x01" * 32, 99)
+    vote_digest = Vote.for_block(block, outsider_pk).digest()
+    qc.votes[0] = (outsider_pk, Signature.new(vote_digest, outsider_sk))
+    with pytest.raises(UnknownAuthority):
+        qc.verify(COMMITTEE, VERIFIER)
+
+
+def test_qc_insufficient_stake():
+    block = chain(1)[0]
+    qc = qc_for_block(block, voters=2)  # 2 of 4 < quorum (3)
+    with pytest.raises(QCRequiresQuorum):
+        qc.verify(COMMITTEE, VERIFIER)
+
+
+def test_qc_bad_signature_in_batch():
+    block = chain(1)[0]
+    qc = qc_for_block(block)
+    pk0, _ = keys()[0]
+    qc.votes[0] = (pk0, Signature(b"\x02" * 64))
+    with pytest.raises(InvalidSignature):
+        qc.verify(COMMITTEE, VERIFIER)
+
+
+def test_timeout_verify_and_digest():
+    pk, sk = keys()[0]
+    t = signed_timeout(QC.genesis(), 7, pk, sk)
+    t.verify(COMMITTEE, VERIFIER)
+    assert t.digest() == timeout_digest(7, 0)
+
+
+def test_tc_verify():
+    # 3 authorities time out at round 5 with genesis high QCs
+    votes = []
+    for pk, sk in keys()[:3]:
+        t = signed_timeout(QC.genesis(), 5, pk, sk)
+        votes.append((pk, t.signature, 0))
+    tc = TC(round=5, votes=votes)
+    tc.verify(COMMITTEE, VERIFIER)  # should not raise
+
+
+def test_tc_insufficient_stake():
+    votes = []
+    for pk, sk in keys()[:2]:
+        t = signed_timeout(QC.genesis(), 5, pk, sk)
+        votes.append((pk, t.signature, 0))
+    with pytest.raises(TCRequiresQuorum):
+        TC(round=5, votes=votes).verify(COMMITTEE, VERIFIER)
+
+
+def test_tc_bad_signature():
+    votes = []
+    for pk, sk in keys()[:3]:
+        t = signed_timeout(QC.genesis(), 5, pk, sk)
+        votes.append((pk, t.signature, 0))
+    # entry 0 claims a different high_qc_round than it signed
+    votes[0] = (votes[0][0], votes[0][1], 3)
+    with pytest.raises(InvalidSignature):
+        TC(round=5, votes=votes).verify(COMMITTEE, VERIFIER)
+
+
+def test_genesis_identities():
+    assert Block.genesis().digest() == Block.genesis().digest()
+    assert QC.genesis().is_genesis()
+    assert not qc_for_block(chain(1)[0]).is_genesis()
+
+
+def test_vote_verify():
+    block = chain(1)[0]
+    pk, sk = keys()[0]
+    vote = signed_vote(block, pk, sk)
+    vote.verify(COMMITTEE, VERIFIER)
+    vote.signature = Signature(b"\x03" * 64)
+    with pytest.raises(InvalidSignature):
+        vote.verify(COMMITTEE, VERIFIER)
