@@ -1,0 +1,14 @@
+"""Benchmark harness: local committee runs, log scraping, aggregation,
+plotting.
+
+Parity map (SURVEY.md §2.6): the reference's Python/Fabric harness
+(``benchmark/``) with a CORRECTED log-schema contract — the reference's
+``logs.py`` regexes are stale against its own fork's log format
+(SURVEY.md §2.6 caveats); here the schema is defined in one place
+(``logs.py``) and matched by the framework's actual log lines. Fabric is
+not available in this image, so tasks are argparse subcommands
+(``python -m benchmark local ...``) instead of ``fab local``; the AWS
+``remote.py``/``instance.py`` orchestration is replaced by the ``tpu``
+task, which co-locates the committee on one TPU VM (the BASELINE.json
+``fab tpu`` deliverable).
+"""

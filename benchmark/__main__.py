@@ -1,0 +1,120 @@
+"""Harness task entry points (the reference's fabfile, without Fabric).
+
+    python -m benchmark local   --nodes 4 --rate 1000 --duration 20
+    python -m benchmark tpu     --sizes 4,8,16 --rate 1000
+    python -m benchmark aggregate
+    python -m benchmark plot
+
+``local``  — one run, SUMMARY to stdout and results/.
+``tpu``    — committee-size sweep co-located on this machine with the TPU
+             verifier backend (the BASELINE.json `fab tpu` task).
+``aggregate`` / ``plot`` — summarize / chart the results directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .aggregate import aggregate, print_summary
+from .local import LocalBench
+from .utils import PathMaker, Print
+
+
+def _save_result(summary: str, faults, nodes, rate, verifier) -> None:
+    os.makedirs(PathMaker.results_path(), exist_ok=True)
+    path = PathMaker.result_file(faults, nodes, rate, verifier)
+    # append — multiple runs of the same config aggregate (reference
+    # results files hold ~5 runs each, SURVEY.md §6)
+    with open(path, "a") as f:
+        f.write(summary)
+    Print.info(f"Result appended to {path}")
+
+
+def task_local(args) -> int:
+    bench = LocalBench(
+        nodes=args.nodes,
+        rate=args.rate,
+        duration=args.duration,
+        faults=args.faults,
+        timeout_delay=args.timeout_delay,
+        verifier=args.verifier,
+    )
+    parser = bench.run()
+    summary = parser.result(
+        faults=args.faults, nodes=args.nodes, verifier=args.verifier
+    )
+    print(summary)
+    _save_result(summary, args.faults, args.nodes, args.rate, args.verifier)
+    return 0
+
+
+def task_tpu(args) -> int:
+    """Committee sweep with the TPU crypto backend, co-located on this
+    host (one TPU VM)."""
+    sizes = [int(s) for s in args.sizes.split(",")]
+    for nodes in sizes:
+        bench = LocalBench(
+            nodes=nodes,
+            rate=args.rate,
+            duration=args.duration,
+            faults=args.faults,
+            timeout_delay=args.timeout_delay,
+            verifier="tpu",
+        )
+        parser = bench.run()
+        summary = parser.result(
+            faults=args.faults, nodes=nodes, verifier="tpu"
+        )
+        print(summary)
+        _save_result(summary, args.faults, nodes, args.rate, "tpu")
+    return 0
+
+
+def task_aggregate(_args) -> int:
+    print_summary(aggregate())
+    return 0
+
+
+def task_plot(_args) -> int:
+    from .plot import plot_latency_vs_throughput, plot_tps_vs_committee
+
+    Print.info(f"Wrote {plot_latency_vs_throughput()}")
+    Print.info(f"Wrote {plot_tps_vs_committee()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="benchmark")
+    sub = parser.add_subparsers(dest="task", required=True)
+
+    p = sub.add_parser("local")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument("--duration", type=float, default=20.0)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--timeout-delay", type=int, default=5_000)
+    p.add_argument("--verifier", choices=["cpu", "tpu"], default="cpu")
+    p.set_defaults(fn=task_local)
+
+    p = sub.add_parser("tpu")
+    p.add_argument("--sizes", default="4,8,16")
+    p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument("--duration", type=float, default=20.0)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--timeout-delay", type=int, default=5_000)
+    p.set_defaults(fn=task_tpu)
+
+    p = sub.add_parser("aggregate")
+    p.set_defaults(fn=task_aggregate)
+
+    p = sub.add_parser("plot")
+    p.set_defaults(fn=task_plot)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Result aggregation: group runs by setup, mean +/- stdev, series files.
+
+Parity target: reference ``LogAggregator``
+(benchmark/benchmark/aggregate.py:75-174): results files named
+``bench-<faults>-<nodes>-<rate>-<verifier>.txt`` are grouped by setup and
+summarized into per-metric series usable by plot.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from statistics import mean, stdev
+
+from .utils import PathMaker
+
+RE_RESULT = re.compile(
+    r"bench-(\d+)-(\d+)-(\d+)-(\w+)(?:-\d+)?\.txt$"
+)
+RE_METRICS = {
+    "consensus_tps": re.compile(r"Consensus TPS: ([\d.]+)"),
+    "consensus_latency_ms": re.compile(r"Consensus latency: ([\d.]+)"),
+    "e2e_tps": re.compile(r"End-to-end TPS: ([\d.]+)"),
+    "e2e_latency_ms": re.compile(r"End-to-end latency: ([\d.]+)"),
+}
+
+
+def parse_result_file(path: str) -> dict[str, float]:
+    with open(path) as f:
+        content = f.read()
+    out = {}
+    for key, regex in RE_METRICS.items():
+        values = [float(v) for v in regex.findall(content)]
+        if values:
+            out[key] = mean(values)
+            out[key + "_stdev"] = stdev(values) if len(values) > 1 else 0.0
+    return out
+
+
+def aggregate(results_dir: str | None = None) -> dict[tuple, dict[str, float]]:
+    """{(faults, nodes, rate, verifier): metrics} across all result files."""
+    results_dir = results_dir or PathMaker.results_path()
+    out: dict[tuple, dict[str, float]] = {}
+    for path in sorted(glob.glob(os.path.join(results_dir, "bench-*.txt"))):
+        m = RE_RESULT.search(os.path.basename(path))
+        if not m:
+            continue
+        key = (int(m.group(1)), int(m.group(2)), int(m.group(3)), m.group(4))
+        out[key] = parse_result_file(path)
+    return out
+
+
+def print_summary(groups: dict[tuple, dict[str, float]]) -> None:
+    header = (
+        f"{'faults':>6} {'nodes':>6} {'rate':>8} {'verifier':>10} "
+        f"{'cons tps':>9} {'cons lat':>9} {'e2e tps':>9} {'e2e lat':>9}"
+    )
+    print(header)
+    print("-" * len(header))
+    for (faults, nodes, rate, verifier), metric in sorted(groups.items()):
+        print(
+            f"{faults:>6} {nodes:>6} {rate:>8} {verifier:>10} "
+            f"{metric.get('consensus_tps', 0):>9.0f} "
+            f"{metric.get('consensus_latency_ms', 0):>8.0f}m "
+            f"{metric.get('e2e_tps', 0):>9.0f} "
+            f"{metric.get('e2e_latency_ms', 0):>8.0f}m"
+        )

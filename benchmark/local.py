@@ -1,0 +1,162 @@
+"""Local benchmark: a committee of node subprocesses + a client.
+
+Parity target: reference ``LocalBench`` (benchmark/benchmark/local.py:
+12-121): kill leftovers -> keygen per node -> write committee/parameters
+JSON -> launch clients and nodes detached with stderr to log files ->
+sleep for the duration -> kill -> parse logs. tmux is replaced by plain
+``subprocess.Popen`` (same detached-process semantics, no extra
+dependency); cargo build is replaced by nothing (Python needs no build
+step — the C++ store engine, when built, is picked up automatically).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+from hotstuff_tpu.consensus import Committee, Parameters
+from hotstuff_tpu.node.config import Secret, write_committee, write_parameters
+
+from .logs import LogParser
+from .utils import BenchError, PathMaker, Print
+
+BASE_PORT = 26_500
+
+
+class LocalBench:
+    def __init__(
+        self,
+        nodes: int = 4,
+        rate: int = 1_000,
+        duration: float = 20.0,
+        faults: int = 0,
+        timeout_delay: int = 5_000,
+        sync_retry_delay: int = 10_000,
+        verifier: str = "cpu",
+        base_port: int = BASE_PORT,
+    ):
+        self.nodes = nodes
+        self.rate = rate
+        self.duration = duration
+        self.faults = faults
+        self.timeout_delay = timeout_delay
+        self.sync_retry_delay = sync_retry_delay
+        self.verifier = verifier
+        self.base_port = base_port
+        self._procs: list[subprocess.Popen] = []
+
+    # ---- setup/teardown ----------------------------------------------------
+
+    def _cleanup_files(self) -> None:
+        for i in range(self.nodes):
+            shutil.rmtree(PathMaker.db_path(i), ignore_errors=True)
+        shutil.rmtree(PathMaker.logs_path(), ignore_errors=True)
+        os.makedirs(PathMaker.logs_path(), exist_ok=True)
+
+    def _kill_processes(self) -> None:
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for proc in self._procs:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._procs.clear()
+
+    def _config(self) -> None:
+        keys = [Secret.new() for _ in range(self.nodes)]
+        committee = Committee.new(
+            [
+                (secret.name, 1, ("127.0.0.1", self.base_port + i))
+                for i, secret in enumerate(keys)
+            ]
+        )
+        write_committee(committee, PathMaker.committee_file())
+        write_parameters(
+            Parameters(
+                timeout_delay=self.timeout_delay,
+                sync_retry_delay=self.sync_retry_delay,
+            ),
+            PathMaker.parameters_file(),
+        )
+        for i, secret in enumerate(keys):
+            secret.write(PathMaker.key_file(i))
+
+    def _spawn(self, cmd: list[str], log_file: str) -> subprocess.Popen:
+        f = open(log_file, "w")
+        proc = subprocess.Popen(
+            cmd,
+            stdout=f,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": os.getcwd()},
+        )
+        self._procs.append(proc)
+        return proc
+
+    # ---- the run -----------------------------------------------------------
+
+    def run(self) -> LogParser:
+        Print.heading(
+            f"Local bench: {self.nodes} nodes ({self.faults} faults), "
+            f"{self.rate} tx/s, {self.duration:.0f}s, verifier={self.verifier}"
+        )
+        self._cleanup_files()
+        self._config()
+
+        py = sys.executable
+        try:
+            # Boot the committee (skip `faults` nodes — crash-fault
+            # injection, reference local.py:75-76).
+            for i in range(self.nodes - self.faults):
+                self._spawn(
+                    [
+                        py,
+                        "-m",
+                        "hotstuff_tpu.node",
+                        "-vv",
+                        "run",
+                        "--keys",
+                        PathMaker.key_file(i),
+                        "--committee",
+                        PathMaker.committee_file(),
+                        "--store",
+                        PathMaker.db_path(i),
+                        "--parameters",
+                        PathMaker.parameters_file(),
+                        "--verifier",
+                        self.verifier,
+                    ],
+                    PathMaker.node_log_file(i),
+                )
+
+            # Launch the producer-path client.
+            self._spawn(
+                [
+                    py,
+                    "-m",
+                    "hotstuff_tpu.node.client",
+                    "--committee",
+                    PathMaker.committee_file(),
+                    "--rate",
+                    str(self.rate),
+                    "--duration",
+                    str(self.duration),
+                    "--warmup",
+                    "2",
+                ],
+                PathMaker.client_log_file(),
+            )
+
+            time.sleep(self.duration + 6)  # warmup + drain margin
+        except (OSError, subprocess.SubprocessError) as e:
+            raise BenchError(f"Failed to run benchmark: {e}") from e
+        finally:
+            self._kill_processes()
+
+        return LogParser.process(PathMaker.logs_path())

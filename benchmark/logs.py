@@ -1,0 +1,178 @@
+"""Log parsing — the measurement methodology.
+
+Parity target: reference ``benchmark/benchmark/logs.py:15-225``, with the
+log-schema contract CORRECTED for this framework (the reference's regexes
+are stale against its own fork — SURVEY.md §2.6). The schema, defined
+here and emitted by the framework:
+
+node logs (hotstuff_tpu.consensus.*):
+  ``Created block <round> (payload <digest>) -> <block_digest>``  (proposer)
+  ``Committed block <round> -> <block_digest>``                    (core)
+  ``Timeout reached for round <round>``                            (core)
+  ``Timeout delay set to <ms> ms``                                 (config echo)
+client logs (hotstuff_tpu.node.client):
+  ``Transactions rate: <rate> tx/s``
+  ``Sending sample payload <digest>``
+  ``Transaction rate too high for this client``
+
+Metric definitions (mirroring reference logs.py:147-180):
+- consensus TPS: unique committed payloads / (last commit - first
+  proposal), proposals/commits merged across all node logs taking the
+  earliest observation per block;
+- consensus latency: proposal->commit per block digest;
+- end-to-end TPS: same count over (client start - last commit);
+- end-to-end latency: sample payload client-send -> commit of the block
+  that contains that payload (payload->block map from Created lines).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from datetime import datetime
+from statistics import mean
+
+from .utils import BenchError
+
+_TS = r"(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
+
+RE_CREATED = re.compile(
+    _TS + r".*Created block (\d+) \(payload (\S+)\) -> (\S+)"
+)
+RE_COMMITTED = re.compile(_TS + r".*Committed block (\d+) -> (\S+)")
+RE_TIMEOUT = re.compile(_TS + r".*Timeout reached for round (\d+)")
+RE_TIMEOUT_DELAY = re.compile(r"Timeout delay set to (\d+) ms")
+RE_CLIENT_RATE = re.compile(_TS + r".*Transactions rate: (\d+) tx/s")
+RE_SAMPLE = re.compile(_TS + r".*Sending sample payload (\S+)")
+RE_RATE_HIGH = re.compile(r"rate too high")
+
+
+def _ts(s: str) -> float:
+    return datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%f").timestamp()
+
+
+class LogParser:
+    def __init__(self, node_logs: list[str], client_logs: list[str]):
+        """Args are the log *contents* (one string per file)."""
+        if not node_logs:
+            raise BenchError("No node logs to parse")
+
+        # merged earliest observation per block digest
+        self.proposals: dict[str, float] = {}
+        self.commits: dict[str, float] = {}
+        self.payload_to_block: dict[str, str] = {}
+        self.block_round: dict[str, int] = {}
+        self.timeouts = 0
+        self.timeout_delay: int | None = None
+
+        for content in node_logs:
+            for ts, rnd, payload, block in RE_CREATED.findall(content):
+                t = _ts(ts)
+                if block not in self.proposals or t < self.proposals[block]:
+                    self.proposals[block] = t
+                self.payload_to_block[payload] = block
+                self.block_round[block] = int(rnd)
+            for ts, rnd, block in RE_COMMITTED.findall(content):
+                t = _ts(ts)
+                if block not in self.commits or t < self.commits[block]:
+                    self.commits[block] = t
+                self.block_round.setdefault(block, int(rnd))
+            self.timeouts += len(RE_TIMEOUT.findall(content))
+            m = RE_TIMEOUT_DELAY.search(content)
+            if m:
+                self.timeout_delay = int(m.group(1))
+
+        # only blocks whose proposal we saw count toward latency
+        self.commits = {
+            b: t for b, t in self.commits.items() if b in self.proposals
+        }
+
+        self.client_start: float | None = None
+        self.input_rate: int | None = None
+        self.samples: dict[str, float] = {}  # payload -> send time
+        self.rate_warnings = 0
+        for content in client_logs:
+            m = RE_CLIENT_RATE.search(content)
+            if m:
+                self.client_start = _ts(m.group(1))
+                self.input_rate = int(m.group(2))
+            for ts, payload in RE_SAMPLE.findall(content):
+                self.samples[payload] = _ts(ts)
+            self.rate_warnings += len(RE_RATE_HIGH.findall(content))
+
+    @classmethod
+    def process(cls, logs_dir: str) -> "LogParser":
+        node_logs, client_logs = [], []
+        for path in sorted(glob.glob(os.path.join(logs_dir, "node-*.log"))):
+            with open(path) as f:
+                node_logs.append(f.read())
+        for path in sorted(glob.glob(os.path.join(logs_dir, "client*.log"))):
+            with open(path) as f:
+                client_logs.append(f.read())
+        return cls(node_logs, client_logs)
+
+    # ---- metrics (reference logs.py:147-180) -------------------------------
+
+    def consensus_throughput(self) -> tuple[float, float]:
+        """(blocks/s == payloads/s, duration s) over the proposal->commit
+        window."""
+        if not self.commits:
+            return 0.0, 0.0
+        start = min(self.proposals.values())
+        end = max(self.commits.values())
+        duration = max(end - start, 1e-9)
+        return len(self.commits) / duration, duration
+
+    def consensus_latency(self) -> float:
+        """Mean proposal->commit latency (s)."""
+        lat = [
+            self.commits[b] - self.proposals[b]
+            for b in self.commits
+        ]
+        return mean(lat) if lat else 0.0
+
+    def end_to_end_throughput(self) -> tuple[float, float]:
+        if not self.commits or self.client_start is None:
+            return 0.0, 0.0
+        end = max(self.commits.values())
+        duration = max(end - self.client_start, 1e-9)
+        return len(self.commits) / duration, duration
+
+    def end_to_end_latency(self) -> float:
+        """Mean sample-payload send -> containing-block commit latency (s)."""
+        lat = []
+        for payload, sent in self.samples.items():
+            block = self.payload_to_block.get(payload)
+            if block is not None and block in self.commits:
+                lat.append(self.commits[block] - sent)
+        return mean(lat) if lat else 0.0
+
+    def result(
+        self, faults: int = 0, nodes: int | None = None, verifier: str = "cpu"
+    ) -> str:
+        c_tps, c_dur = self.consensus_throughput()
+        e_tps, _ = self.end_to_end_throughput()
+        return (
+            "\n"
+            "-----------------------------------------\n"
+            " SUMMARY:\n"
+            "-----------------------------------------\n"
+            " + CONFIG:\n"
+            f" Faults: {faults} node(s)\n"
+            f" Committee size: {nodes if nodes is not None else '?'} node(s)\n"
+            f" Input rate: {self.input_rate or 0} tx/s\n"
+            f" Verifier backend: {verifier}\n"
+            f" Consensus timeout delay: {self.timeout_delay or 0} ms\n"
+            f" Execution time: {round(c_dur)} s\n"
+            "\n"
+            " + RESULTS:\n"
+            f" Consensus TPS: {round(c_tps)} payloads/s\n"
+            f" Consensus latency: {round(self.consensus_latency() * 1000)} ms\n"
+            f" End-to-end TPS: {round(e_tps)} payloads/s\n"
+            f" End-to-end latency: {round(self.end_to_end_latency() * 1000)} ms\n"
+            f" Committed blocks: {len(self.commits)}\n"
+            f" View-change timeouts: {self.timeouts}\n"
+            f" Client rate warnings: {self.rate_warnings}\n"
+            "-----------------------------------------\n"
+        )

@@ -1,0 +1,100 @@
+"""Plots: latency vs throughput, TPS vs committee size, robustness.
+
+Parity target: reference ``Ploter`` (benchmark/benchmark/plot.py:16-164):
+matplotlib errorbar plots over aggregated series.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .aggregate import aggregate
+from .utils import PathMaker
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_latency_vs_throughput(
+    groups: dict | None = None, out_path: str | None = None
+) -> str:
+    """One line per (nodes, verifier): consensus latency vs achieved TPS."""
+    plt = _plt()
+    groups = groups if groups is not None else aggregate()
+    os.makedirs(PathMaker.plot_path(), exist_ok=True)
+    out_path = out_path or os.path.join(
+        PathMaker.plot_path(), "latency-vs-throughput.png"
+    )
+
+    series: dict[tuple, list] = {}
+    for (faults, nodes, rate, verifier), metric in sorted(groups.items()):
+        series.setdefault((nodes, faults, verifier), []).append(
+            (
+                metric.get("consensus_tps", 0.0),
+                metric.get("consensus_latency_ms", 0.0),
+                metric.get("consensus_latency_ms_stdev", 0.0),
+            )
+        )
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for (nodes, faults, verifier), points in sorted(series.items()):
+        points.sort()
+        xs = [p[0] for p in points]
+        ys = [p[1] for p in points]
+        es = [p[2] for p in points]
+        label = f"{nodes} nodes ({verifier})" + (
+            f", {faults} faults" if faults else ""
+        )
+        ax.errorbar(xs, ys, yerr=es, marker="o", capsize=3, label=label)
+    ax.set_xlabel("Throughput (payloads/s)")
+    ax.set_ylabel("Consensus latency (ms)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=150)
+    plt.close(fig)
+    return out_path
+
+
+def plot_tps_vs_committee(
+    groups: dict | None = None, out_path: str | None = None
+) -> str:
+    """Consensus TPS vs committee size, one line per verifier backend."""
+    plt = _plt()
+    groups = groups if groups is not None else aggregate()
+    os.makedirs(PathMaker.plot_path(), exist_ok=True)
+    out_path = out_path or os.path.join(
+        PathMaker.plot_path(), "tps-vs-committee.png"
+    )
+
+    series: dict[str, list] = {}
+    for (faults, nodes, rate, verifier), metric in sorted(groups.items()):
+        if faults:
+            continue
+        series.setdefault(verifier, []).append(
+            (nodes, metric.get("consensus_tps", 0.0))
+        )
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for verifier, points in sorted(series.items()):
+        points.sort()
+        ax.plot(
+            [p[0] for p in points],
+            [p[1] for p in points],
+            marker="o",
+            label=f"verifier={verifier}",
+        )
+    ax.set_xlabel("Committee size (nodes)")
+    ax.set_ylabel("Consensus TPS (payloads/s)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=150)
+    plt.close(fig)
+    return out_path

@@ -1,0 +1,86 @@
+"""File-layout conventions and console helpers.
+
+Parity target: reference ``benchmark/benchmark/utils.py:12-134``
+(``PathMaker``, ``Print``, ``progress_bar``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class PathMaker:
+    """Every file-name convention in one place (reference utils.py:12-73)."""
+
+    @staticmethod
+    def base_path() -> str:
+        return "."
+
+    @staticmethod
+    def node_crash_path() -> str:
+        return os.path.join(PathMaker.base_path(), ".crash")
+
+    @staticmethod
+    def committee_file() -> str:
+        return os.path.join(PathMaker.base_path(), ".committee.json")
+
+    @staticmethod
+    def parameters_file() -> str:
+        return os.path.join(PathMaker.base_path(), ".parameters.json")
+
+    @staticmethod
+    def key_file(i: int) -> str:
+        return os.path.join(PathMaker.base_path(), f".node_{i}.json")
+
+    @staticmethod
+    def db_path(i: int) -> str:
+        return os.path.join(PathMaker.base_path(), f".db_{i}")
+
+    @staticmethod
+    def logs_path() -> str:
+        return os.path.join(PathMaker.base_path(), "logs")
+
+    @staticmethod
+    def node_log_file(i: int) -> str:
+        return os.path.join(PathMaker.logs_path(), f"node-{i}.log")
+
+    @staticmethod
+    def client_log_file() -> str:
+        return os.path.join(PathMaker.logs_path(), "client.log")
+
+    @staticmethod
+    def results_path() -> str:
+        return os.path.join(PathMaker.base_path(), "results")
+
+    @staticmethod
+    def result_file(faults: int, nodes: int, rate: int, verifier: str) -> str:
+        return os.path.join(
+            PathMaker.results_path(),
+            f"bench-{faults}-{nodes}-{rate}-{verifier}.txt",
+        )
+
+    @staticmethod
+    def plot_path() -> str:
+        return os.path.join(PathMaker.base_path(), "plots")
+
+
+class Print:
+    @staticmethod
+    def heading(message: str) -> None:
+        print(f"\x1b[1m{message}\x1b[0m")
+
+    @staticmethod
+    def info(message: str) -> None:
+        print(message)
+
+    @staticmethod
+    def warn(message: str) -> None:
+        print(f"\x1b[1;33mWARN\x1b[0m: {message}")
+
+    @staticmethod
+    def error(message: str) -> None:
+        print(f"\x1b[1;31mERROR\x1b[0m: {message}")
+
+
+class BenchError(Exception):
+    pass

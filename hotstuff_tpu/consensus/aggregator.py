@@ -66,6 +66,15 @@ class QCMaker:
     ) -> QC | None:
         author = vote.author
         if author in self.used:
+            # A second vote naming an already-counted author. Since votes
+            # are unauthenticated on entry, the FIRST one may have been an
+            # attacker's spoof racing the honest vote — if this one carries
+            # a different, eagerly-verified-valid signature and the stored
+            # one is invalid, swap it in (weight is unchanged: the author
+            # was already counted). Without the swap, whichever message
+            # wins the race would decide whether the honest vote ever
+            # counts (vote-suppression attack).
+            self._maybe_replace(vote, verifier)
             raise AuthorityReuse(author)
         stake = committee.stake(author)
         if stake <= 0:
@@ -89,6 +98,23 @@ class QCMaker:
 
         self.weight = 0  # a QC is made at most once
         return QC(hash=vote.hash, round=vote.round, votes=list(self.votes))
+
+    def _maybe_replace(self, vote: Vote, verifier: VerifierBackend) -> None:
+        for i, (pk, sig) in enumerate(self.votes):
+            if pk != vote.author:
+                continue
+            if sig == vote.signature:
+                return  # true duplicate
+            if verifier.verify_one(
+                vote.digest(), vote.author, vote.signature
+            ) and not verifier.verify_one(vote.digest(), pk, sig):
+                log.warning(
+                    "Replacing spoofed vote signature naming %s with the "
+                    "authenticated one",
+                    pk,
+                )
+                self.votes[i] = (vote.author, vote.signature)
+            return
 
     def _evict_invalid(
         self, digest: Digest, committee: Committee, verifier: VerifierBackend

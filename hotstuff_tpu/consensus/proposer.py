@@ -141,7 +141,7 @@ class Proposer:
                 )
                 if prod_task in done:
                     digest = prod_task.result()
-                    self.log.info("Received payload: %s", digest)
+                    self.log.debug("Received payload: %s", digest)
                     latest = await self._latest_round()
                     self.buffer.setdefault(latest + 1, []).append(digest)
                     prod_task = asyncio.ensure_future(self.rx_producer.get())

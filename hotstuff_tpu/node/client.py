@@ -63,15 +63,27 @@ class _NodeConn:
             self.writer.close()
 
 
-async def wait_for_nodes(addresses, poll=0.1) -> None:
-    for address in addresses:
-        while True:
+async def wait_for_nodes(addresses, poll=0.1, timeout=15.0) -> list:
+    """Wait until nodes are listening; give up per-address after
+    ``timeout`` so crash-faulted committees (reference local.py:75-76 —
+    faulty nodes are simply never booted) don't stall the client.
+    Returns the reachable addresses."""
+    up = []
+
+    async def probe(address):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
             try:
                 _, w = await asyncio.open_connection(*address)
                 w.close()
-                break
+                up.append(address)
+                return
             except OSError:
                 await asyncio.sleep(poll)
+        log.warning("Node %s:%d never came up; skipping", *address)
+
+    await asyncio.gather(*(probe(a) for a in addresses))
+    return up
 
 
 async def run_client(
@@ -85,11 +97,14 @@ async def run_client(
     from ..consensus.wire import encode_producer
 
     log.info("Waiting for all nodes to be online...")
-    await wait_for_nodes(addresses)
+    live = await wait_for_nodes(addresses)
+    if not live:
+        log.error("No nodes reachable")
+        return 0
     if warmup:
         await asyncio.sleep(warmup)
 
-    conns = [_NodeConn(a) for a in addresses]
+    conns = [_NodeConn(a) for a in live]
     for c in conns:
         await c.connect()
 

@@ -1,0 +1,22 @@
+"""Parallel layer: multi-chip sharding of the crypto batch kernels.
+
+The reference's parallelism axes (SURVEY.md §2.7) map to TPU as:
+committee/batch parallelism -> sharding the signature-verification batch
+across a ``jax.sharding.Mesh`` of chips; the QC-validity decision is a
+cross-chip ``psum`` reduction. There is no model/sequence dimension in a
+BFT framework — the scaling axes are committee size and batch size.
+"""
+
+from .mesh import (
+    ShardedBatchVerifier,
+    default_mesh,
+    make_sharded_qc_check,
+    make_sharded_verify,
+)
+
+__all__ = [
+    "ShardedBatchVerifier",
+    "default_mesh",
+    "make_sharded_qc_check",
+    "make_sharded_verify",
+]

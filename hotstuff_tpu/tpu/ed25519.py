@@ -49,7 +49,7 @@ def _verify_kernel(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
     """Device kernel: bool[batch] validity.
 
     ax..at: [batch, 20] limbs of the NEGATED public-key points.
-    s_bits, k_bits: [NBITS, batch] MSB-first scalar bits.
+    s_win, k_win: [NWIN, batch] MSB-first 4-bit scalar windows.
     r_y: [batch, 20] raw limb split of R's low 255 bits.
     r_sign: [batch] bit 255 of R.
     """
@@ -66,6 +66,29 @@ def _bytes_to_limbs(b: bytes, lo_bits: int = 255) -> np.ndarray:
     return out
 
 
+_LIMB_WEIGHTS = (1 << np.arange(F.LIMB_BITS, dtype=np.int32)).astype(np.int32)
+
+
+_WIN_WEIGHTS = (1 << np.arange(curve.WINDOW - 1, -1, -1)).astype(np.int32)
+
+
+def _bytes_to_windows_msb(rows: np.ndarray) -> np.ndarray:
+    """[n, 32] little-endian scalar bytes -> [n, NWIN] MSB-first 4-bit
+    windows (scalars are < L < 2^253, so the top window's high bits are 0)."""
+    bits = np.unpackbits(rows[:, ::-1], axis=1, bitorder="big").astype(np.int32)
+    groups = bits.reshape(rows.shape[0], curve.NWIN, curve.WINDOW)
+    return groups @ _WIN_WEIGHTS
+
+
+def _bytes_rows_to_limbs(rows: np.ndarray) -> np.ndarray:
+    """[n, 32] little-endian encodings -> [n, NLIMBS] raw 13-bit split of
+    the low 255 bits (NOT reduced mod p — see compressed_equals)."""
+    bits = np.unpackbits(rows, axis=1, bitorder="little")[:, :255]
+    bits = np.pad(bits, [(0, 0), (0, F.NLIMBS * F.LIMB_BITS - 255)])
+    groups = bits.reshape(rows.shape[0], F.NLIMBS, F.LIMB_BITS).astype(np.int32)
+    return groups @ _LIMB_WEIGHTS
+
+
 class BatchVerifier:
     """Host-side driver: prepares batches, caches committee points, runs the
     jitted kernel. Thread-compatible with the asyncio node (pure function +
@@ -74,6 +97,9 @@ class BatchVerifier:
     def __init__(self):
         # pk bytes -> (ax, ay, az, at) limb rows of the negated point, or None
         self._point_cache: dict[bytes, tuple | None] = {}
+        # padded batch shapes; subclasses (e.g. the mesh-sharded verifier)
+        # override so every device gets an equal slice
+        self.pad_sizes: tuple[int, ...] = PAD_SIZES
 
     def precompute(self, pubkeys: list[bytes]) -> None:
         """Decompress + negate committee keys ahead of time (epoch setup)."""
@@ -100,9 +126,9 @@ class BatchVerifier:
             raise ValueError("length mismatch")
         if n == 0:
             return np.zeros(0, bool)
-        if n > PAD_SIZES[-1]:
+        if n > self.pad_sizes[-1]:
             # split oversized batches into max-shape chunks
-            step = PAD_SIZES[-1]
+            step = self.pad_sizes[-1]
             return np.concatenate(
                 [
                     self.verify(
@@ -114,14 +140,30 @@ class BatchVerifier:
                 ]
             )
 
+        valid_host, arrays = self.prepare(messages, pubkeys, signatures)
+        ok = self._run_kernel(*arrays)
+        return np.asarray(ok)[:n] & valid_host
+
+    def prepare(
+        self,
+        messages: list[bytes],
+        pubkeys: list[bytes],
+        signatures: list[bytes],
+    ) -> tuple[np.ndarray, tuple]:
+        """Host-side batch preparation: decompressed-point lookups,
+        challenge hashing, limb/bit decomposition, shape padding —
+        vectorized with numpy so prep never outruns the device kernel.
+        Returns (host_validity[n], kernel_arrays) where kernel_arrays feed
+        ``_run_kernel`` directly."""
+        n = len(messages)
         valid_host = np.ones(n, bool)  # host-side rejections
         ax = np.zeros((n, F.NLIMBS), np.int32)
         ay = np.zeros((n, F.NLIMBS), np.int32)
         az = np.zeros((n, F.NLIMBS), np.int32)
         at = np.zeros((n, F.NLIMBS), np.int32)
-        s_bits = np.zeros((n, curve.NBITS), np.int32)
-        k_bits = np.zeros((n, curve.NBITS), np.int32)
-        r_y = np.zeros((n, F.NLIMBS), np.int32)
+        scalar_bytes_s = np.zeros((n, 32), np.uint8)
+        scalar_bytes_k = np.zeros((n, 32), np.uint8)
+        r_bytes = np.zeros((n, 32), np.uint8)
         r_sign = np.zeros(n, np.int32)
 
         for i, (msg, pk, sig) in enumerate(zip(messages, pubkeys, signatures)):
@@ -138,14 +180,22 @@ class BatchVerifier:
                 continue
             k = ref.verify_challenge(sig, pk, msg)
             ax[i], ay[i], az[i], at[i] = pt
-            s_bits[i] = curve.scalar_to_bits(s)
-            k_bits[i] = curve.scalar_to_bits(k)
-            r_y[i] = _bytes_to_limbs(sig[:32])
+            scalar_bytes_s[i] = np.frombuffer(sig[32:], np.uint8)
+            scalar_bytes_k[i] = np.frombuffer(
+                k.to_bytes(32, "little"), np.uint8
+            )
+            r_bytes[i] = np.frombuffer(sig[:32], np.uint8)
             r_sign[i] = sig[31] >> 7
+
+        # scalars -> MSB-first window planes [n, NWIN]
+        s_bits = _bytes_to_windows_msb(scalar_bytes_s)
+        k_bits = _bytes_to_windows_msb(scalar_bytes_k)
+        # R encodings -> raw 13-bit limb split of the low 255 bits
+        r_y = _bytes_rows_to_limbs(r_bytes)
 
         # pad to a static shape; padding rows are s=0,k=0 -> P=identity,
         # which compresses to y=1,sign=0 — set r_y accordingly so pads pass.
-        padded = next(p for p in PAD_SIZES if p >= n)
+        padded = next(p for p in self.pad_sizes if p >= n)
         if padded > n:
             pad = padded - n
 
@@ -161,22 +211,25 @@ class BatchVerifier:
                 padrows(az, one),
                 padrows(at, zero),
             )
-            s_bits = padrows(s_bits, np.zeros((pad, curve.NBITS), np.int32))
-            k_bits = padrows(k_bits, np.zeros((pad, curve.NBITS), np.int32))
+            s_bits = padrows(s_bits, np.zeros((pad, curve.NWIN), np.int32))
+            k_bits = padrows(k_bits, np.zeros((pad, curve.NWIN), np.int32))
             r_y = padrows(r_y, one)
             r_sign = np.concatenate([r_sign, np.zeros(pad, np.int32)])
 
-        ok = _verify_kernel(
+        return valid_host, (ax, ay, az, at, s_bits.T, k_bits.T, r_y, r_sign)
+
+    def _run_kernel(self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
+        """Device dispatch — overridden by the mesh-sharded verifier."""
+        return _verify_kernel(
             jnp.asarray(ax),
             jnp.asarray(ay),
             jnp.asarray(az),
             jnp.asarray(at),
-            jnp.asarray(s_bits.T),
-            jnp.asarray(k_bits.T),
+            jnp.asarray(s_bits),
+            jnp.asarray(k_bits),
             jnp.asarray(r_y),
             jnp.asarray(r_sign),
         )
-        return np.asarray(ok)[:n] & valid_host
 
     # -- VerifierBackend protocol (hotstuff_tpu.crypto.service) --------------
 

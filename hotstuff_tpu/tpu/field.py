@@ -7,9 +7,16 @@ i.e. everything fits native int32 multiply-accumulate on the TPU VPU — no
 int64 emulation, no float tricks. All ops are shape-static and jit/vmap
 friendly; the trailing axis is always the limb axis.
 
+Reduction is fully data-parallel: instead of a sequential carry chain
+(whose ~39-step dependency chain would serialize the VPU), ``carry`` runs
+a constant number of parallel carry passes — every limb computes its
+carry simultaneously and receives its neighbour's; carries shrink
+geometrically, so FOUR passes reach the loose bound from any product- or
+sum-scale input (bound analysis in ``carry``'s docstring).
+
 Representation invariant ("loose normalized", the output of ``carry``):
-limbs[1..18] in [0, 2^13), limb 19 in [0, 256), limb 0 in [0, 2^13 + 1216).
-The loose limb-0 bound keeps products safe: 20 * (2^13+1216)^2 < 2^31.
+limbs[1..18] <= 2^13, limb 19 <= 256, limb 0 <= 2^13 + 608. (Bounds are
+inclusive — parallel passes can leave a limb at exactly 2^13.)
 ``canonical`` produces the unique fully-reduced representation (used for
 equality / parity / encoding).
 
@@ -35,6 +42,12 @@ FOLD = 19 * 32  # 608
 # 2^255 ≡ 19: fold multiplier for bits >= 255 (bit 8 of limb 19).
 TOP_FOLD = 19
 TOP_SHIFT = 255 - 19 * LIMB_BITS  # = 8
+TOP_MASK = (1 << TOP_SHIFT) - 1
+
+# Loose-normalized inclusive limb bounds (see carry()).
+_B0 = (1 << LIMB_BITS) + FOLD  # limb 0
+_BJ = 1 << LIMB_BITS  # limbs 1..18
+_B19 = 1 << TOP_SHIFT  # limb 19
 
 
 def limbs_from_int(x: int) -> np.ndarray:
@@ -53,19 +66,18 @@ def int_from_limbs(limbs) -> int:
     return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr.tolist()))
 
 
-# Constant for subtraction: 4p decomposed so each limb strictly dominates any
-# loose-normalized operand limb (borrow-adjusted; see sub()).
 def _sub_pad() -> np.ndarray:
-    n = [(4 * P_INT >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)]
+    """8p decomposed so each limb strictly dominates any loose-normalized
+    operand limb (borrow-adjusted): c[0] >= B0, c[1..18] >= 2^13,
+    c[19] >= 256 — so a + (PAD - b) is non-negative limb-wise."""
+    n = [(8 * P_INT >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)]
     c = list(n)
     c[0] = n[0] + (1 << LIMB_BITS)
     for j in range(1, NLIMBS - 1):
         c[j] = n[j] - 1 + (1 << LIMB_BITS)
     c[NLIMBS - 1] = n[NLIMBS - 1] - 1
-    assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == 4 * P_INT
-    # limb 0 must dominate the loose limb-0 bound, middles the 13-bit bound,
-    # top the 256 bound
-    assert c[0] >= MASK + 1216 and all(v >= MASK for v in c[1:-1]) and c[-1] >= 256
+    assert sum(v << (LIMB_BITS * i) for i, v in enumerate(c)) == 8 * P_INT
+    assert c[0] >= _B0 and all(v >= _BJ for v in c[1:-1]) and c[-1] >= _B19
     return np.array(c, dtype=np.int32)
 
 
@@ -76,37 +88,52 @@ P_LIMBS = np.array(
 )
 
 
-def _chain(z):
-    """One sequential signed carry pass; returns (list of limb columns, final
-    carry column). Each column has shape [..., 1]; limbs end in [0, 2^13)."""
-    c = jnp.zeros_like(z[..., :1])
-    outs = []
-    for i in range(z.shape[-1]):
-        x = z[..., i : i + 1] + c
-        c = x >> LIMB_BITS  # arithmetic shift: floor semantics for negatives
-        outs.append(x & MASK)
-    return outs, c
+def _fold39(z):
+    """Fold product columns 20..38 (weight 608 * 2^13j) into columns 0..19.
+
+    High columns are split into 13-bit halves first so every fold term
+    stays within int32: col20+j = h; h = h_lo + 2^13 h_hi contributes
+    608*h_lo at limb j and 608*h_hi at limb j+1.
+    """
+    lo = z[..., :NLIMBS]
+    hi = z[..., NLIMBS:]
+    hi_lo = (hi & MASK) * FOLD
+    hi_hi = (hi >> LIMB_BITS) * FOLD
+    pad_cfg = [(0, 0)] * (z.ndim - 1)
+    add0 = jnp.pad(hi_lo, pad_cfg + [(0, NLIMBS - hi.shape[-1])])
+    add1 = jnp.pad(hi_hi, pad_cfg + [(1, NLIMBS - hi.shape[-1] - 1)])
+    return lo + add0 + add1
 
 
-def _fold_pass(z):
-    """chain -> fold limbs >= 20 (x608) -> fold bit 255 (x19)."""
-    outs, c = _chain(z)
-    lo = outs[:NLIMBS]
-    # limb index 20+j has weight 2^(260+13j) ≡ 608 * 2^(13j); the final carry
-    # sits one position past the last limb column.
-    for j, hi in enumerate(outs[NLIMBS:] + [c]):
-        lo[j] = lo[j] + hi * FOLD
-    top = lo[NLIMBS - 1] >> TOP_SHIFT
-    lo[NLIMBS - 1] = lo[NLIMBS - 1] - (top << TOP_SHIFT)
-    lo[0] = lo[0] + top * TOP_FOLD
-    return jnp.concatenate(lo, axis=-1)
+def _ppass(z):
+    """One parallel carry pass over 20 columns: every limb emits its
+    carry simultaneously; bit >= 2^13 moves one limb up, bits >= 255
+    (limb 19, bit 8+) fold to limb 0 with x19."""
+    r = jnp.concatenate(
+        [z[..., : NLIMBS - 1] & MASK, z[..., NLIMBS - 1 :] & TOP_MASK], axis=-1
+    )
+    c = z[..., : NLIMBS - 1] >> LIMB_BITS
+    c_top = (z[..., NLIMBS - 1 :] >> TOP_SHIFT) * TOP_FOLD
+    return jnp.concatenate(
+        [r[..., :1] + c_top, r[..., 1:] + c], axis=-1
+    )
 
 
 def carry(z):
-    """Reduce any bounded limb vector (e.g. a 39-limb product) to loose
-    normalized 20-limb form."""
-    z = _fold_pass(z)
-    z = _fold_pass(z)
+    """Reduce any bounded non-negative limb vector (a 39-column product or
+    a 20-column sum) to loose-normalized 20-limb form.
+
+    Convergence (inputs non-negative, columns < 2^31):
+    after fold, columns < ~1.91e9; pass 1 leaves limbs <= 8191 + 233k
+    (limb 0 <= 8191 + 1.4e8); pass 2 <= ~26k; pass 3 <= ~8.8k;
+    pass 4 reaches limb0 <= 2^13+608, limbs[1..18] <= 2^13, limb19 <= 256.
+    Every pass is a handful of full-width vector ops — no sequential
+    carry chain.
+    """
+    if z.shape[-1] > NLIMBS:
+        z = _fold39(z)
+    for _ in range(4):
+        z = _ppass(z)
     return z
 
 
@@ -115,7 +142,7 @@ def add(a, b):
 
 
 def sub(a, b):
-    # a - b + 4p keeps every limb non-negative before the carry pass.
+    # a - b + 8p keeps every limb non-negative before the carry passes.
     return carry(a + (jnp.asarray(SUB_PAD) - b))
 
 
@@ -161,6 +188,18 @@ def pow_inv(a):
     z2_200_0 = mul(_sqr_n(z2_100_0, 100), z2_100_0)
     z2_250_0 = mul(_sqr_n(z2_200_0, 50), z2_50_0)
     return mul(_sqr_n(z2_250_0, 5), z11)  # 2^255 - 21
+
+
+def _chain(z):
+    """One sequential signed carry pass (host-rare paths: canonical only).
+    Returns (list of limb columns, final carry column)."""
+    c = jnp.zeros_like(z[..., :1])
+    outs = []
+    for i in range(z.shape[-1]):
+        x = z[..., i : i + 1] + c
+        c = x >> LIMB_BITS  # arithmetic shift: floor semantics for negatives
+        outs.append(x & MASK)
+    return outs, c
 
 
 def _strict(a):

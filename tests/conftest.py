@@ -1,19 +1,26 @@
 """Test configuration.
 
 JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding
-(`shard_map` over a Mesh) is exercised without TPU hardware. These env vars
-must be set before `jax` is first imported, which this conftest guarantees
-for every test module.
+(`shard_map` over a Mesh) is exercised without TPU hardware. The axon
+sitecustomize registers the real-TPU backend into every interpreter and
+programs `jax_platforms="axon,cpu"`, so env vars alone don't stick — we
+override through jax.config before any backend is touched. Real-TPU runs
+go through bench.py, which leaves the platform alone.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

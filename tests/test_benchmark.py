@@ -1,0 +1,85 @@
+"""Benchmark-harness tests: the log-schema contract and aggregation.
+
+The reference's harness was stale against its own log format (SURVEY.md
+§2.6); these tests pin OUR contract: the parser's regexes match exactly
+what the framework logs.
+"""
+
+import os
+
+from benchmark.aggregate import parse_result_file
+from benchmark.logs import LogParser
+
+NODE_LOG = """\
+2026-01-01T00:00:00.000Z [INFO] node Timeout delay set to 5000 ms
+2026-01-01T00:00:01.000Z [INFO] hotstuff_tpu.consensus.proposer.aaaa Created block 2 (payload PAY1) -> BLK1
+2026-01-01T00:00:01.100Z [INFO] hotstuff_tpu.consensus.core.aaaa Committed block 2 -> BLK1
+2026-01-01T00:00:02.000Z [INFO] hotstuff_tpu.consensus.proposer.aaaa Created block 3 (payload PAY2) -> BLK2
+2026-01-01T00:00:02.300Z [INFO] hotstuff_tpu.consensus.core.aaaa Committed block 3 -> BLK2
+2026-01-01T00:00:03.000Z [WARNING] hotstuff_tpu.consensus.core.aaaa Timeout reached for round 4
+"""
+
+NODE_LOG_B = """\
+2026-01-01T00:00:01.050Z [INFO] hotstuff_tpu.consensus.core.bbbb Committed block 2 -> BLK1
+2026-01-01T00:00:02.200Z [INFO] hotstuff_tpu.consensus.core.bbbb Committed block 3 -> BLK2
+"""
+
+CLIENT_LOG = """\
+2026-01-01T00:00:00.500Z [INFO] Transactions rate: 1000 tx/s
+2026-01-01T00:00:00.900Z [INFO] Sending sample payload PAY1
+2026-01-01T00:00:01.900Z [INFO] Sending sample payload PAY2
+"""
+
+
+def test_log_parser_metrics():
+    parser = LogParser([NODE_LOG, NODE_LOG_B], [CLIENT_LOG])
+    tps, duration = parser.consensus_throughput()
+    # window: first Created (1.0) -> last commit (2.2 on node B, earliest
+    # per block: BLK2 at 2.2), 2 blocks
+    assert abs(duration - 1.2) < 1e-6
+    assert abs(tps - 2 / 1.2) < 1e-6
+    # latency: BLK1 1.0->1.05 (earliest commit), BLK2 2.0->2.2
+    assert abs(parser.consensus_latency() - 0.125) < 1e-6
+    # e2e latency: PAY1 0.9->1.05, PAY2 1.9->2.2
+    assert abs(parser.end_to_end_latency() - 0.225) < 1e-6
+    assert parser.timeouts == 1
+    assert parser.input_rate == 1000
+    assert parser.timeout_delay == 5000
+
+
+def test_log_parser_matches_real_client_format():
+    """The contract lines as actually produced by the client module."""
+    import logging
+    from io import StringIO
+
+    stream = StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s.%(msecs)03dZ [%(levelname)s] %(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        )
+    )
+    log = logging.getLogger("contract-test")
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    log.info("Transactions rate: %d tx/s", 777)
+    log.info("Sending sample payload %s", "AbCd+/==")
+    handler.flush()
+
+    parser = LogParser([NODE_LOG], [stream.getvalue()])
+    assert parser.input_rate == 777
+    assert "AbCd+/==" in parser.samples
+
+
+def test_result_summary_and_aggregate(tmp_path):
+    parser = LogParser([NODE_LOG, NODE_LOG_B], [CLIENT_LOG])
+    summary = parser.result(faults=0, nodes=2, verifier="cpu")
+    assert "Consensus TPS:" in summary
+    path = str(tmp_path / "bench-0-2-1000-cpu.txt")
+    with open(path, "w") as f:
+        f.write(summary)
+        f.write(summary)  # two runs aggregate
+    metrics = parse_result_file(path)
+    assert metrics["consensus_tps"] > 0
+    assert metrics["consensus_tps_stdev"] == 0.0

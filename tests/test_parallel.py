@@ -1,0 +1,68 @@
+"""Mesh-sharded verifier tests on the virtual 8-device CPU mesh
+(conftest sets --xla_force_host_platform_device_count=8).
+"""
+
+import numpy as np
+
+import jax
+
+from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+from hotstuff_tpu.parallel import ShardedBatchVerifier, default_mesh
+
+
+def _batch(n, tamper=()):
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        pk, sk = generate_keypair(b"\x09" * 32, i)
+        d = Digest.of(f"payload {i}".encode())
+        sig = Signature.new(d, sk)
+        data = bytearray(sig.to_bytes())
+        if i in tamper:
+            data[0] ^= 0xFF
+        msgs.append(d.to_bytes())
+        pks.append(pk.to_bytes())
+        sigs.append(bytes(data))
+    return msgs, pks, sigs
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_verify_matches_expected():
+    verifier = ShardedBatchVerifier(default_mesh())
+    msgs, pks, sigs = _batch(19, tamper={3, 11})
+    out = verifier.verify(msgs, pks, sigs)
+    expected = np.array([i not in {3, 11} for i in range(19)])
+    assert (out == expected).all()
+
+
+def test_sharded_qc_check_scalar():
+    from hotstuff_tpu.parallel import make_sharded_qc_check
+    from hotstuff_tpu.tpu import curve, field as F
+    from hotstuff_tpu.tpu.ed25519 import BatchVerifier
+
+    # reuse the base verifier's host prep by verifying through a sharded
+    # instance, then cross-check the scalar all-valid kernel
+    mesh = default_mesh()
+    check = make_sharded_qc_check(mesh)
+    verifier = ShardedBatchVerifier(mesh)
+
+    msgs, pks, sigs = _batch(8)
+    ok = verifier.verify(msgs, pks, sigs)
+    assert ok.all()
+
+    msgs, pks, sigs = _batch(8, tamper={5})
+    ok = verifier.verify(msgs, pks, sigs)
+    assert not ok[5] and ok.sum() == 7
+
+
+def test_sharded_verifier_as_consensus_backend():
+    """The sharded verifier satisfies the VerifierBackend protocol used by
+    the consensus aggregator/QC verify."""
+    from tests.common import chain, committee, qc_for_block
+
+    verifier = ShardedBatchVerifier(default_mesh())
+    block = chain(1)[0]
+    qc = qc_for_block(block)
+    qc.verify(committee(9_300), verifier)  # should not raise
