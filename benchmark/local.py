@@ -90,11 +90,16 @@ class LocalBench:
 
     def _spawn(self, cmd: list[str], log_file: str) -> subprocess.Popen:
         f = open(log_file, "w")
+        # repo root (the directory holding hotstuff_tpu/), NOT cwd — the
+        # harness must work from any working directory
+        import hotstuff_tpu
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(hotstuff_tpu.__file__)))
         proc = subprocess.Popen(
             cmd,
             stdout=f,
             stderr=subprocess.STDOUT,
-            env={**os.environ, "PYTHONPATH": os.getcwd()},
+            env={**os.environ, "PYTHONPATH": root},
         )
         self._procs.append(proc)
         return proc
@@ -149,6 +154,8 @@ class LocalBench:
                     str(self.duration),
                     "--warmup",
                     "2",
+                    "--faults",
+                    str(self.faults),
                 ],
                 PathMaker.client_log_file(),
             )

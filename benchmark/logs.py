@@ -6,7 +6,7 @@ are stale against its own fork — SURVEY.md §2.6). The schema, defined
 here and emitted by the framework:
 
 node logs (hotstuff_tpu.consensus.*):
-  ``Created block <round> (payload <digest>) -> <block_digest>``  (proposer)
+  ``Created block <round> (payloads <d1>,<d2>,...) -> <block_digest>`` (proposer)
   ``Committed block <round> -> <block_digest>``                    (core)
   ``Timeout reached for round <round>``                            (core)
   ``Timeout delay set to <ms> ms``                                 (config echo)
@@ -16,9 +16,10 @@ client logs (hotstuff_tpu.node.client):
   ``Transaction rate too high for this client``
 
 Metric definitions (mirroring reference logs.py:147-180):
-- consensus TPS: unique committed payloads / (last commit - first
-  proposal), proposals/commits merged across all node logs taking the
-  earliest observation per block;
+- consensus TPS: UNIQUE committed payload digests / (last commit -
+  first proposal), proposals/commits merged across all node logs taking
+  the earliest observation per block (deduplication means a payload
+  re-proposed after a view change is counted once);
 - consensus latency: proposal->commit per block digest;
 - end-to-end TPS: same count over (client start - last commit);
 - end-to-end latency: sample payload client-send -> commit of the block
@@ -38,7 +39,7 @@ from .utils import BenchError
 _TS = r"(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
 
 RE_CREATED = re.compile(
-    _TS + r".*Created block (\d+) \(payload (\S+)\) -> (\S+)"
+    _TS + r".*Created block (\d+) \(payloads (\S*)\) -> (\S+)"
 )
 RE_COMMITTED = re.compile(_TS + r".*Committed block (\d+) -> (\S+)")
 RE_TIMEOUT = re.compile(_TS + r".*Timeout reached for round (\d+)")
@@ -62,16 +63,20 @@ class LogParser:
         self.proposals: dict[str, float] = {}
         self.commits: dict[str, float] = {}
         self.payload_to_block: dict[str, str] = {}
+        self.block_payloads: dict[str, tuple[str, ...]] = {}
         self.block_round: dict[str, int] = {}
         self.timeouts = 0
         self.timeout_delay: int | None = None
 
         for content in node_logs:
-            for ts, rnd, payload, block in RE_CREATED.findall(content):
+            for ts, rnd, payloads, block in RE_CREATED.findall(content):
                 t = _ts(ts)
                 if block not in self.proposals or t < self.proposals[block]:
                     self.proposals[block] = t
-                self.payload_to_block[payload] = block
+                plist = tuple(p for p in payloads.split(",") if p)
+                self.block_payloads[block] = plist
+                for p in plist:
+                    self.payload_to_block[p] = block
                 self.block_round[block] = int(rnd)
             for ts, rnd, block in RE_COMMITTED.findall(content):
                 t = _ts(ts)
@@ -114,15 +119,23 @@ class LogParser:
 
     # ---- metrics (reference logs.py:147-180) -------------------------------
 
+    def committed_payloads(self) -> int:
+        """UNIQUE payload digests inside committed blocks (a payload
+        re-proposed after a view change is counted once)."""
+        unique: set[str] = set()
+        for block in self.commits:
+            unique.update(self.block_payloads.get(block, ()))
+        return len(unique)
+
     def consensus_throughput(self) -> tuple[float, float]:
-        """(blocks/s == payloads/s, duration s) over the proposal->commit
-        window."""
+        """(unique committed payloads/s, duration s) over the
+        proposal->commit window."""
         if not self.commits:
             return 0.0, 0.0
         start = min(self.proposals.values())
         end = max(self.commits.values())
         duration = max(end - start, 1e-9)
-        return len(self.commits) / duration, duration
+        return self.committed_payloads() / duration, duration
 
     def consensus_latency(self) -> float:
         """Mean proposal->commit latency (s)."""
@@ -137,7 +150,7 @@ class LogParser:
             return 0.0, 0.0
         end = max(self.commits.values())
         duration = max(end - self.client_start, 1e-9)
-        return len(self.commits) / duration, duration
+        return self.committed_payloads() / duration, duration
 
     def end_to_end_latency(self) -> float:
         """Mean sample-payload send -> containing-block commit latency (s)."""

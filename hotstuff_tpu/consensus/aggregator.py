@@ -57,12 +57,22 @@ class QCMaker:
         self.votes: list[tuple[PublicKey, Signature]] = []
         self.used: set[PublicKey] = set()
         self.suspect: set[PublicKey] = set()  # authors with an evicted sig
+        # True once the cell holds at least one signature that passed
+        # verification.  Cells that never earn this are evictable when the
+        # per-round digest-cell budget fills up (ADVICE r1: otherwise 8
+        # spoofed votes with random digests suppress honest votes for the
+        # real block all round).
+        self.verified = False
+        # Protected cells (the digest this node itself voted for) are
+        # never evicted.
+        self.protected = False
 
     def append(
         self,
         vote: Vote,
         committee: Committee,
         verifier: VerifierBackend,
+        stake: int | None = None,
     ) -> QC | None:
         author = vote.author
         if author in self.used:
@@ -76,7 +86,8 @@ class QCMaker:
             # counts (vote-suppression attack).
             self._maybe_replace(vote, verifier)
             raise AuthorityReuse(author)
-        stake = committee.stake(author)
+        if stake is None:
+            stake = committee.stake(author)
         if stake <= 0:
             raise UnknownAuthority(author)
         if author in self.suspect:
@@ -84,6 +95,7 @@ class QCMaker:
             # verify instead of trusting the deferred batch again
             if not verifier.verify_one(vote.digest(), author, vote.signature):
                 raise InvalidSignature(f"bad signature on vote {vote!r}")
+            self.verified = True
         self.used.add(author)
         self.votes.append((author, vote.signature))
         self.weight += stake
@@ -96,8 +108,24 @@ class QCMaker:
             if self.weight < committee.quorum_threshold():
                 return None  # keep accumulating
 
+        self.verified = True
         self.weight = 0  # a QC is made at most once
         return QC(hash=vote.hash, round=vote.round, votes=list(self.votes))
+
+    def check_any_valid(self, digest: Digest, verifier: VerifierBackend) -> bool:
+        """Verify the stored signatures against the cell's vote digest;
+        mark the cell verified (and report True) if any is genuine."""
+        if not self.votes:
+            return False
+        ok = verifier.verify_many(
+            [digest.to_bytes()] * len(self.votes),
+            [pk.to_bytes() for pk, _ in self.votes],
+            [sig.to_bytes() for _, sig in self.votes],
+        )
+        if any(ok):
+            self.verified = True
+            return True
+        return False
 
     def _maybe_replace(self, vote: Vote, verifier: VerifierBackend) -> None:
         for i, (pk, sig) in enumerate(self.votes):
@@ -134,6 +162,8 @@ class QCMaker:
                 self.suspect.add(pk)
         self.votes = [v for v, valid in zip(self.votes, ok) if valid]
         self.weight = sum(committee.stake(pk) for pk, _ in self.votes)
+        if self.votes:
+            self.verified = True  # survivors passed per-signature checks
 
 
 class TCMaker:
@@ -167,13 +197,39 @@ class TCMaker:
 
 
 class Aggregator:
-    """Per-round certificate accumulators with cleanup and DoS bounds."""
+    """Per-round certificate accumulators with cleanup and DoS bounds.
 
-    def __init__(self, committee: Committee, verifier: VerifierBackend):
+    ``self_key`` (the node's own public key) powers the liveness
+    guarantee: QC formation only ever matters for the block this node
+    itself voted for (voters address votes to the next leader, and the
+    leader votes for its own proposal), so the digest cell matching a
+    self-authored vote is admitted unconditionally — evicting a
+    non-protected cell at the cap — and can never be evicted itself.
+    """
+
+    def __init__(
+        self,
+        committee: Committee,
+        verifier: VerifierBackend,
+        self_key: PublicKey | None = None,
+    ):
         self.committee = committee
         self.verifier = verifier
+        self.self_key = self_key
         self.votes_aggregators: dict[Round, dict[Digest, QCMaker]] = {}
         self.timeouts_aggregators: dict[Round, TCMaker] = {}
+        # Authors whose valid signature already paid for an extra digest
+        # cell this round: a second paid cell from the same author is
+        # proof of equivocation and is refused (one Byzantine member must
+        # not consume the whole cell budget with validly-signed votes for
+        # random digests).
+        self.cell_payers: dict[Round, set[PublicKey]] = {}
+        # Verified votes that found the cell budget exhausted before this
+        # node's own (protected) cell existed — replayed into the
+        # protected cell when it is admitted, so a coalition racing its
+        # equivocations ahead of the real proposal can't permanently drop
+        # honest votes.  Bounded: one vote per author per round.
+        self.parked: dict[Round, dict[PublicKey, Vote]] = {}
 
     def add_vote(self, vote: Vote, current_round: Round | None = None) -> QC | None:
         if (
@@ -181,14 +237,129 @@ class Aggregator:
             and vote.round > current_round + ROUND_LOOKAHEAD
         ):
             raise AggregationBounds(f"vote for far-future round {vote.round}")
+        # Authority check before any aggregation state is created, so
+        # UnknownAuthority rejections cannot leave empty cells behind.
+        stake = self.committee.stake(vote.author)
+        if stake <= 0:
+            raise UnknownAuthority(vote.author)
         makers = self.votes_aggregators.setdefault(vote.round, {})
         digest = vote.digest()
-        if digest not in makers and len(makers) >= MAX_DIGEST_CELLS:
+        maker = makers.get(digest)
+        created = maker is None
+        if created:
+            maker = self._admit_cell(vote, digest, makers)
+        qc = maker.append(vote, self.committee, self.verifier, stake=stake)
+        if created and maker.protected:
+            qc = self._replay_parked(vote.round, digest, maker) or qc
+        return qc
+
+    def _park(self, vote: Vote) -> None:
+        """Remember a verified-but-unplaceable vote (one per author/round)."""
+        self.parked.setdefault(vote.round, {}).setdefault(vote.author, vote)
+
+    def _replay_parked(
+        self, round_: Round, digest: Digest, maker: QCMaker
+    ) -> QC | None:
+        """Feed parked votes matching the protected cell's digest back in."""
+        parked = self.parked.get(round_)
+        if not parked:
+            return None
+        qc = None
+        for author in [a for a, v in parked.items() if v.digest() == digest]:
+            vote = parked.pop(author)
+            try:
+                got = maker.append(vote, self.committee, self.verifier)
+            except ConsensusError:
+                continue
+            qc = got or qc
+        return qc
+
+    def _admit_cell(
+        self, vote: Vote, digest: Digest, makers: dict[Digest, QCMaker]
+    ) -> QCMaker:
+        """Create a new digest cell, charging for it when it isn't the first.
+
+        The honest case is exactly one digest per round, so every
+        ADDITIONAL cell must be paid for with a valid signature — spoofed
+        votes carrying random digests cost the attacker a rejected verify
+        instead of a slot in the cell budget (per-round vote-suppression
+        DoS otherwise: 8 garbage digests would exhaust MAX_DIGEST_CELLS
+        and honest votes for the real block would bounce).  Each author
+        may pay for at most one cell per round (a second one is proof of
+        equivocation), and a self-authored vote's cell is admitted
+        unconditionally and marked protected (see class docstring).
+        """
+        own = self.self_key is not None and vote.author == self.self_key
+        verified = False
+        if makers and not own:
+            if not self.verifier.verify_one(digest, vote.author, vote.signature):
+                raise InvalidSignature(f"bad signature on vote {vote!r}")
+            payers = self.cell_payers.setdefault(vote.round, set())
+            if vote.author in payers:
+                # One paid cell per author per round.  The vote itself is
+                # genuine though — votes may legitimately join an
+                # EXISTING cell regardless of the author's history — so
+                # park it for replay in case its digest gets the
+                # protected cell later.
+                self._park(vote)
+                raise AggregationBounds(
+                    f"second digest cell paid by {vote.author} in round "
+                    f"{vote.round} (vote parked)"
+                )
+            verified = True
+        if len(makers) >= MAX_DIGEST_CELLS and not self._evict_for(
+            vote, makers, own
+        ):
+            # Verified vote, but the budget is full of verified cells and
+            # this node's own (protected) cell doesn't exist yet: PARK it
+            # for replay when the protected cell lands — a coalition
+            # racing equivocations ahead of the real proposal must not
+            # permanently drop honest votes.
+            self._park(vote)
             raise AggregationBounds(
-                f"vote digest cell #{len(makers)} in round {vote.round}"
+                f"vote digest cell #{len(makers)} in round {vote.round} "
+                f"(vote parked)"
             )
-        maker = makers.setdefault(digest, QCMaker())
-        return maker.append(vote, self.committee, self.verifier)
+        if verified:
+            # charge the payer only once the cell actually exists
+            self.cell_payers.setdefault(vote.round, set()).add(vote.author)
+        maker = makers[digest] = QCMaker()
+        maker.verified = verified or own
+        maker.protected = own
+        return maker
+
+    def _evict_for(
+        self, vote: Vote, makers: dict[Digest, QCMaker], own: bool
+    ) -> bool:
+        """Make room at the cell cap; False if no cell may be evicted.
+
+        A cell is only evictable if NONE of its stored signatures verify —
+        an unverified cell may be the honest block's cell whose batch check
+        is simply deferred until quorum, and evicting it would destroy
+        accumulated honest votes (per-round liveness loss a Byzantine
+        insider could trigger at will).  Checking promotes genuinely
+        honest cells to verified, so each cell pays the check at most
+        once.  For a SELF-authored vote the cell must be admitted even if
+        every other cell is verified: all other cells are by definition
+        not this node's block, so evict any non-protected one.
+        """
+        victim = None
+        for d, m in makers.items():
+            if m.protected:
+                continue
+            if not m.verified and not m.check_any_valid(d, self.verifier):
+                victim = d
+                break
+        if victim is None and own:
+            victim = next(
+                (d for d, m in makers.items() if not m.protected), None
+            )
+        if victim is None:
+            return False
+        log.warning("Evicting digest cell to admit %s",
+                    "own-vote cell" if own else "a verified one")
+        del makers[victim]
+        return True
 
     def add_timeout(
         self, timeout: Timeout, current_round: Round | None = None
@@ -210,3 +381,7 @@ class Aggregator:
         self.timeouts_aggregators = {
             r: v for r, v in self.timeouts_aggregators.items() if r >= round_
         }
+        self.cell_payers = {
+            r: v for r, v in self.cell_payers.items() if r >= round_
+        }
+        self.parked = {r: v for r, v in self.parked.items() if r >= round_}

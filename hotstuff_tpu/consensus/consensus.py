@@ -159,7 +159,6 @@ class Consensus:
             rx_producer=tx_producer,
             rx_message=tx_proposer,
             tx_loopback=tx_loopback,
-            store=store,
         )
         self._tasks.append(self.proposer.spawn())
 

@@ -169,7 +169,7 @@ class Core:
         self.last_committed_round: Round = 0
         self.high_qc: QC = QC.genesis()
         self.timer = Timer(timeout_delay_ms)
-        self.aggregator = Aggregator(committee, verifier)
+        self.aggregator = Aggregator(committee, verifier, self_key=name)
         self.network = network if network is not None else SimpleSender()
         self.state_changed = False
         self._task: asyncio.Task | None = None
@@ -208,10 +208,13 @@ class Core:
         if latest == block.round:
             raw = await self.store.read(round_key(block.round))
             payloads = decode_payload_index(raw) if raw else []
-            if block.payload not in payloads:
-                payloads.append(block.payload)
+            known = set(payloads)
+            for p in block.payloads:
+                if p not in known:
+                    known.add(p)
+                    payloads.append(p)
         elif latest < block.round:
-            payloads = [block.payload]
+            payloads = list(block.payloads)
         else:
             self.log.warning("The block round is less than the last round")
             return
@@ -283,6 +286,15 @@ class Core:
         self.state_changed = True
         self.log.debug("Moved to round %d", self.round)
         self.aggregator.cleanup(self.round)
+        # Tell the proposer the chain moved on, so a make deferred while
+        # the payload buffer was empty can't later fire for a dead round
+        # (best effort — a full queue just means the signal is late).
+        try:
+            self.tx_proposer.put_nowait(
+                ProposerMessage.cleanup([self.round - 1])
+            )
+        except asyncio.QueueFull:
+            pass
 
     async def _generate_proposal(self, tc: TC | None) -> None:
         await self.tx_proposer.put(

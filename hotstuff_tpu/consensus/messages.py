@@ -29,6 +29,7 @@ from .config import Committee
 from .errors import (
     AuthorityReuse,
     InvalidSignature,
+    MalformedBlock,
     QCRequiresQuorum,
     TCRequiresQuorum,
     UnknownAuthority,
@@ -178,16 +179,29 @@ def timeout_digest(round_: Round, high_qc_round: Round) -> Digest:
     return Digest(sha512_trunc(_round_le(round_) + _round_le(high_qc_round)))
 
 
+# Protocol-level cap on payload digests per block, enforced on RECEIVED
+# blocks in Block.verify (a Byzantine leader must not be able to ship a
+# frame-limit-sized block and wedge every node's store path).  The honest
+# proposer uses the same constant when draining its buffer.
+MAX_BLOCK_PAYLOADS = 512
+
+
 @dataclass
 class Block:
-    """A proposal: extends the block certified by ``qc`` with one payload
-    digest (the fork's single-digest payload, reference messages.rs:16-23)."""
+    """A proposal: extends the block certified by ``qc`` with a list of
+    payload digests.
+
+    The reference fork narrowed upstream's ``Vec<Digest>`` payload to a
+    single digest (reference messages.rs:16-23); this build restores the
+    vector form — one round can drain the whole producer backlog, so
+    committed-payload throughput is round-rate x batch-size instead of
+    being capped at one payload per round."""
 
     qc: QC = field(default_factory=QC)
     tc: TC | None = None
     author: PublicKey = field(default_factory=PublicKey)
     round: Round = 0
-    payload: Digest = field(default_factory=Digest)
+    payloads: tuple[Digest, ...] = ()
     signature: Signature = field(default_factory=Signature)
 
     @classmethod
@@ -203,7 +217,7 @@ class Block:
             sha512_trunc(
                 self.author.to_bytes()
                 + _round_le(self.round)
-                + self.payload.to_bytes()
+                + b"".join(p.to_bytes() for p in self.payloads)
                 + self.qc.hash.to_bytes()
             )
         )
@@ -211,6 +225,8 @@ class Block:
     def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
         if committee.stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
+        if len(self.payloads) > MAX_BLOCK_PAYLOADS:
+            raise MalformedBlock(self.digest())
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad author signature on block {self}")
         if not self.qc.is_genesis():
@@ -224,7 +240,10 @@ class Block:
         if self.tc is not None:
             self.tc.encode(enc)
         enc.raw(self.author.to_bytes()).u64(self.round)
-        enc.raw(self.payload.to_bytes()).raw(self.signature.to_bytes())
+        enc.u32(len(self.payloads))
+        for p in self.payloads:
+            enc.raw(p.to_bytes())
+        enc.raw(self.signature.to_bytes())
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Block":
@@ -232,9 +251,12 @@ class Block:
         tc = TC.decode(dec) if dec.flag() else None
         author = PublicKey(dec.raw(PublicKey.SIZE))
         rnd = dec.u64()
-        payload = Digest(dec.raw(Digest.SIZE))
+        n = dec.u32()
+        payloads = tuple(Digest(dec.raw(Digest.SIZE)) for _ in range(n))
         sig = Signature(dec.raw(Signature.SIZE))
-        return cls(qc=qc, tc=tc, author=author, round=rnd, payload=payload, signature=sig)
+        return cls(
+            qc=qc, tc=tc, author=author, round=rnd, payloads=payloads, signature=sig
+        )
 
     def serialize(self) -> bytes:
         enc = Encoder()
@@ -251,7 +273,7 @@ class Block:
     def __repr__(self) -> str:
         return (
             f"{self.digest()}: B({self.author}, {self.round}, "
-            f"{self.qc!r}, {self.payload})"
+            f"{self.qc!r}, {len(self.payloads)} payloads)"
         )
 
     def __str__(self) -> str:

@@ -9,10 +9,23 @@ then the payload.
 from __future__ import annotations
 
 import asyncio
+import socket
 import struct
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
+
+
+def set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on the stream's socket.  Consensus frames are
+    kilobyte-scale and latency-bound; letting the kernel coalesce them
+    costs milliseconds per protocol hop."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # e.g. unix sockets in tests
+            pass
 
 
 class FramingError(Exception):

@@ -14,7 +14,7 @@ import asyncio
 import logging
 from typing import Protocol
 
-from .framing import FramingError, read_frame, send_frame
+from .framing import FramingError, read_frame, send_frame, set_nodelay
 
 log = logging.getLogger(__name__)
 
@@ -57,6 +57,7 @@ class Receiver:
         self, reader: asyncio.StreamReader, stream_writer: asyncio.StreamWriter
     ) -> None:
         peer = stream_writer.get_extra_info("peername")
+        set_nodelay(stream_writer)
         log.debug("Incoming connection from %s", peer)
         self._writers.add(stream_writer)
         writer = Writer(stream_writer)

@@ -25,7 +25,7 @@ import logging
 import random
 from collections import deque
 
-from .framing import FramingError, read_frame, send_frame
+from .framing import FramingError, read_frame, send_frame, set_nodelay
 
 log = logging.getLogger(__name__)
 
@@ -59,6 +59,7 @@ class _Connection:
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, RETRY_CAP_S)
                 continue
+            set_nodelay(writer)
             log.debug("Outgoing connection established with %s", self.address)
             delay = RETRY_DELAY_S  # reset on success
             try:

@@ -13,7 +13,7 @@ import asyncio
 import logging
 import random
 
-from .framing import read_frame, send_frame
+from .framing import read_frame, send_frame, set_nodelay
 
 log = logging.getLogger(__name__)
 
@@ -40,6 +40,7 @@ class _Connection:
             except OSError as e:
                 log.warning("Failed to connect to %s: %s", self.address, e)
                 continue  # drop this message, wait for the next
+            set_nodelay(writer)
             log.debug("Outgoing connection established with %s", self.address)
             sink = asyncio.get_running_loop().create_task(self._sink_acks(reader))
             try:

@@ -24,7 +24,7 @@ import logging
 import sys
 
 from ..crypto import Digest
-from ..network.framing import read_frame, send_frame
+from ..network.framing import read_frame, send_frame, set_nodelay
 from .config import read_committee
 
 log = logging.getLogger("client")
@@ -43,6 +43,7 @@ class _NodeConn:
 
     async def connect(self) -> None:
         reader, self.writer = await asyncio.open_connection(*self.address)
+        set_nodelay(self.writer)
         self._sink = asyncio.ensure_future(self._drain(reader))
 
     @staticmethod
@@ -63,26 +64,48 @@ class _NodeConn:
             self.writer.close()
 
 
-async def wait_for_nodes(addresses, poll=0.1, timeout=15.0) -> list:
+async def wait_for_nodes(
+    addresses, poll=0.1, timeout=15.0, expect_faults=0
+) -> list:
     """Wait until nodes are listening; give up per-address after
     ``timeout`` so crash-faulted committees (reference local.py:75-76 —
     faulty nodes are simply never booted) don't stall the client.
-    Returns the reachable addresses."""
+    ``expect_faults`` is the number of nodes known to never boot: the
+    early-start grace below only kicks in once the expected live count
+    is reached, so a merely slow-booting node in a fault-free committee
+    still gets the full ``timeout``.  Returns the reachable addresses."""
     up = []
+    loop = asyncio.get_running_loop()
+    last_join = loop.time()
 
     async def probe(address):
-        deadline = asyncio.get_running_loop().time() + timeout
-        while asyncio.get_running_loop().time() < deadline:
+        nonlocal last_join
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
             try:
                 _, w = await asyncio.open_connection(*address)
                 w.close()
                 up.append(address)
+                last_join = loop.time()
                 return
             except OSError:
                 await asyncio.sleep(poll)
-        log.warning("Node %s:%d never came up; skipping", *address)
 
-    await asyncio.gather(*(probe(a) for a in addresses))
+    # Don't let crash-faulted (never-booted) nodes consume the whole
+    # benchmark window: once the expected live count is up and no new
+    # node has joined for ``grace`` seconds, start without the rest.
+    grace = 2.0
+    expected_live = max(1, len(addresses) - expect_faults)
+    tasks = [asyncio.ensure_future(probe(a)) for a in addresses]
+    deadline = loop.time() + timeout
+    while loop.time() < deadline and not all(t.done() for t in tasks):
+        await asyncio.sleep(poll)
+        if len(up) >= expected_live and loop.time() - last_join > grace:
+            break
+    for t, a in zip(tasks, addresses):
+        if not t.done():
+            t.cancel()
+            log.warning("Node %s:%d never came up; skipping", *a)
     return up
 
 
@@ -91,13 +114,14 @@ async def run_client(
     rate: int,
     duration: float,
     warmup: float = 0.0,
+    expect_faults: int = 0,
 ) -> int:
     """Send ``rate`` producer payloads/s for ``duration`` seconds to every
     node. Returns the number of payloads sent (per node)."""
     from ..consensus.wire import encode_producer
 
     log.info("Waiting for all nodes to be online...")
-    live = await wait_for_nodes(addresses)
+    live = await wait_for_nodes(addresses, expect_faults=expect_faults)
     if not live:
         log.error("No nodes reachable")
         return 0
@@ -158,6 +182,12 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--warmup", type=float, default=2.0, help="settle time after ports open"
     )
+    parser.add_argument(
+        "--faults",
+        type=int,
+        default=0,
+        help="nodes known to be crash-faulted (never booted)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=1)
     args = parser.parse_args(argv)
 
@@ -170,7 +200,13 @@ def main(argv=None) -> int:
     committee = read_committee(args.committee)
     addresses = [a.address for a in committee.authorities.values()]
     sent = asyncio.run(
-        run_client(addresses, args.rate, args.duration, args.warmup)
+        run_client(
+            addresses,
+            args.rate,
+            args.duration,
+            args.warmup,
+            expect_faults=args.faults,
+        )
     )
     log.info("Sent %d payloads", sent)
     return 0

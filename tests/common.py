@@ -74,7 +74,7 @@ def signed_block(
         tc=tc,
         author=author,
         round=round_,
-        payload=payload if payload is not None else Digest(),
+        payloads=(payload,) if payload is not None else (),
     )
     block.signature = Signature.new(block.digest(), secret)
     return block

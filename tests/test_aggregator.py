@@ -110,11 +110,228 @@ def test_aggregation_bounds(aggregator):
     with pytest.raises(AggregationBounds):
         aggregator.add_vote(far, current_round=1)
 
-    # distinct-digest flood within one round
+    # ONE author cannot flood cells: the second cell paid by the same
+    # author is refused as proof of equivocation (cell #0 is free, the
+    # first paid cell lands, the next one trips the bound)
     with pytest.raises(AggregationBounds):
-        for i in range(MAX_DIGEST_CELLS + 1):
+        for i in range(3):
             v = Vote(hash=Digest.random(), round=5, author=pk)
+            v.signature = Signature.new(v.digest(), sk)
             aggregator.add_vote(v, current_round=5)
+    assert len(aggregator.votes_aggregators[5]) == 2
+
+
+def test_distinct_author_cell_flood_capped():
+    """Even distinct authors (large Byzantine coalition) are capped at
+    MAX_DIGEST_CELLS cells per round."""
+    from hotstuff_tpu.consensus.aggregator import (
+        MAX_DIGEST_CELLS,
+        AggregationBounds,
+    )
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus import Vote
+
+    n = MAX_DIGEST_CELLS + 4
+    agg = Aggregator(committee(9_200, n=n), CpuVerifier())
+    pairs = keys(n)
+    with pytest.raises(AggregationBounds):
+        for pk, sk in pairs:
+            v = Vote(hash=Digest.random(), round=5, author=pk)
+            v.signature = Signature.new(v.digest(), sk)
+            agg.add_vote(v, current_round=5)
+    assert len(agg.votes_aggregators[5]) == MAX_DIGEST_CELLS
+
+
+def test_self_vote_cell_admitted_through_full_verified_budget():
+    """Liveness guarantee: even when a Byzantine coalition fills every
+    cell with validly-signed equivocations BEFORE the honest votes
+    arrive, the cell for the digest this node itself votes for is
+    admitted (evicting a coalition cell), is never evicted, and the QC
+    for the real block still forms."""
+    from hotstuff_tpu.consensus.aggregator import MAX_DIGEST_CELLS
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus import Vote
+
+    n = 16
+    pairs = keys(n)
+    self_pk, self_sk = pairs[0]
+    agg = Aggregator(committee(9_300, n=n), CpuVerifier(), self_key=self_pk)
+
+    block = chain(1, n=n)[0]
+    # coalition pre-fills the whole budget with verified equivocations
+    for pk, sk in pairs[1 : MAX_DIGEST_CELLS + 1]:
+        v = Vote(hash=Digest.random(), round=block.round, author=pk)
+        v.signature = Signature.new(v.digest(), sk)
+        agg.add_vote(v)
+    assert len(agg.votes_aggregators[block.round]) == MAX_DIGEST_CELLS
+
+    # the node's own vote for the real block is admitted regardless
+    assert agg.add_vote(signed_vote(block, self_pk, self_sk)) is None
+    makers = agg.votes_aggregators[block.round]
+    assert len(makers) == MAX_DIGEST_CELLS
+    own_cell = makers[signed_vote(block, self_pk, self_sk).digest()]
+    assert own_cell.protected and own_cell.verified
+
+    # enough honest votes arrive for the real block: QC forms
+    quorum = agg.committee.quorum_threshold()
+    qc = None
+    for pk, sk in pairs[1:quorum]:
+        qc = agg.add_vote(signed_vote(block, pk, sk))
+    assert qc is not None
+    assert qc.hash == block.digest()
+    qc.verify(agg.committee, agg.verifier)
+
+
+def test_spoof_digest_flood_cannot_suppress_honest_votes(aggregator):
+    """ADVICE r1 (medium): unsigned votes with random digests must not
+    exhaust the digest-cell budget — honest votes for the real block must
+    still form a QC after a garbage flood."""
+    from hotstuff_tpu.consensus import InvalidSignature
+    from hotstuff_tpu.consensus.aggregator import MAX_DIGEST_CELLS
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus import Vote
+
+    block = chain(1)[0]
+    pairs = keys()
+    pk = pairs[0][0]
+
+    # attacker floods round 1 with garbage-signed votes for random digests;
+    # the first one lands as cell #0 for free, the rest are rejected at
+    # the door with a failed eager verify
+    garbage = Vote(hash=Digest.random(), round=1, author=pk)
+    assert aggregator.add_vote(garbage, current_round=1) is None
+    for _ in range(2 * MAX_DIGEST_CELLS):
+        with pytest.raises(InvalidSignature):
+            aggregator.add_vote(
+                Vote(hash=Digest.random(), round=1, author=pk), current_round=1
+            )
+    assert len(aggregator.votes_aggregators[1]) == 1  # only the free cell
+
+    # honest votes for the real block still form a QC
+    assert aggregator.add_vote(signed_vote(block, *pairs[1])) is None
+    assert aggregator.add_vote(signed_vote(block, *pairs[2])) is None
+    qc = aggregator.add_vote(signed_vote(block, *pairs[3]))
+    assert qc is not None
+    qc.verify(aggregator.committee, aggregator.verifier)
+
+
+def test_verified_cell_evicts_unverified_spam_at_cap():
+    """When the cell budget is full and contains an unverified spam cell,
+    a verified vote for a new digest evicts the spam cell instead of
+    bouncing."""
+    from hotstuff_tpu.consensus.aggregator import MAX_DIGEST_CELLS
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus import Vote
+
+    n = MAX_DIGEST_CELLS + 4
+    agg = Aggregator(committee(9_400, n=n), CpuVerifier())
+    pairs = keys(n)
+    # one free unverified spam cell (garbage signature, spoofed author)
+    agg.add_vote(Vote(hash=Digest.random(), round=1, author=pairs[0][0]))
+    # fill the rest of the budget with verified cells from distinct authors
+    for pk, sk in pairs[1:MAX_DIGEST_CELLS]:
+        v = Vote(hash=Digest.random(), round=1, author=pk)
+        v.signature = Signature.new(v.digest(), sk)
+        agg.add_vote(v)
+    assert len(agg.votes_aggregators[1]) == MAX_DIGEST_CELLS
+    # a fresh VERIFIED digest evicts the spam cell, not the vote
+    block = chain(1, n=n)[0]
+    assert agg.add_vote(signed_vote(block, *pairs[MAX_DIGEST_CELLS])) is None
+    makers = agg.votes_aggregators[1]
+    assert len(makers) == MAX_DIGEST_CELLS
+    assert all(m.verified for m in makers.values())
+
+
+def test_byzantine_equivocation_cannot_evict_honest_subquorum_cell(aggregator):
+    """A Byzantine insider signing votes for many random digests must not
+    evict the honest block's cell while its (deferred-verify) sub-quorum
+    votes are accumulating — eviction requires proving the victim cell
+    holds no genuine signature."""
+    from hotstuff_tpu.consensus.aggregator import MAX_DIGEST_CELLS, AggregationBounds
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus import Vote
+
+    block = chain(1)[0]
+    pairs = keys()
+    byz_pk, byz_sk = pairs[0]
+
+    # honest cell #0 accumulates 2 of 3 needed votes (unverified: batch
+    # check is deferred until quorum)
+    assert aggregator.add_vote(signed_vote(block, *pairs[1])) is None
+    assert aggregator.add_vote(signed_vote(block, *pairs[2])) is None
+
+    # Byzantine member floods validly-signed votes for random digests
+    with pytest.raises(AggregationBounds):
+        for _ in range(MAX_DIGEST_CELLS + 2):
+            v = Vote(hash=Digest.random(), round=block.round, author=byz_pk)
+            v.signature = Signature.new(v.digest(), byz_sk)
+            aggregator.add_vote(v)
+
+    # the honest cell survived with both its votes
+    vote_digest = signed_vote(block, *pairs[1]).digest()
+    honest_cell = aggregator.votes_aggregators[block.round][vote_digest]
+    assert len(honest_cell.votes) == 2
+    # ...and the third vote forms the QC
+    qc = aggregator.add_vote(signed_vote(block, *pairs[3]))
+    assert qc is not None
+    assert qc.hash == block.digest()
+    qc.verify(aggregator.committee, aggregator.verifier)
+
+
+def test_parked_votes_replay_when_protected_cell_lands():
+    """Coalition races its equivocations ahead of the real proposal and
+    fills every cell verified BEFORE any honest vote arrives: honest
+    votes are parked (not dropped) and replayed once the node's own
+    protected cell is admitted — the QC still forms."""
+    from hotstuff_tpu.consensus.aggregator import (
+        MAX_DIGEST_CELLS,
+        AggregationBounds,
+    )
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus import Vote
+
+    n = 16
+    pairs = keys(n)
+    self_pk, self_sk = pairs[0]
+    agg = Aggregator(committee(9_500, n=n), CpuVerifier(), self_key=self_pk)
+    block = chain(1, n=n)[0]
+
+    # coalition pre-fills the whole budget before any honest vote
+    for pk, sk in pairs[1 : MAX_DIGEST_CELLS + 1]:
+        v = Vote(hash=Digest.random(), round=block.round, author=pk)
+        v.signature = Signature.new(v.digest(), sk)
+        agg.add_vote(v)
+    assert len(agg.votes_aggregators[block.round]) == MAX_DIGEST_CELLS
+
+    # honest votes arrive next: each bounces but is PARKED
+    quorum = agg.committee.quorum_threshold()
+    honest = pairs[1:quorum]  # coalition members also vote for the real block
+    for pk, sk in honest:
+        with pytest.raises(AggregationBounds):
+            agg.add_vote(signed_vote(block, pk, sk))
+    assert len(agg.parked[block.round]) == len(honest)
+
+    # the node's own vote admits the protected cell and replays the lot:
+    # self + (quorum-1) parked = quorum -> the QC forms right here
+    qc = agg.add_vote(signed_vote(block, self_pk, self_sk))
+    assert qc is not None
+    assert qc.hash == block.digest()
+    qc.verify(agg.committee, agg.verifier)
+    assert not agg.parked[block.round]
+
+
+def test_unknown_authority_leaves_no_cell(aggregator):
+    """ADVICE r1: UnknownAuthority rejections must not leave empty cells."""
+    from hotstuff_tpu.consensus import UnknownAuthority
+    from hotstuff_tpu.crypto import generate_keypair
+
+    block = chain(1)[0]
+    outsider_pk, outsider_sk = generate_keypair(b"\x55" * 32, 99)
+    vote = signed_vote(block, outsider_pk, outsider_sk)
+    with pytest.raises(UnknownAuthority):
+        aggregator.add_vote(vote)
+    makers = aggregator.votes_aggregators.get(vote.round, {})
+    assert vote.digest() not in makers
 
 
 def test_add_timeout_forms_tc(aggregator):

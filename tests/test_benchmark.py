@@ -12,9 +12,9 @@ from benchmark.logs import LogParser
 
 NODE_LOG = """\
 2026-01-01T00:00:00.000Z [INFO] node Timeout delay set to 5000 ms
-2026-01-01T00:00:01.000Z [INFO] hotstuff_tpu.consensus.proposer.aaaa Created block 2 (payload PAY1) -> BLK1
+2026-01-01T00:00:01.000Z [INFO] hotstuff_tpu.consensus.proposer.aaaa Created block 2 (payloads PAY1) -> BLK1
 2026-01-01T00:00:01.100Z [INFO] hotstuff_tpu.consensus.core.aaaa Committed block 2 -> BLK1
-2026-01-01T00:00:02.000Z [INFO] hotstuff_tpu.consensus.proposer.aaaa Created block 3 (payload PAY2) -> BLK2
+2026-01-01T00:00:02.000Z [INFO] hotstuff_tpu.consensus.proposer.aaaa Created block 3 (payloads PAY2,PAY3) -> BLK2
 2026-01-01T00:00:02.300Z [INFO] hotstuff_tpu.consensus.core.aaaa Committed block 3 -> BLK2
 2026-01-01T00:00:03.000Z [WARNING] hotstuff_tpu.consensus.core.aaaa Timeout reached for round 4
 """
@@ -35,9 +35,9 @@ def test_log_parser_metrics():
     parser = LogParser([NODE_LOG, NODE_LOG_B], [CLIENT_LOG])
     tps, duration = parser.consensus_throughput()
     # window: first Created (1.0) -> last commit (2.2 on node B, earliest
-    # per block: BLK2 at 2.2), 2 blocks
+    # per block: BLK2 at 2.2), 3 unique payloads over 2 blocks
     assert abs(duration - 1.2) < 1e-6
-    assert abs(tps - 2 / 1.2) < 1e-6
+    assert abs(tps - 3 / 1.2) < 1e-6
     # latency: BLK1 1.0->1.05 (earliest commit), BLK2 2.0->2.2
     assert abs(parser.consensus_latency() - 0.125) < 1e-6
     # e2e latency: PAY1 0.9->1.05, PAY2 1.9->2.2
@@ -83,3 +83,27 @@ def test_result_summary_and_aggregate(tmp_path):
     metrics = parse_result_file(path)
     assert metrics["consensus_tps"] > 0
     assert metrics["consensus_tps_stdev"] == 0.0
+
+
+def test_created_line_contract_matches_proposer_emitter():
+    """Anti-drift: format the proposer's actual Created log template and
+    feed it through the parser (benchmark/logs.py contract)."""
+    line = (
+        "2026-01-01T00:00:01.000Z [INFO] hotstuff_tpu.consensus.proposer.x "
+        + "Created block %d (payloads %s) -> %s"
+        % (7, ",".join(["dA+/b==", "c99x=="]), "BLOCKD==")
+    )
+    commit = (
+        "2026-01-01T00:00:01.500Z [INFO] hotstuff_tpu.consensus.core.x "
+        "Committed block 7 -> BLOCKD=="
+    )
+    parser = LogParser([line + "\n" + commit + "\n"], [])
+    assert parser.block_payloads["BLOCKD=="] == ("dA+/b==", "c99x==")
+    assert parser.committed_payloads() == 2
+    # empty-payload blocks parse too
+    line0 = (
+        "2026-01-01T00:00:02.000Z [INFO] hotstuff_tpu.consensus.proposer.x "
+        "Created block 8 (payloads ) -> EMPTY=="
+    )
+    parser = LogParser([line0 + "\n"], [])
+    assert parser.block_payloads["EMPTY=="] == ()

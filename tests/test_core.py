@@ -100,10 +100,14 @@ async def test_generate_proposal_after_quorum(tmp_path):
     for pk, sk in keys()[:3]:
         await h.rx_message.put((TAG_VOTE, signed_vote(b1, pk, sk)))
 
-    message: ProposerMessage = await asyncio.wait_for(
-        h.tx_proposer.get(), timeout=2.0
-    )
-    assert message.kind == ProposerMessage.MAKE
+    # round advances also emit best-effort Cleanup pings; the MAKE is the
+    # first non-cleanup message
+    while True:
+        message: ProposerMessage = await asyncio.wait_for(
+            h.tx_proposer.get(), timeout=2.0
+        )
+        if message.kind == ProposerMessage.MAKE:
+            break
     assert message.round == 2
     assert message.qc.hash == b1.digest()
     assert message.qc.round == 1
