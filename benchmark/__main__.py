@@ -72,6 +72,48 @@ def task_tpu(args) -> int:
     return 0
 
 
+def task_remote_lifecycle(args) -> int:
+    from .instance import TpuVmManager
+    from .remote import RemoteBench
+    from .settings import Settings
+
+    settings = Settings.load(args.settings)
+    mgr = TpuVmManager(settings)
+    if args.lifecycle == "create":
+        mgr.create_instances()
+    elif args.lifecycle == "destroy":
+        mgr.terminate_instances()
+    elif args.lifecycle == "start":
+        mgr.start_instances()
+    elif args.lifecycle == "stop":
+        mgr.stop_instances()
+    elif args.lifecycle == "info":
+        mgr.print_info()
+    elif args.lifecycle == "install":
+        RemoteBench(settings).install()
+    elif args.lifecycle == "update":
+        RemoteBench(settings).update()
+    elif args.lifecycle == "remote-kill":
+        RemoteBench(settings).kill()
+    return 0
+
+
+def task_remote_bench(args) -> int:
+    from .remote import RemoteBench
+    from .settings import Settings
+
+    bench = RemoteBench(Settings.load(args.settings))
+    bench.run(
+        nodes_list=[int(s) for s in args.sizes.split(",")],
+        rate_list=[int(s) for s in args.rates.split(",")],
+        duration=args.duration,
+        runs=args.runs,
+        faults=args.faults,
+        verifier=args.verifier,
+    )
+    return 0
+
+
 def task_aggregate(_args) -> int:
     print_summary(aggregate())
     return 0
@@ -95,7 +137,7 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=20.0)
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
-    p.add_argument("--verifier", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
     p.set_defaults(fn=task_local)
 
     p = sub.add_parser("tpu")
@@ -111,6 +153,28 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("plot")
     p.set_defaults(fn=task_plot)
+
+    # remote/cluster tasks (reference fabfile.py create/destroy/install/
+    # start/stop/info/remote, re-targeted at TPU VMs — benchmark/remote.py)
+    for name in ("create", "destroy", "start", "stop", "info", "install",
+                 "update", "remote-kill"):
+        p = sub.add_parser(name)
+        p.add_argument("--settings", default="settings.json")
+        p.set_defaults(fn=task_remote_lifecycle, lifecycle=name)
+
+    p = sub.add_parser("remote")
+    p.add_argument("--settings", default="settings.json")
+    p.add_argument("--sizes", default="4,8")
+    p.add_argument("--rates", default="1000")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--runs", type=int, default=1)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument(
+        "--verifier",
+        choices=["cpu", "tpu", "tpu-sharded"],
+        default="tpu",
+    )
+    p.set_defaults(fn=task_remote_bench)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,126 @@
+"""TPU-VM lifecycle management.
+
+Parity target: reference ``benchmark/benchmark/instance.py:18-278``
+(boto3 EC2 create/terminate/start/stop/list per region), re-targeted at
+Cloud TPU VMs through the ``gcloud`` CLI: no cloud SDK is required in
+the image, and every operation is one auditable subprocess command.
+
+All shelling-out goes through an injectable ``runner`` callable so the
+orchestration logic is unit-testable without network access (the
+reference's boto3 calls are untestable without AWS and indeed have no
+tests)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+
+from .settings import Settings
+from .utils import BenchError, Print
+
+
+def _default_runner(cmd: list[str], timeout: int = 600) -> str:
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout
+    )
+    if proc.returncode != 0:
+        raise BenchError(
+            f"command {' '.join(cmd)} failed: {proc.stderr.strip()}"
+        )
+    return proc.stdout
+
+
+class TpuVmManager:
+    """Create / delete / start / stop / list the testbed's TPU VMs."""
+
+    def __init__(self, settings: Settings, runner=None):
+        self.settings = settings
+        self.run = runner if runner is not None else _default_runner
+
+    def _name(self, i: int) -> str:
+        return f"{self.settings.testbed}-{i}"
+
+    def _base(self) -> list[str]:
+        return [
+            "gcloud",
+            "compute",
+            "tpus",
+            "tpu-vm",
+        ]
+
+    def create_instances(self) -> None:
+        s = self.settings
+        for i in range(s.instances):
+            Print.info(f"Creating {self._name(i)} ({s.accelerator_type})")
+            self.run(
+                self._base()
+                + [
+                    "create",
+                    self._name(i),
+                    f"--zone={s.zone}",
+                    f"--accelerator-type={s.accelerator_type}",
+                    f"--version={s.runtime_version}",
+                ]
+            )
+
+    def terminate_instances(self) -> None:
+        for i in range(self.settings.instances):
+            Print.info(f"Deleting {self._name(i)}")
+            self.run(
+                self._base()
+                + [
+                    "delete",
+                    self._name(i),
+                    f"--zone={self.settings.zone}",
+                    "--quiet",
+                ]
+            )
+
+    def start_instances(self) -> None:
+        for i in range(self.settings.instances):
+            self.run(
+                self._base()
+                + ["start", self._name(i), f"--zone={self.settings.zone}"]
+            )
+
+    def stop_instances(self) -> None:
+        for i in range(self.settings.instances):
+            self.run(
+                self._base()
+                + ["stop", self._name(i), f"--zone={self.settings.zone}"]
+            )
+
+    def hosts(self) -> list[dict]:
+        """[{name, internal_ip, external_ip, state}] for the testbed."""
+        out = self.run(
+            self._base()
+            + [
+                "list",
+                f"--zone={self.settings.zone}",
+                "--format=json",
+            ]
+        )
+        info = []
+        for item in json.loads(out or "[]"):
+            name = item.get("name", "").rsplit("/", 1)[-1]
+            if not name.startswith(self.settings.testbed + "-"):
+                continue
+            endpoints = item.get("networkEndpoints", [{}])
+            info.append(
+                {
+                    "name": name,
+                    "internal_ip": endpoints[0].get("ipAddress", ""),
+                    "external_ip": endpoints[0]
+                    .get("accessConfig", {})
+                    .get("externalIp", ""),
+                    "state": item.get("state", "UNKNOWN"),
+                }
+            )
+        return sorted(info, key=lambda d: d["name"])
+
+    def print_info(self) -> None:
+        for h in self.hosts():
+            Print.info(
+                f"{h['name']}: {h['state']} internal={h['internal_ip']} "
+                f"external={h['external_ip']}"
+            )

@@ -99,7 +99,16 @@ class LocalBench:
             cmd,
             stdout=f,
             stderr=subprocess.STDOUT,
-            env={**os.environ, "PYTHONPATH": root},
+            env={
+                **os.environ,
+                "PYTHONPATH": root,
+                # share one persistent XLA compilation cache across the
+                # committee: with --verifier tpu every node would
+                # otherwise pay the full first-compile (~40 s) per run
+                "JAX_COMPILATION_CACHE_DIR": os.path.join(
+                    root, ".jax_cache"
+                ),
+            },
         )
         self._procs.append(proc)
         return proc

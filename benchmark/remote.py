@@ -1,0 +1,221 @@
+"""Remote benchmark orchestration over a TPU-VM testbed.
+
+Parity target: reference ``benchmark/benchmark/remote.py:58-298`` — the
+Fabric/SSH driver that installs the stack on every instance, uploads
+per-node configs, launches clients and nodes in detached remote
+sessions, downloads logs, and sweeps (nodes x rate x runs).  Here the
+transport is an injectable runner over the ``gcloud compute tpus
+tpu-vm ssh/scp`` CLI (see benchmark/instance.py for why), and what gets
+installed is this repo's Python/JAX stack instead of a cargo build.
+
+The orchestration logic (command sequences, config fan-out, sweep
+shape, results-file discipline ``bench-FAULTS-NODES-RATE-VERIFIER.txt``)
+is unit-tested with a recording fake runner — the reference's harness
+has no tests at all.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from .instance import TpuVmManager, _default_runner
+from .logs import LogParser
+from .settings import Settings
+from .utils import BenchError, PathMaker, Print
+
+
+class RemoteBench:
+    def __init__(self, settings: Settings, runner=None):
+        self.settings = settings
+        self.run = runner if runner is not None else _default_runner
+        self.manager = TpuVmManager(settings, runner=self.run)
+
+    # ---- transport ---------------------------------------------------------
+
+    def _ssh(self, name: str, command: str, timeout: int = 600) -> str:
+        s = self.settings
+        return self.run(
+            list(s.ssh_command)
+            + [name, f"--zone={s.zone}", f"--command={command}"],
+            timeout,
+        )
+
+    def _upload(self, name: str, local: str, remote: str) -> None:
+        s = self.settings
+        self.run(
+            list(s.scp_command)
+            + [local, f"{name}:{remote}", f"--zone={s.zone}"]
+        )
+
+    def _download(self, name: str, remote: str, local: str) -> None:
+        s = self.settings
+        self.run(
+            list(s.scp_command)
+            + [f"{name}:{remote}", local, f"--zone={s.zone}"]
+        )
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def install(self) -> None:
+        """Clone the repo on every instance (reference remote.py:58-83)."""
+        s = self.settings
+        cmd = (
+            f"git clone {s.repo_url} || "
+            f"(cd {s.repo_name} && git fetch origin)"
+        )
+        for h in self.manager.hosts():
+            Print.info(f"Installing on {h['name']}")
+            self._ssh(h["name"], cmd)
+
+    def update(self) -> None:
+        """git pull to the configured branch (reference remote.py:117-128)."""
+        s = self.settings
+        cmd = (
+            f"cd {s.repo_name} && git fetch origin && "
+            f"git checkout {s.branch} && git reset --hard origin/{s.branch}"
+        )
+        for h in self.manager.hosts():
+            Print.info(f"Updating {h['name']}")
+            self._ssh(h["name"], cmd)
+
+    def kill(self) -> None:
+        """Stop any running nodes/clients (reference's tmux kill)."""
+        for h in self.manager.hosts():
+            self._ssh(
+                h["name"],
+                "pkill -f hotstuff_tpu.node || true",
+            )
+
+    # ---- one benchmark run -------------------------------------------------
+
+    def _config(self, hosts: list[dict], nodes: int) -> None:
+        """Generate keys/committee locally, upload per-node files
+        (reference remote.py:130-175)."""
+        from hotstuff_tpu.consensus import Committee, Parameters
+        from hotstuff_tpu.node.config import (
+            Secret,
+            write_committee,
+            write_parameters,
+        )
+
+        keys = [Secret.new() for _ in range(nodes)]
+        committee = Committee.new(
+            [
+                (
+                    secret.name,
+                    1,
+                    (
+                        hosts[i % len(hosts)]["internal_ip"],
+                        self.settings.consensus_port,
+                    ),
+                )
+                for i, secret in enumerate(keys)
+            ]
+        )
+        write_committee(committee, PathMaker.committee_file())
+        write_parameters(Parameters(), PathMaker.parameters_file())
+        for i, secret in enumerate(keys):
+            secret.write(PathMaker.key_file(i))
+        for i in range(nodes):
+            host = hosts[i % len(hosts)]
+            repo = self.settings.repo_name
+            self._upload(
+                host["name"], PathMaker.committee_file(), f"{repo}/"
+            )
+            self._upload(
+                host["name"], PathMaker.parameters_file(), f"{repo}/"
+            )
+            self._upload(host["name"], PathMaker.key_file(i), f"{repo}/")
+
+    def _run_single(
+        self,
+        hosts: list[dict],
+        nodes: int,
+        rate: int,
+        duration: float,
+        faults: int,
+        verifier: str,
+    ) -> None:
+        """Boot clients then nodes in detached remote shells
+        (reference remote.py:177-219)."""
+        repo = self.settings.repo_name
+        for i in range(nodes - faults):
+            host = hosts[i % len(hosts)]
+            node_cmd = (
+                f"cd {repo} && nohup python3 -m hotstuff_tpu.node -vv run"
+                f" --keys {PathMaker.key_file(i)}"
+                f" --committee {PathMaker.committee_file()}"
+                f" --store .db_{i}"
+                f" --parameters {PathMaker.parameters_file()}"
+                f" --verifier {verifier}"
+                f" > logs/node-{i}.log 2>&1 &"
+            )
+            self._ssh(host["name"], f"mkdir -p {repo}/logs && {node_cmd}")
+        client_host = hosts[0]
+        client_cmd = (
+            f"cd {repo} && nohup python3 -m hotstuff_tpu.node.client"
+            f" --committee {PathMaker.committee_file()}"
+            f" --rate {rate} --duration {duration} --faults {faults}"
+            f" > logs/client.log 2>&1 &"
+        )
+        self._ssh(client_host["name"], client_cmd)
+
+    def _logs(self, hosts: list[dict], nodes: int, faults: int) -> LogParser:
+        """Download every log and parse (reference remote.py:221-235)."""
+        os.makedirs(PathMaker.logs_dir(), exist_ok=True)
+        repo = self.settings.repo_name
+        for i in range(nodes - faults):
+            host = hosts[i % len(hosts)]
+            self._download(
+                host["name"],
+                f"{repo}/logs/node-{i}.log",
+                os.path.join(PathMaker.logs_dir(), f"node-{i}.log"),
+            )
+        self._download(
+            hosts[0]["name"],
+            f"{repo}/logs/client.log",
+            os.path.join(PathMaker.logs_dir(), "client.log"),
+        )
+        return LogParser.process(PathMaker.logs_dir())
+
+    def run(
+        self,
+        nodes_list: list[int],
+        rate_list: list[int],
+        duration: float = 30.0,
+        runs: int = 1,
+        faults: int = 0,
+        verifier: str = "tpu",
+    ) -> None:
+        """The sweep driver (reference remote.py:237-298)."""
+        hosts = [h for h in self.manager.hosts() if h["state"] == "READY"]
+        if not hosts:
+            raise BenchError("no READY instances in the testbed")
+        import time
+
+        for nodes in nodes_list:
+            for rate in rate_list:
+                for attempt in range(runs):
+                    Print.heading(
+                        f"Remote bench: {nodes} nodes, {rate}/s, "
+                        f"run {attempt + 1}/{runs}"
+                    )
+                    self.kill()
+                    self._config(hosts, nodes)
+                    self._run_single(
+                        hosts, nodes, rate, duration, faults, verifier
+                    )
+                    time.sleep(duration + 20)
+                    self.kill()
+                    parser = self._logs(hosts, nodes, faults)
+                    summary = parser.result(
+                        faults=faults, nodes=nodes, verifier=verifier
+                    )
+                    print(summary)
+                    path = PathMaker.result_file(faults, nodes, rate, verifier)
+                    with open(path, "a") as f:
+                        f.write(summary)
+
+
+__all__ = ["RemoteBench", "TpuVmManager", "Settings", "subprocess"]

@@ -1,0 +1,87 @@
+"""Remote testbed settings.
+
+Parity target: reference ``benchmark/settings.py:8-66`` +
+``settings.json`` — testbed name, SSH key, ports, repo, instance
+topology.  Cloud-TPU-VM flavored instead of EC2: instances are
+``gcloud compute tpus tpu-vm`` resources addressed by zone, and nodes
+co-locate one committee member per TPU-VM worker.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+class SettingsError(Exception):
+    pass
+
+
+@dataclass
+class Settings:
+    testbed: str
+    key_path: str
+    consensus_port: int
+    repo_name: str
+    repo_url: str
+    branch: str
+    # TPU-VM topology
+    zone: str
+    accelerator_type: str
+    runtime_version: str
+    instances: int
+    ssh_command: list[str] = field(
+        default_factory=lambda: ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
+    )
+    scp_command: list[str] = field(
+        default_factory=lambda: ["gcloud", "compute", "tpus", "tpu-vm", "scp"]
+    )
+
+    @classmethod
+    def load(cls, path: str = "settings.json") -> "Settings":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise SettingsError(f"cannot read settings at {path}: {e}") from e
+        try:
+            return cls(
+                testbed=data["testbed"],
+                key_path=data["key"]["path"],
+                consensus_port=int(data["ports"]["consensus"]),
+                repo_name=data["repo"]["name"],
+                repo_url=data["repo"]["url"],
+                branch=data["repo"]["branch"],
+                zone=data["instances"]["zone"],
+                accelerator_type=data["instances"]["accelerator_type"],
+                runtime_version=data["instances"]["runtime_version"],
+                instances=int(data["instances"]["count"]),
+                ssh_command=data.get(
+                    "ssh_command",
+                    ["gcloud", "compute", "tpus", "tpu-vm", "ssh"],
+                ),
+                scp_command=data.get(
+                    "scp_command",
+                    ["gcloud", "compute", "tpus", "tpu-vm", "scp"],
+                ),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise SettingsError(f"malformed settings: missing {e}") from e
+
+
+DEFAULT_SETTINGS = {
+    "testbed": "hotstuff-tpu",
+    "key": {"name": "gcp", "path": "~/.ssh/google_compute_engine"},
+    "ports": {"consensus": 8000},
+    "repo": {
+        "name": "hotstuff_tpu",
+        "url": "https://example.com/hotstuff-tpu.git",
+        "branch": "main",
+    },
+    "instances": {
+        "zone": "us-central2-b",
+        "accelerator_type": "v5litepod-8",
+        "runtime_version": "tpu-ubuntu2204-base",
+        "count": 4,
+    },
+}

@@ -1,0 +1,281 @@
+"""BLS12-381 curve groups G1 (over Fq) and G2 (over Fq2).
+
+G1: y^2 = x^3 + 4         over Fq,  order R, cofactor H1.
+G2: y^2 = x^3 + 4(u + 1)  over Fq2, order R, cofactor H2.
+
+Affine coordinates with Python big ints — clarity over speed; this is
+the CPU reference backend (the hot path for consensus is Ed25519 on the
+TPU; BLS is the threshold variant, BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .fields import P, R, Fq2, fq_inv
+
+H1 = 0x396C8C005555E1568C00AAAB0000AAAB
+H2 = 0x5D543A95414E7F1091D50792876A202CD91DE4547085ABAA68A205B2E5A7DDFA628F1CB4D9E82EF21537E293A6691AE1616EC6E786F0C70CF1C38E31C7238E5
+
+# Standard generators (RFC 9380 / zkcrypto test vectors).
+G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+G2_X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+G2_Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+
+class G1Point:
+    """Affine G1 point; None coordinates = identity."""
+
+    __slots__ = ("x", "y", "inf")
+
+    def __init__(self, x: int = 0, y: int = 0, inf: bool = False):
+        self.x = x % P
+        self.y = y % P
+        self.inf = inf
+
+    @classmethod
+    def identity(cls) -> "G1Point":
+        return cls(0, 0, True)
+
+    @classmethod
+    def generator(cls) -> "G1Point":
+        return cls(G1_X, G1_Y)
+
+    def is_on_curve(self) -> bool:
+        if self.inf:
+            return True
+        return (self.y * self.y - self.x**3 - 4) % P == 0
+
+    def __eq__(self, o: object) -> bool:
+        if not isinstance(o, G1Point):
+            return NotImplemented
+        if self.inf or o.inf:
+            return self.inf == o.inf
+        return self.x == o.x and self.y == o.y
+
+    def __hash__(self) -> int:
+        return hash((self.x, self.y, self.inf))
+
+    def __neg__(self) -> "G1Point":
+        if self.inf:
+            return self
+        return G1Point(self.x, -self.y)
+
+    def __add__(self, o: "G1Point") -> "G1Point":
+        if self.inf:
+            return o
+        if o.inf:
+            return self
+        if self.x == o.x:
+            if (self.y + o.y) % P == 0:
+                return G1Point.identity()
+            # doubling
+            lam = (3 * self.x * self.x) * fq_inv(2 * self.y) % P
+        else:
+            lam = (o.y - self.y) * fq_inv(o.x - self.x) % P
+        x3 = (lam * lam - self.x - o.x) % P
+        y3 = (lam * (self.x - x3) - self.y) % P
+        return G1Point(x3, y3)
+
+    def mul(self, k: int) -> "G1Point":
+        k %= R
+        result = G1Point.identity()
+        add = self
+        while k > 0:
+            if k & 1:
+                result = result + add
+            add = add + add
+            k >>= 1
+        return result
+
+    # -- serialization (zcash/ietf compressed format, 48 bytes) -------------
+
+    def to_bytes(self) -> bytes:
+        if self.inf:
+            return bytes([0xC0] + [0] * 47)
+        flag = 0x80 | (0x20 if self.y > (P - 1) // 2 else 0)
+        out = bytearray(self.x.to_bytes(48, "big"))
+        out[0] |= flag
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "G1Point | None":
+        if len(data) != 48 or not data[0] & 0x80:
+            return None
+        if data[0] & 0x40:  # infinity
+            if data[0] != 0xC0 or any(data[1:]):
+                return None
+            return cls.identity()
+        sign = bool(data[0] & 0x20)
+        x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+        if x >= P:
+            return None
+        y2 = (x**3 + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P != y2:
+            return None
+        if (y > (P - 1) // 2) != sign:
+            y = P - y
+        pt = cls(x, y)
+        # subgroup check
+        if not pt.mul(R).inf:
+            return None
+        return pt
+
+
+class G2Point:
+    """Affine G2 point over Fq2."""
+
+    __slots__ = ("x", "y", "inf")
+
+    def __init__(self, x: Fq2 = Fq2.ZERO, y: Fq2 = Fq2.ZERO, inf: bool = False):
+        self.x, self.y, self.inf = x, y, inf
+
+    @classmethod
+    def identity(cls) -> "G2Point":
+        return cls(Fq2.ZERO, Fq2.ZERO, True)
+
+    @classmethod
+    def generator(cls) -> "G2Point":
+        return cls(Fq2(*G2_X), Fq2(*G2_Y))
+
+    B2 = None  # set below: 4(u+1)
+
+    def is_on_curve(self) -> bool:
+        if self.inf:
+            return True
+        return self.y.square() == self.x.square() * self.x + G2Point.B2
+
+    def __eq__(self, o: object) -> bool:
+        if not isinstance(o, G2Point):
+            return NotImplemented
+        if self.inf or o.inf:
+            return self.inf == o.inf
+        return self.x == o.x and self.y == o.y
+
+    def __hash__(self) -> int:
+        return hash((self.x, self.y, self.inf))
+
+    def __neg__(self) -> "G2Point":
+        if self.inf:
+            return self
+        return G2Point(self.x, -self.y)
+
+    def __add__(self, o: "G2Point") -> "G2Point":
+        if self.inf:
+            return o
+        if o.inf:
+            return self
+        if self.x == o.x:
+            if (self.y + o.y).is_zero():
+                return G2Point.identity()
+            lam = (self.x.square().mul_int(3)) * (self.y.mul_int(2)).inverse()
+        else:
+            lam = (o.y - self.y) * (o.x - self.x).inverse()
+        x3 = lam.square() - self.x - o.x
+        y3 = lam * (self.x - x3) - self.y
+        return G2Point(x3, y3)
+
+    def mul(self, k: int) -> "G2Point":
+        k %= R
+        result = G2Point.identity()
+        add = self
+        while k > 0:
+            if k & 1:
+                result = result + add
+            add = add + add
+            k >>= 1
+        return result
+
+    # -- serialization (compressed, 96 bytes) --------------------------------
+
+    def to_bytes(self) -> bytes:
+        if self.inf:
+            return bytes([0xC0] + [0] * 95)
+        # lexicographic "greater" on (c1, c0)
+        great = self.y.c1 > (P - 1) // 2 or (
+            self.y.c1 == 0 and self.y.c0 > (P - 1) // 2
+        )
+        flag = 0x80 | (0x20 if great else 0)
+        out = bytearray(
+            self.x.c1.to_bytes(48, "big") + self.x.c0.to_bytes(48, "big")
+        )
+        out[0] |= flag
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "G2Point | None":
+        if len(data) != 96 or not data[0] & 0x80:
+            return None
+        if data[0] & 0x40:
+            if data[0] != 0xC0 or any(data[1:]):
+                return None
+            return cls.identity()
+        sign = bool(data[0] & 0x20)
+        x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+        x0 = int.from_bytes(data[48:], "big")
+        if x0 >= P or x1 >= P:
+            return None
+        x = Fq2(x0, x1)
+        y2 = x.square() * x + G2Point.B2
+        y = y2.sqrt()
+        if y is None:
+            return None
+        great = y.c1 > (P - 1) // 2 or (y.c1 == 0 and y.c0 > (P - 1) // 2)
+        if great != sign:
+            y = -y
+        pt = cls(x, y)
+        if not pt.mul(R).inf:
+            return None
+        return pt
+
+
+G2Point.B2 = Fq2(4, 4)
+
+
+def hash_to_g1(message: bytes, dst: bytes = b"HOTSTUFF_TPU_BLS_G1") -> G1Point:
+    """Hash-and-check map to G1 with cofactor clearing.
+
+    Deliberately NOT RFC 9380 SSWU (this backend has no external interop
+    requirement); deterministic try-and-increment over SHA-256 counters,
+    which is uniform enough for the signature scheme's security argument
+    as long as all parties use the same map — they do, it ships with the
+    framework.
+    """
+    counter = 0
+    while True:
+        h = hashlib.sha256(dst + counter.to_bytes(4, "big") + message).digest()
+        x = int.from_bytes(h + hashlib.sha256(b"x2" + h).digest()[:16], "big") % P
+        y2 = (x**3 + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P == y2:
+            # pick the "even" root deterministically, then clear cofactor
+            if y > (P - 1) // 2:
+                y = P - y
+            return G1Point(x, y).mul_by_cofactor()
+        counter += 1
+
+
+def _mul_any(pt: G1Point, k: int) -> G1Point:
+    result = G1Point.identity()
+    add = pt
+    while k > 0:
+        if k & 1:
+            result = result + add
+        add = add + add
+        k >>= 1
+    return result
+
+
+def _mul_by_cofactor(self: G1Point) -> G1Point:
+    return _mul_any(self, H1)
+
+
+G1Point.mul_by_cofactor = _mul_by_cofactor  # type: ignore[attr-defined]

@@ -1,0 +1,106 @@
+"""Optimal ate pairing on BLS12-381.
+
+Textbook Miller loop over affine G2 with line evaluations embedded into
+Fq12, followed by the final exponentiation (p^12 - 1)/r computed
+directly by integer exponentiation — slow but transparently correct;
+bilinearity is asserted by tests (e(aP, bQ) == e(P, Q)^(ab)), which a
+wrong line function or exponent cannot satisfy.
+
+Embedding convention: G1 points (x, y) in Fq embed into Fq12 via the
+towering Fq -> Fq2 -> Fq6 -> Fq12; the line function is evaluated with
+the G2 (untwisted) coefficients in Fq12.
+"""
+
+from __future__ import annotations
+
+from .curve import G1Point, G2Point
+from .fields import P, R, X, Fq2, Fq6, Fq12
+
+
+def _fq2_to_fq12(a: Fq2) -> Fq12:
+    return Fq12(Fq6(a, Fq2.ZERO, Fq2.ZERO), Fq6.ZERO)
+
+
+# w in Fq12 (w^2 = v, v^3 = u+1); the twist maps G2 (x', y') to
+# (x' / w^2, y' / w^3) on the curve over Fq12.
+_W = Fq12(Fq6.ZERO, Fq6.ONE)
+_W2 = _W * _W
+_W3 = _W2 * _W
+_W2_INV = _W2.inverse()
+_W3_INV = _W3.inverse()
+
+
+def _untwist(q: G2Point) -> tuple[Fq12, Fq12]:
+    """G2 (over Fq2, the twist) -> point over Fq12 on the base curve."""
+    x = _fq2_to_fq12(q.x) * _W2_INV
+    y = _fq2_to_fq12(q.y) * _W3_INV
+    return x, y
+
+
+def _fq_to_fq12(a: int) -> Fq12:
+    return _fq2_to_fq12(Fq2(a, 0))
+
+
+def _line(px: Fq12, py: Fq12, qx: Fq12, qy: Fq12, rx: Fq12, ry: Fq12) -> Fq12:
+    """Evaluate at (rx, ry) the line through (px, py) and (qx, qy)
+    (tangent when the points coincide)."""
+    if px == qx and py == qy:
+        # tangent: slope = 3x^2 / 2y  (curve a-coefficient is 0)
+        three = _fq_to_fq12(3)
+        two = _fq_to_fq12(2)
+        lam = three * px * px * (two * py).inverse()
+    elif px == qx:
+        # vertical line
+        return rx - px
+    else:
+        lam = (qy - py) * (qx - px).inverse()
+    return ry - py - lam * (rx - px)
+
+
+def miller_loop(p: G1Point, q: G2Point) -> Fq12:
+    if p.inf or q.inf:
+        return Fq12.ONE
+    px, py = _fq_to_fq12(p.x), _fq_to_fq12(p.y)
+    qx, qy = _untwist(q)
+
+    t = abs(X)
+    bits = bin(t)[3:]  # skip the leading 1
+    f = Fq12.ONE
+    rx, ry = qx, qy
+    for bit in bits:
+        f = f * f * _line(rx, ry, rx, ry, px, py)
+        # R = 2R (on the Fq12 curve)
+        lam = _fq_to_fq12(3) * rx * rx * (_fq_to_fq12(2) * ry).inverse()
+        new_x = lam * lam - rx - rx
+        new_y = lam * (rx - new_x) - ry
+        rx, ry = new_x, new_y
+        if bit == "1":
+            f = f * _line(rx, ry, qx, qy, px, py)
+            if rx == qx and ry == qy:
+                lam = _fq_to_fq12(3) * rx * rx * (_fq_to_fq12(2) * ry).inverse()
+            else:
+                lam = (qy - ry) * (qx - rx).inverse()
+            new_x = lam * lam - rx - qx
+            new_y = lam * (rx - new_x) - ry
+            rx, ry = new_x, new_y
+    if X < 0:
+        f = f.conjugate()  # f^(p^6) inverts the exponent cheaply
+    return f
+
+
+def final_exponentiation(f: Fq12) -> Fq12:
+    return f.pow((P**12 - 1) // R)
+
+
+def pairing(p: G1Point, q: G2Point) -> Fq12:
+    """e(P, Q): bilinear, non-degenerate on (G1, G2)."""
+    return final_exponentiation(miller_loop(p, q))
+
+
+def pairings_equal(
+    p1: G1Point, q1: G2Point, p2: G1Point, q2: G2Point
+) -> bool:
+    """e(P1, Q1) == e(P2, Q2) via one product: e(P1,Q1)·e(-P2,Q2) == 1 —
+    shares the final exponentiation between the two Miller loops."""
+    f = miller_loop(p1, q1) * miller_loop(-p2, q2)
+    return final_exponentiation(f) == Fq12.ONE

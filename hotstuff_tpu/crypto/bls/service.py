@@ -1,0 +1,112 @@
+"""BLS backend behind the framework's verifier/signing boundaries.
+
+Mirrors the Ed25519 ``VerifierBackend`` protocol
+(hotstuff_tpu/crypto/service.py) over BLS12-381 keys (96-byte G2
+pubkeys) and signatures (48-byte G1 points), and adds what only BLS can
+offer: constant-cost shared-message verification via signature
+aggregation — ``verify_shared_msg`` does ONE pairing equality however
+many votes are in the QC, instead of a batch over 2f+1 Ed25519
+signatures.
+
+Drop-in point (reference parity): the SignatureService boundary at
+crypto/src/lib.rs:232-257; BASELINE config 5's threshold variant uses
+``split_secret``/``combine_partials`` from the package root.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from . import (
+    BlsPublicKey,
+    BlsSecretKey,
+    BlsSignature,
+    aggregate_public_keys,
+    aggregate_signatures,
+    keygen,
+)
+
+
+class BlsVerifier:
+    """VerifierBackend over BLS bytes; caches decoded public keys."""
+
+    name = "bls-cpu"
+
+    def __init__(self):
+        self._pk_cache: dict[bytes, BlsPublicKey | None] = {}
+
+    def _pk(self, pk_bytes: bytes) -> BlsPublicKey | None:
+        if pk_bytes not in self._pk_cache:
+            self._pk_cache[pk_bytes] = BlsPublicKey.from_bytes(pk_bytes)
+        return self._pk_cache[pk_bytes]
+
+    def precompute(self, pubkeys: list[bytes]) -> None:
+        for pk in pubkeys:
+            self._pk(pk)
+
+    def verify_one(self, digest, pk, sig) -> bool:
+        pk_b = pk if isinstance(pk, bytes) else pk.to_bytes()
+        sig_b = sig if isinstance(sig, bytes) else sig.to_bytes()
+        msg = digest if isinstance(digest, bytes) else digest.to_bytes()
+        pub = self._pk(pk_b)
+        s = BlsSignature.from_bytes(sig_b)
+        return pub is not None and s is not None and pub.verify(msg, s)
+
+    def verify_shared_msg(self, digest, votes) -> bool:
+        """One pairing equality for the whole vote set (aggregation)."""
+        msg = digest if isinstance(digest, bytes) else digest.to_bytes()
+        pks, sigs = [], []
+        for pk, sig in votes:
+            pub = self._pk(pk if isinstance(pk, bytes) else pk.to_bytes())
+            s = BlsSignature.from_bytes(
+                sig if isinstance(sig, bytes) else sig.to_bytes()
+            )
+            if pub is None or s is None:
+                return False
+            pks.append(pub)
+            sigs.append(s)
+        if not pks:
+            return False
+        agg_sig = aggregate_signatures(sigs)
+        return aggregate_public_keys(pks).verify(msg, agg_sig)
+
+    def verify_many(self, digests, pks, sigs) -> list[bool]:
+        return [
+            self.verify_one(d, p, s) for d, p, s in zip(digests, pks, sigs)
+        ]
+
+
+class BlsSignatureService:
+    """Actor-shaped signing service (reference crypto/src/lib.rs:232-257):
+    callers await ``request_signature(digest)``; one task owns the key."""
+
+    def __init__(self, secret: BlsSecretKey):
+        self._secret = secret
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="bls-signature-service"
+            )
+
+    async def _run(self) -> None:
+        while True:
+            digest, fut = await self._queue.get()
+            if not fut.done():
+                fut.set_result(self._secret.sign(digest))
+
+    async def request_signature(self, digest: bytes) -> BlsSignature:
+        self._ensure_started()
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put((digest, fut))
+        return await fut
+
+    def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+__all__ = ["BlsVerifier", "BlsSignatureService", "keygen"]

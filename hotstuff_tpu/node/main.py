@@ -96,7 +96,7 @@ def main(argv=None) -> int:
     p_run.add_argument("--parameters", default=None)
     p_run.add_argument(
         "--verifier",
-        choices=["cpu", "tpu"],
+        choices=["cpu", "tpu", "tpu-sharded"],
         default="cpu",
         help="signature verification backend",
     )

@@ -32,6 +32,12 @@ def make_verifier(kind: str) -> VerifierBackend:
         from ..tpu.ed25519 import BatchVerifier
 
         return BatchVerifier()
+    if kind == "tpu-sharded":
+        # batch sharded over every visible device (multi-chip execution;
+        # on one chip this degenerates to the plain TPU backend's shape)
+        from ..parallel.mesh import ShardedBatchVerifier
+
+        return ShardedBatchVerifier()
     raise ValueError(f"unknown verifier backend '{kind}'")
 
 

@@ -88,8 +88,8 @@ class ShardedBatchVerifier(BatchVerifier):
     chip gets an equal slice.
     """
 
-    def __init__(self, mesh: Mesh | None = None):
-        super().__init__()
+    def __init__(self, mesh: Mesh | None = None, min_device_batch: int = 64):
+        super().__init__(min_device_batch=min_device_batch)
         self.mesh = mesh if mesh is not None else default_mesh()
         self._kernel = make_sharded_verify(self.mesh)
         self.name = f"tpu-sharded-{self.mesh.devices.size}"

@@ -17,16 +17,20 @@ from collections import deque
 from .engine import Engine, WalEngine
 
 
-def open_engine(path: str, prefer_native: bool = True) -> Engine:
-    """Open the best available engine at ``path`` (C++ if built, else WAL)."""
+def open_engine(
+    path: str, prefer_native: bool = True, fsync_mode: int = 0
+) -> Engine:
+    """Open the best available engine at ``path`` (C++ if built, else the
+    pure-Python WAL).  Both speak the same on-disk format.  fsync_mode:
+    0 = flush per put, 1 = fsync per put, 2 = fsync on close."""
     if prefer_native:
         try:
             from .native import NativeEngine  # noqa: PLC0415
 
-            return NativeEngine(path)
+            return NativeEngine(path, fsync_mode)
         except (ImportError, OSError):
             pass
-    return WalEngine(path)
+    return WalEngine(path, fsync_mode)
 
 
 class Store:

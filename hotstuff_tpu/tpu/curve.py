@@ -45,11 +45,11 @@ NWIN = 64  # ceil(256 / WINDOW) windows, MSB-first (top 3 bits always 0)
 Point = tuple  # (X, Y, Z, T) limb arrays
 
 
-def _base_table() -> np.ndarray:
-    """Constant table of [m]B for m in 0..15, extended affine limbs.
-    Shape [16, 4, NLIMBS] (coords X, Y, Z=1, T)."""
-    table = np.zeros((1 << WINDOW, 4, F.NLIMBS), np.int32)
-    for m in range(1 << WINDOW):
+def _base_table(window: int) -> np.ndarray:
+    """Constant table of [m]B for m in 0..2^window-1, extended affine
+    limbs.  Shape [2^window, 4, NLIMBS] (coords X, Y, Z=1, T)."""
+    table = np.zeros((1 << window, 4, F.NLIMBS), np.int32)
+    for m in range(1 << window):
         if m == 0:
             x, y = 0, 1
         else:
@@ -61,7 +61,13 @@ def _base_table() -> np.ndarray:
     return table
 
 
-B_TABLE = _base_table()
+B_TABLE = _base_table(WINDOW)
+# The base point is compile-time constant, so its window can be twice as
+# wide for free (the table is baked into the program): 8-bit windows
+# halve the number of [m]B additions in the fused scan (64 -> 32),
+# measured ~8% off whole-kernel latency.
+B_WINDOW = 8
+B_TABLE8 = _base_table(B_WINDOW)
 
 
 def identity(shape_like) -> Point:
@@ -143,39 +149,49 @@ def _select_from_batch_table(table: tuple, nibble) -> Point:
     return tuple(jnp.sum(coord * onehot, axis=0) for coord in table)
 
 
-def _select_from_const_table(nibble) -> Point:
-    """B_TABLE select: nibble [...batch] -> constant multiples of B."""
+def _select_from_const_table(byte) -> Point:
+    """B_TABLE8 select: byte [...batch] -> constant multiples of B."""
     onehot = (
-        nibble[..., None] == jnp.arange(1 << WINDOW, dtype=jnp.int32)
-    ).astype(jnp.int32)  # [...batch, 16]
-    tab = jnp.asarray(B_TABLE)  # [16, 4, 20]
+        byte[..., None] == jnp.arange(1 << B_WINDOW, dtype=jnp.int32)
+    ).astype(jnp.int32)  # [...batch, 256]
+    tab = jnp.asarray(B_TABLE8)  # [256, 4, 20]
     sel = jnp.tensordot(onehot, tab, axes=([-1], [0]))  # [...batch, 4, 20]
     return tuple(sel[..., c, :] for c in range(4))
 
 
 def dual_scalar_mult(s_win, k_win, a_point: Point) -> Point:
-    """[s]B + [k]A for a whole batch at once — 4-bit Straus windows.
+    """[s]B + [k]A for a whole batch at once — mixed-window Straus.
 
     s_win, k_win: int32 [NWIN, ...batch] — MSB-first 4-bit windows.
     a_point: batch of points (each coord [...batch, 20]).
 
-    One lax.scan step = 4 doublings + 2 complete additions of
-    table-selected multiples: [16]A built once per batch (15 additions),
-    [m]B a compile-time constant table — ~2x fewer point operations than
-    a bit-serial double-and-add over 253 bits.
+    One lax.scan macro-step covers 8 bits: 2x(4 doublings + one
+    [m]A addition from the 16-entry per-batch table) + one [m]B addition
+    from the compile-time 256-entry constant table (B is fixed, so its
+    window is twice as wide for free — 32 B-additions instead of 64).
     """
     a_table = _build_a_table(a_point)
 
+    # pair the 4-bit windows: (hi, lo) nibbles of each 8-bit B-window
+    s_pairs = s_win.reshape((NWIN // 2, 2) + s_win.shape[1:])
+    s_bytes = s_pairs[:, 0] * (1 << WINDOW) + s_pairs[:, 1]
+    k_pairs = k_win.reshape((NWIN // 2, 2) + k_win.shape[1:])
+
     def step(acc, wins):
-        ws, wk = wins
+        sb, wk_hi, wk_lo = wins
         for _ in range(WINDOW):
             acc = point_double(acc)
-        acc = point_add(acc, _select_from_const_table(ws))
-        acc = point_add(acc, _select_from_batch_table(a_table, wk))
+        acc = point_add(acc, _select_from_batch_table(a_table, wk_hi))
+        for _ in range(WINDOW):
+            acc = point_double(acc)
+        acc = point_add(acc, _select_from_batch_table(a_table, wk_lo))
+        acc = point_add(acc, _select_from_const_table(sb))
         return acc, None
 
     init = identity(a_point[0])
-    out, _ = jax.lax.scan(step, init, (s_win, k_win))
+    out, _ = jax.lax.scan(
+        step, init, (s_bytes, k_pairs[:, 0], k_pairs[:, 1])
+    )
     return out
 
 

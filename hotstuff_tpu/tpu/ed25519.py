@@ -92,14 +92,24 @@ def _bytes_rows_to_limbs(rows: np.ndarray) -> np.ndarray:
 class BatchVerifier:
     """Host-side driver: prepares batches, caches committee points, runs the
     jitted kernel. Thread-compatible with the asyncio node (pure function +
-    caches keyed by immutable bytes)."""
+    caches keyed by immutable bytes).
 
-    def __init__(self):
+    Hybrid routing: batches smaller than ``min_device_batch`` are
+    verified on the CPU backend instead — kernel dispatch has a fixed
+    cost (milliseconds under a remote tunnel, tens of microseconds
+    co-located) that swamps the work of a handful of signatures, so the
+    device only sees batches where it pays off.  Set
+    ``min_device_batch=0`` to force everything onto the device (tests
+    do, so the kernel path is what's exercised)."""
+
+    def __init__(self, min_device_batch: int = 64):
         # pk bytes -> (ax, ay, az, at) limb rows of the negated point, or None
         self._point_cache: dict[bytes, tuple | None] = {}
         # padded batch shapes; subclasses (e.g. the mesh-sharded verifier)
         # override so every device gets an equal slice
         self.pad_sizes: tuple[int, ...] = PAD_SIZES
+        self.min_device_batch = min_device_batch
+        self._cpu = None  # lazy CpuVerifier for small batches
 
     def precompute(self, pubkeys: list[bytes]) -> None:
         """Decompress + negate committee keys ahead of time (epoch setup)."""
@@ -126,6 +136,12 @@ class BatchVerifier:
             raise ValueError("length mismatch")
         if n == 0:
             return np.zeros(0, bool)
+        if n < self.min_device_batch:
+            if self._cpu is None:
+                from ..crypto.signature import batch_verify_arrays
+
+                self._cpu = batch_verify_arrays
+            return np.asarray(self._cpu(messages, pubkeys, signatures))
         if n > self.pad_sizes[-1]:
             # split oversized batches into max-shape chunks
             step = self.pad_sizes[-1]

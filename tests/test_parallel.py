@@ -30,7 +30,7 @@ def test_mesh_has_8_devices():
 
 
 def test_sharded_verify_matches_expected():
-    verifier = ShardedBatchVerifier(default_mesh())
+    verifier = ShardedBatchVerifier(default_mesh(), min_device_batch=0)
     msgs, pks, sigs = _batch(19, tamper={3, 11})
     out = verifier.verify(msgs, pks, sigs)
     expected = np.array([i not in {3, 11} for i in range(19)])
@@ -46,7 +46,7 @@ def test_sharded_qc_check_scalar():
     # instance, then cross-check the scalar all-valid kernel
     mesh = default_mesh()
     check = make_sharded_qc_check(mesh)
-    verifier = ShardedBatchVerifier(mesh)
+    verifier = ShardedBatchVerifier(mesh, min_device_batch=0)
 
     msgs, pks, sigs = _batch(8)
     ok = verifier.verify(msgs, pks, sigs)
@@ -62,7 +62,7 @@ def test_sharded_verifier_as_consensus_backend():
     the consensus aggregator/QC verify."""
     from tests.common import chain, committee, qc_for_block
 
-    verifier = ShardedBatchVerifier(default_mesh())
+    verifier = ShardedBatchVerifier(default_mesh(), min_device_batch=0)
     block = chain(1)[0]
     qc = qc_for_block(block)
     qc.verify(committee(9_300), verifier)  # should not raise

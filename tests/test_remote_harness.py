@@ -1,0 +1,146 @@
+"""Remote/cluster harness orchestration tests (reference
+benchmark/benchmark/remote.py + instance.py, which ship untested).
+
+A recording fake runner stands in for the gcloud CLI so the command
+sequences — lifecycle, install/update fan-out, config upload, node/client
+launch, log download — are pinned without any network access."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from benchmark.instance import TpuVmManager
+from benchmark.remote import RemoteBench
+from benchmark.settings import DEFAULT_SETTINGS, Settings, SettingsError
+
+
+def make_settings(tmp_path, count=2) -> Settings:
+    cfg = json.loads(json.dumps(DEFAULT_SETTINGS))
+    cfg["instances"]["count"] = count
+    path = tmp_path / "settings.json"
+    path.write_text(json.dumps(cfg))
+    return Settings.load(str(path))
+
+
+class FakeRunner:
+    def __init__(self, hosts_json="[]"):
+        self.commands: list[list[str]] = []
+        self.hosts_json = hosts_json
+
+    def __call__(self, cmd, timeout=600):
+        self.commands.append(list(cmd))
+        if "list" in cmd:
+            return self.hosts_json
+        return ""
+
+
+def hosts_payload(n):
+    return json.dumps(
+        [
+            {
+                "name": f"projects/x/locations/y/nodes/hotstuff-tpu-{i}",
+                "state": "READY",
+                "networkEndpoints": [
+                    {
+                        "ipAddress": f"10.0.0.{i + 1}",
+                        "accessConfig": {"externalIp": f"34.1.2.{i + 1}"},
+                    }
+                ],
+            }
+            for i in range(n)
+        ]
+    )
+
+
+def test_settings_load_and_errors(tmp_path):
+    s = make_settings(tmp_path)
+    assert s.testbed == "hotstuff-tpu"
+    assert s.accelerator_type == "v5litepod-8"
+    with pytest.raises(SettingsError):
+        Settings.load(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    with pytest.raises(SettingsError):
+        Settings.load(str(bad))
+
+
+def test_instance_lifecycle_commands(tmp_path):
+    s = make_settings(tmp_path, count=2)
+    runner = FakeRunner()
+    mgr = TpuVmManager(s, runner=runner)
+    mgr.create_instances()
+    mgr.stop_instances()
+    mgr.start_instances()
+    mgr.terminate_instances()
+    cmds = [" ".join(c) for c in runner.commands]
+    assert sum("create hotstuff-tpu-" in c for c in cmds) == 2
+    assert any("--accelerator-type=v5litepod-8" in c for c in cmds)
+    assert sum(" stop hotstuff-tpu-" in c for c in cmds) == 2
+    assert sum(" start hotstuff-tpu-" in c for c in cmds) == 2
+    assert sum(" delete hotstuff-tpu-" in c for c in cmds) == 2
+
+
+def test_hosts_parses_gcloud_json(tmp_path):
+    s = make_settings(tmp_path, count=2)
+    mgr = TpuVmManager(s, runner=FakeRunner(hosts_payload(2)))
+    hosts = mgr.hosts()
+    assert [h["name"] for h in hosts] == ["hotstuff-tpu-0", "hotstuff-tpu-1"]
+    assert hosts[0]["internal_ip"] == "10.0.0.1"
+    assert hosts[1]["external_ip"] == "34.1.2.2"
+    assert all(h["state"] == "READY" for h in hosts)
+
+
+def test_install_update_kill_fan_out(tmp_path):
+    s = make_settings(tmp_path, count=3)
+    runner = FakeRunner(hosts_payload(3))
+    bench = RemoteBench(s, runner=runner)
+    bench.install()
+    bench.update()
+    bench.kill()
+    cmds = [" ".join(c) for c in runner.commands]
+    assert sum("git clone" in c for c in cmds) == 3
+    assert sum("git fetch origin && git checkout main" in c for c in cmds) == 3
+    assert sum("pkill -f hotstuff_tpu.node" in c for c in cmds) == 3
+
+
+def test_config_generates_and_uploads(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    s = make_settings(tmp_path, count=2)
+    runner = FakeRunner(hosts_payload(2))
+    bench = RemoteBench(s, runner=runner)
+    hosts = bench.manager.hosts()
+    bench._config(hosts, nodes=4)
+    # committee written locally with the hosts' internal IPs
+    committee = json.loads((tmp_path / ".committee.json").read_text())
+    addresses = str(committee)
+    assert "10.0.0.1" in addresses and "10.0.0.2" in addresses
+    # 4 keys + (committee + parameters + key) x 4 uploads
+    uploads = [c for c in runner.commands if ".committee.json" in " ".join(c)]
+    assert len(uploads) == 4
+    key_uploads = [c for c in runner.commands if ".node_" in " ".join(c)]
+    assert len(key_uploads) == 4
+
+
+def test_run_single_boots_nodes_round_robin(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    s = make_settings(tmp_path, count=2)
+    runner = FakeRunner(hosts_payload(2))
+    bench = RemoteBench(s, runner=runner)
+    hosts = bench.manager.hosts()
+    bench._config(hosts, nodes=4)
+    runner.commands.clear()
+    bench._run_single(hosts, nodes=4, rate=1000, duration=30, faults=1,
+                      verifier="tpu")
+    cmds = [" ".join(c) for c in runner.commands]
+    node_launches = [c for c in cmds if "hotstuff_tpu.node -vv run" in c]
+    assert len(node_launches) == 3  # faults=1 -> one node not booted
+    assert all("--verifier tpu" in c for c in node_launches)
+    client_launches = [c for c in cmds if "hotstuff_tpu.node.client" in c]
+    assert len(client_launches) == 1
+    assert "--faults 1" in client_launches[0]
+    # round-robin placement: node 0 and node 2 land on host 0
+    assert "hotstuff-tpu-0" in node_launches[0]
+    assert "hotstuff-tpu-1" in node_launches[1]
+    assert "hotstuff-tpu-0" in node_launches[2]

@@ -1,0 +1,175 @@
+"""Native C++ WAL engine: parity with the Python engine, crash-kill
+recovery, compaction, fsync modes (reference store durability semantics,
+store/src/lib.rs + SURVEY.md §5 "the store IS the checkpoint")."""
+
+from __future__ import annotations
+
+import os
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from hotstuff_tpu.store.engine import WalEngine
+
+try:
+    from hotstuff_tpu.store.native import NativeEngine
+
+    _HAVE_NATIVE = True
+except (ImportError, OSError):  # no compiler in this environment
+    _HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not _HAVE_NATIVE, reason="native lib not built")
+
+
+@needs_native
+def test_native_put_get_delete_roundtrip(tmp_path):
+    e = NativeEngine(str(tmp_path / "db"))
+    e.put(b"a", b"1")
+    e.put(b"b", b"2" * 1000)
+    e.put(b"a", b"3")  # overwrite
+    e.delete(b"b")
+    assert e.get(b"a") == b"3"
+    assert e.get(b"b") is None
+    assert e.get(b"missing") is None
+    assert len(e) == 1
+    assert set(e.keys()) == {b"a"}
+    e.put(b"", b"empty-key")  # empty key and value edge cases
+    e.put(b"ev", b"")
+    assert e.get(b"") == b"empty-key"
+    assert e.get(b"ev") == b""
+    e.close()
+
+
+@needs_native
+def test_native_reopen_recovers(tmp_path):
+    path = str(tmp_path / "db")
+    e = NativeEngine(path)
+    for i in range(100):
+        e.put(f"k{i}".encode(), f"v{i}".encode() * 10)
+    e.delete(b"k50")
+    e.close()
+    e2 = NativeEngine(path)
+    assert len(e2) == 99
+    assert e2.get(b"k7") == b"v7" * 10
+    assert e2.get(b"k50") is None
+    e2.close()
+
+
+@needs_native
+def test_cross_engine_wal_interop(tmp_path):
+    """Python and C++ engines share the WAL format bit-for-bit."""
+    path = str(tmp_path / "db")
+    w = WalEngine(path)
+    w.put(b"py", b"from-python")
+    w.delete(b"gone")
+    w.close()
+    e = NativeEngine(path)
+    assert e.get(b"py") == b"from-python"
+    e.put(b"cc", b"from-cpp")
+    e.close()
+    w2 = WalEngine(path)
+    assert w2.get(b"py") == b"from-python"
+    assert w2.get(b"cc") == b"from-cpp"
+    w2.close()
+
+
+@needs_native
+def test_native_torn_tail_truncated(tmp_path):
+    """A torn (half-written) trailing record is discarded and truncated."""
+    path = str(tmp_path / "db")
+    e = NativeEngine(path)
+    e.put(b"good", b"value")
+    e.close()
+    wal = os.path.join(path, "wal.log")
+    with open(wal, "ab") as f:
+        f.write(struct.pack("<II", 4, 100))  # header promises 100-byte value
+        f.write(b"torn")  # ...but the process died here
+    e2 = NativeEngine(path)
+    assert e2.get(b"good") == b"value"
+    assert len(e2) == 1
+    e2.close()
+    # tail was truncated: a fresh append replays cleanly
+    e3 = NativeEngine(path)
+    e3.put(b"after", b"recovery")
+    e3.close()
+    e4 = NativeEngine(path)
+    assert e4.get(b"after") == b"recovery"
+    assert len(e4) == 2
+    e4.close()
+
+
+_KILL_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, {root!r})
+from hotstuff_tpu.store.native import NativeEngine
+e = NativeEngine({path!r}, fsync_mode=1)
+for i in range(50):
+    e.put(f"key{{i}}".encode(), b"x" * 100)
+os.kill(os.getpid(), 9)  # die without close()
+"""
+
+
+@needs_native
+def test_native_survives_sigkill(tmp_path):
+    """Process killed mid-sequence (no close): every acknowledged put is
+    recovered on reopen (VERDICT r1 item 9)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = str(tmp_path / "db")
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT.format(root=root, path=path)],
+        capture_output=True,
+        timeout=60,
+    )
+    assert proc.returncode == -9  # SIGKILL
+    e = NativeEngine(path)
+    assert len(e) == 50
+    for i in range(50):
+        assert e.get(f"key{i}".encode()) == b"x" * 100
+    e.close()
+
+
+@needs_native
+def test_native_compaction_bounds_wal(tmp_path):
+    """Overwriting the same keys grows the log; reopen compacts it."""
+    path = str(tmp_path / "db")
+    e = NativeEngine(path)
+    for round_ in range(300):
+        for k in range(10):
+            e.put(f"key{k}".encode(), bytes([round_ % 256]) * 1024)
+    grown = e.wal_bytes()
+    e.close()
+    assert grown > 2 * 10 * 1100  # lots of dead records
+    e2 = NativeEngine(path)
+    assert e2.wal_bytes() < grown / 10  # compacted on open
+    assert len(e2) == 10
+    for k in range(10):
+        assert e2.get(f"key{k}".encode()) == bytes([299 % 256]) * 1024
+    e2.close()
+
+
+def test_python_wal_compaction_and_fsync(tmp_path):
+    """The pure-Python engine has the same compaction + fsync options."""
+    path = str(tmp_path / "db")
+    e = WalEngine(path, fsync_mode=1)
+    for round_ in range(300):
+        for k in range(10):
+            e.put(f"key{k}".encode(), bytes([round_ % 256]) * 1024)
+    e.close()
+    grown = os.path.getsize(os.path.join(path, "wal.log"))
+    e2 = WalEngine(path)
+    compacted = os.path.getsize(os.path.join(path, "wal.log"))
+    assert compacted < grown / 10
+    assert len(e2) == 10
+    e2.close()
+
+
+@needs_native
+def test_store_actor_uses_native_engine(tmp_path):
+    """open_engine prefers the native engine when the library is built."""
+    from hotstuff_tpu.store import open_engine
+
+    e = open_engine(str(tmp_path / "db"))
+    assert type(e).__name__ == "NativeEngine"
+    e.close()

@@ -61,7 +61,7 @@ def _sign_many(n, msg_fn):
 
 @pytest.fixture(scope="module")
 def verifier():
-    return BatchVerifier()
+    return BatchVerifier(min_device_batch=0)  # force the kernel path
 
 
 def test_batch_all_valid(verifier):
