@@ -14,22 +14,11 @@
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 from .aggregate import aggregate, print_summary
 from .local import LocalBench
-from .utils import PathMaker, Print
-
-
-def _save_result(summary: str, faults, nodes, rate, verifier) -> None:
-    os.makedirs(PathMaker.results_path(), exist_ok=True)
-    path = PathMaker.result_file(faults, nodes, rate, verifier)
-    # append — multiple runs of the same config aggregate (reference
-    # results files hold ~5 runs each, SURVEY.md §6)
-    with open(path, "a") as f:
-        f.write(summary)
-    Print.info(f"Result appended to {path}")
+from .utils import PathMaker, Print, save_result as _save_result
 
 
 def task_local(args) -> int:

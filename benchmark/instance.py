@@ -105,7 +105,7 @@ class TpuVmManager:
             name = item.get("name", "").rsplit("/", 1)[-1]
             if not name.startswith(self.settings.testbed + "-"):
                 continue
-            endpoints = item.get("networkEndpoints", [{}])
+            endpoints = item.get("networkEndpoints") or [{}]
             info.append(
                 {
                     "name": name,

@@ -17,12 +17,13 @@ has no tests at all.
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 
 from .instance import TpuVmManager, _default_runner
 from .logs import LogParser
 from .settings import Settings
-from .utils import BenchError, PathMaker, Print
+from .utils import BenchError, PathMaker, Print, save_result
 
 
 class RemoteBench:
@@ -82,9 +83,12 @@ class RemoteBench:
     def kill(self) -> None:
         """Stop any running nodes/clients (reference's tmux kill)."""
         for h in self.manager.hosts():
+            # bracketed dot so the pattern never matches the remote shell
+            # that is executing this very command (pkill -f would SIGTERM
+            # it, killing the ssh session before `|| true` runs)
             self._ssh(
                 h["name"],
-                "pkill -f hotstuff_tpu.node || true",
+                "pkill -f 'hotstuff_tpu[.]node' || true",
             )
 
     # ---- one benchmark run -------------------------------------------------
@@ -100,6 +104,8 @@ class RemoteBench:
         )
 
         keys = [Secret.new() for _ in range(nodes)]
+        # round-robin nodes over hosts; co-located nodes (i // len(hosts)
+        # > 0) need distinct ports or their listeners collide
         committee = Committee.new(
             [
                 (
@@ -107,7 +113,7 @@ class RemoteBench:
                     1,
                     (
                         hosts[i % len(hosts)]["internal_ip"],
-                        self.settings.consensus_port,
+                        self.settings.consensus_port + i // len(hosts),
                     ),
                 )
                 for i, secret in enumerate(keys)
@@ -117,15 +123,13 @@ class RemoteBench:
         write_parameters(Parameters(), PathMaker.parameters_file())
         for i, secret in enumerate(keys):
             secret.write(PathMaker.key_file(i))
+        repo = self.settings.repo_name
+        # shared files once per host; key files once per node
+        for host in hosts[: min(nodes, len(hosts))]:
+            self._upload(host["name"], PathMaker.committee_file(), f"{repo}/")
+            self._upload(host["name"], PathMaker.parameters_file(), f"{repo}/")
         for i in range(nodes):
             host = hosts[i % len(hosts)]
-            repo = self.settings.repo_name
-            self._upload(
-                host["name"], PathMaker.committee_file(), f"{repo}/"
-            )
-            self._upload(
-                host["name"], PathMaker.parameters_file(), f"{repo}/"
-            )
             self._upload(host["name"], PathMaker.key_file(i), f"{repo}/")
 
     def _run_single(
@@ -163,21 +167,24 @@ class RemoteBench:
 
     def _logs(self, hosts: list[dict], nodes: int, faults: int) -> LogParser:
         """Download every log and parse (reference remote.py:221-235)."""
-        os.makedirs(PathMaker.logs_dir(), exist_ok=True)
+        # clear stale logs from a previous (possibly larger) run: the
+        # parser globs node-*.log, so leftovers would corrupt the summary
+        shutil.rmtree(PathMaker.logs_path(), ignore_errors=True)
+        os.makedirs(PathMaker.logs_path(), exist_ok=True)
         repo = self.settings.repo_name
         for i in range(nodes - faults):
             host = hosts[i % len(hosts)]
             self._download(
                 host["name"],
                 f"{repo}/logs/node-{i}.log",
-                os.path.join(PathMaker.logs_dir(), f"node-{i}.log"),
+                PathMaker.node_log_file(i),
             )
         self._download(
             hosts[0]["name"],
             f"{repo}/logs/client.log",
-            os.path.join(PathMaker.logs_dir(), "client.log"),
+            PathMaker.client_log_file(),
         )
-        return LogParser.process(PathMaker.logs_dir())
+        return LogParser.process(PathMaker.logs_path())
 
     def run(
         self,
@@ -213,9 +220,7 @@ class RemoteBench:
                         faults=faults, nodes=nodes, verifier=verifier
                     )
                     print(summary)
-                    path = PathMaker.result_file(faults, nodes, rate, verifier)
-                    with open(path, "a") as f:
-                        f.write(summary)
+                    save_result(summary, faults, nodes, rate, verifier)
 
 
 __all__ = ["RemoteBench", "TpuVmManager", "Settings", "subprocess"]

@@ -84,3 +84,15 @@ class Print:
 
 class BenchError(Exception):
     pass
+
+
+def save_result(summary: str, faults, nodes, rate, verifier) -> str:
+    """Append a SUMMARY block to the results file for this config.
+    Append — multiple runs of the same config aggregate (reference
+    results files hold ~5 runs each, SURVEY.md §6)."""
+    os.makedirs(PathMaker.results_path(), exist_ok=True)
+    path = PathMaker.result_file(faults, nodes, rate, verifier)
+    with open(path, "a") as f:
+        f.write(summary)
+    Print.info(f"Result appended to {path}")
+    return path

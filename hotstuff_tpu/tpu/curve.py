@@ -61,7 +61,6 @@ def _base_table(window: int) -> np.ndarray:
     return table
 
 
-B_TABLE = _base_table(WINDOW)
 # The base point is compile-time constant, so its window can be twice as
 # wide for free (the table is baked into the program): 8-bit windows
 # halve the number of [m]B additions in the fused scan (64 -> 32),

@@ -102,7 +102,8 @@ def test_install_update_kill_fan_out(tmp_path):
     cmds = [" ".join(c) for c in runner.commands]
     assert sum("git clone" in c for c in cmds) == 3
     assert sum("git fetch origin && git checkout main" in c for c in cmds) == 3
-    assert sum("pkill -f hotstuff_tpu.node" in c for c in cmds) == 3
+    # bracketed pattern: must not match the remote shell running the pkill
+    assert sum("pkill -f 'hotstuff_tpu[.]node'" in c for c in cmds) == 3
 
 
 def test_config_generates_and_uploads(tmp_path, monkeypatch):
@@ -116,9 +117,16 @@ def test_config_generates_and_uploads(tmp_path, monkeypatch):
     committee = json.loads((tmp_path / ".committee.json").read_text())
     addresses = str(committee)
     assert "10.0.0.1" in addresses and "10.0.0.2" in addresses
-    # 4 keys + (committee + parameters + key) x 4 uploads
+    # co-located nodes (2 per host) must get distinct ports per host
+    ports = sorted(
+        int(str(addr).rsplit(":", 1)[-1])
+        for addr in json.dumps(committee).split('"')
+        if str(addr).startswith("10.0.0.1:")
+    )
+    assert len(ports) == len(set(ports)) == 2
+    # shared files once per host; key files once per node
     uploads = [c for c in runner.commands if ".committee.json" in " ".join(c)]
-    assert len(uploads) == 4
+    assert len(uploads) == 2
     key_uploads = [c for c in runner.commands if ".node_" in " ".join(c)]
     assert len(key_uploads) == 4
 
