@@ -26,6 +26,9 @@ import json
 import sys
 import time
 
+import hotstuff_tpu  # noqa: F401  (sets the shared compilation-cache
+# dir; must import before jax reads its config env vars)
+
 
 BATCH = 1024  # four 256-vote QCs per dispatch (256-node committee shape)
 WARMUP = 2
@@ -61,9 +64,14 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     """(throughput sigs/s, {qc_size: {p50_ms, p99_ms}})."""
     import numpy as np
 
-    from hotstuff_tpu.tpu.ed25519 import BatchVerifier, _verify_kernel
+    from hotstuff_tpu.tpu.ed25519 import (
+        BatchVerifier,
+        _verify_kernel,
+        _verify_kernel_pallas,
+    )
 
     verifier = BatchVerifier(min_device_batch=0)  # measure the kernel
+    _kernel = _verify_kernel_pallas if verifier.use_pallas else _verify_kernel
     verifier.precompute(pks)  # epoch setup: committee keys decompressed once
 
     for _ in range(WARMUP):
@@ -75,7 +83,7 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     # throughput: FIFO dispatch stream, clock stopped by a full fetch of
     # the last result (the only sync the tunnel can't fake)
     t0 = time.perf_counter()
-    outs = [_verify_kernel(*staged) for _ in range(ROUNDS)]
+    outs = [_kernel(*staged) for _ in range(ROUNDS)]
     final = np.asarray(outs[-1])
     dt = time.perf_counter() - t0
     assert final.all()
@@ -90,11 +98,11 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     latencies: dict = {}
     for qc_size in (16, 64, 256):
         sub = _stage(verifier, msgs[:qc_size], pks[:qc_size], sigs[:qc_size])
-        np.asarray(_verify_kernel(*sub))  # warm this shape
+        np.asarray(_kernel(*sub))  # warm this shape
         times = []
         for _ in range(LAT_REPS):
             t0 = time.perf_counter()
-            ok = np.asarray(_verify_kernel(*sub))
+            ok = np.asarray(_kernel(*sub))
             times.append(time.perf_counter() - t0)
             assert ok.all()
         times.sort()
@@ -102,7 +110,7 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
         for n in (8, 32):
             t0 = time.perf_counter()
             for _ in range(n):
-                out = _verify_kernel(*sub)
+                out = _kernel(*sub)
             np.asarray(out)
             totals[n] = time.perf_counter() - t0
         latencies[str(qc_size)] = {

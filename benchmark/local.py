@@ -102,11 +102,12 @@ class LocalBench:
             env={
                 **os.environ,
                 "PYTHONPATH": root,
-                # share one persistent XLA compilation cache across the
-                # committee: with --verifier tpu every node would
-                # otherwise pay the full first-compile (~40 s) per run
-                "JAX_COMPILATION_CACHE_DIR": os.path.join(
-                    root, ".jax_cache"
+                # share one persistent XLA/Mosaic compilation cache across
+                # the committee AND with bench/test runs: with --verifier
+                # tpu every node would otherwise pay the full first
+                # compile (minutes for the Pallas kernel) per run
+                "JAX_COMPILATION_CACHE_DIR": os.environ.get(
+                    "JAX_COMPILATION_CACHE_DIR", hotstuff_tpu.JAX_CACHE_DIR
                 ),
             },
         )

@@ -74,6 +74,18 @@ class Node:
             verifier.precompute(
                 [pk.to_bytes() for pk in committee.authorities]
             )
+        committee_size = len(committee.authorities)
+        if hasattr(verifier, "warmup") and committee_size >= getattr(
+            verifier, "min_device_batch", 0
+        ):
+            # compile/cache-load the device kernel BEFORE binding the
+            # consensus port: a cold compile on the first QC verify
+            # would stall past the round timeout and trigger view
+            # changes (clients wait for the port, so boot-time cost is
+            # invisible to the measured window).  Skipped when every
+            # possible batch (<= committee size) routes to the CPU
+            # hybrid path anyway — then the kernel is never dispatched.
+            verifier.warmup(batch=committee_size)
 
         self.commit = asyncio.Queue(maxsize=self.CHANNEL_CAPACITY)
         self.consensus = await Consensus.spawn(

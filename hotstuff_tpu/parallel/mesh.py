@@ -89,7 +89,10 @@ class ShardedBatchVerifier(BatchVerifier):
     """
 
     def __init__(self, mesh: Mesh | None = None, min_device_batch: int = 64):
-        super().__init__(min_device_batch=min_device_batch)
+        # use_pallas=False: the sharded path runs the XLA kernel inside
+        # shard_map (portable to the CPU-mesh dryrun; a per-shard Pallas
+        # dispatch on real multi-chip pods is a future optimization)
+        super().__init__(min_device_batch=min_device_batch, use_pallas=False)
         self.mesh = mesh if mesh is not None else default_mesh()
         self._kernel = make_sharded_verify(self.mesh)
         self.name = f"tpu-sharded-{self.mesh.devices.size}"

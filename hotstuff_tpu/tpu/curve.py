@@ -149,12 +149,18 @@ def _select_from_batch_table(table: tuple, nibble) -> Point:
 
 
 def _select_from_const_table(byte) -> Point:
-    """B_TABLE8 select: byte [...batch] -> constant multiples of B."""
+    """B_TABLE8 select: byte [...batch] -> constant multiples of B.
+
+    The 256-way select is a one-hot f32 matmul so it rides the MXU
+    (limb values < 2^13 are f32-exact; the one-hot contraction picks a
+    single entry, so no accumulation error is possible)."""
     onehot = (
         byte[..., None] == jnp.arange(1 << B_WINDOW, dtype=jnp.int32)
-    ).astype(jnp.int32)  # [...batch, 256]
-    tab = jnp.asarray(B_TABLE8)  # [256, 4, 20]
-    sel = jnp.tensordot(onehot, tab, axes=([-1], [0]))  # [...batch, 4, 20]
+    ).astype(jnp.float32)  # [...batch, 256]
+    tab = jnp.asarray(B_TABLE8, dtype=jnp.float32)  # [256, 4, 20]
+    sel = jnp.tensordot(
+        onehot, tab, axes=([-1], [0]), precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)  # [...batch, 4, 20]
     return tuple(sel[..., c, :] for c in range(4))
 
 

@@ -57,6 +57,24 @@ def _verify_kernel(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
     return curve.compressed_equals(p, r_y, r_sign)
 
 
+@partial(jax.jit, static_argnames=())
+def _verify_kernel_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
+    """Same contract as _verify_kernel, with the double-scalar
+    multiplication running in the VMEM-resident Pallas kernel
+    (tpu/pallas_dsm.py).  TPU backend only; batch must be a multiple of
+    pallas_dsm.LANE_TILE (the pad sizes guarantee it)."""
+    from . import pallas_dsm
+
+    p = pallas_dsm.dual_scalar_mult(s_bits, k_bits, (ax, ay, az, at))
+    return curve.compressed_equals(p, r_y, r_sign)
+
+
+# Pallas pad shapes: lane-aligned, capped at 1024 per dispatch (larger
+# batches chunk; each new shape costs a multi-minute Mosaic compile,
+# amortized by the persistent compilation cache).
+PALLAS_PAD_SIZES = (256, 1024)
+
+
 def _bytes_to_limbs(b: bytes, lo_bits: int = 255) -> np.ndarray:
     v = int.from_bytes(b, "little") & ((1 << lo_bits) - 1)
     out = np.zeros(F.NLIMBS, np.int32)
@@ -102,19 +120,67 @@ class BatchVerifier:
     ``min_device_batch=0`` to force everything onto the device (tests
     do, so the kernel path is what's exercised)."""
 
-    def __init__(self, min_device_batch: int = 64):
+    def __init__(self, min_device_batch: int = 64, use_pallas: bool | None = None):
         # pk bytes -> (ax, ay, az, at) limb rows of the negated point, or None
         self._point_cache: dict[bytes, tuple | None] = {}
-        # padded batch shapes; subclasses (e.g. the mesh-sharded verifier)
-        # override so every device gets an equal slice
-        self.pad_sizes: tuple[int, ...] = PAD_SIZES
+        # The Pallas VMEM-resident kernel is the fast path on real TPU
+        # hardware; the XLA kernel is the portable fallback (CPU tests,
+        # sharded-mesh subclass).  use_pallas=None defers autodetection
+        # to the first device dispatch — probing the backend in
+        # __init__ would initialize JAX in every process that merely
+        # CONSTRUCTS a verifier (e.g. small-committee nodes whose
+        # batches all route to the CPU hybrid path and that may not be
+        # able to claim the device at all).
+        self._use_pallas = use_pallas
+        if use_pallas is not None:
+            self.pad_sizes = PALLAS_PAD_SIZES if use_pallas else PAD_SIZES
+        else:
+            self.pad_sizes = None  # resolved with use_pallas
         self.min_device_batch = min_device_batch
         self._cpu = None  # lazy CpuVerifier for small batches
+
+    @property
+    def use_pallas(self) -> bool:
+        if self._use_pallas is None:
+            import os
+
+            self._use_pallas = (
+                jax.default_backend() == "tpu"
+                and not os.environ.get("HOTSTUFF_NO_PALLAS")
+            )
+        return self._use_pallas
+
+    def _padded_sizes(self) -> tuple[int, ...]:
+        if self.pad_sizes is None:
+            self.pad_sizes = PALLAS_PAD_SIZES if self.use_pallas else PAD_SIZES
+        return self.pad_sizes
 
     def precompute(self, pubkeys: list[bytes]) -> None:
         """Decompress + negate committee keys ahead of time (epoch setup)."""
         for pk in pubkeys:
             self._neg_point(pk)
+
+    def warmup(self, batch: int | None = None) -> None:
+        """Compile (or cache-load) the device kernel BEFORE entering the
+        consensus hot path.  A cold Mosaic compile of the Pallas kernel
+        takes minutes — paid here, once, at node boot, instead of on the
+        first QC verify where it would blow through the round timeout.
+
+        ``batch`` is the largest batch the caller expects (the committee
+        size: QC/TC verification batches never exceed it) — warming the
+        shape THAT batch pads to is the point; the min_device_batch
+        floor alone would warm a smaller shape and leave the real QC
+        shape cold."""
+        from ..crypto import ed25519_ref as ref
+
+        seed = b"\x5a" * 32
+        msg = b"hotstuff_tpu verifier warmup"
+        pk = ref.public_from_seed(seed)
+        sig = ref.sign(seed, msg)
+        n = max(batch or 0, self.min_device_batch, 1)  # force device path
+        out = self.verify([msg] * n, [pk] * n, [sig] * n)
+        if not out.all():
+            raise RuntimeError("verifier warmup produced invalid result")
 
     def _neg_point(self, pk: bytes):
         hit = self._point_cache.get(pk)
@@ -142,9 +208,9 @@ class BatchVerifier:
 
                 self._cpu = batch_verify_arrays
             return np.asarray(self._cpu(messages, pubkeys, signatures))
-        if n > self.pad_sizes[-1]:
+        if n > self._padded_sizes()[-1]:
             # split oversized batches into max-shape chunks
-            step = self.pad_sizes[-1]
+            step = self._padded_sizes()[-1]
             return np.concatenate(
                 [
                     self.verify(
@@ -211,7 +277,7 @@ class BatchVerifier:
 
         # pad to a static shape; padding rows are s=0,k=0 -> P=identity,
         # which compresses to y=1,sign=0 — set r_y accordingly so pads pass.
-        padded = next(p for p in self.pad_sizes if p >= n)
+        padded = next(p for p in self._padded_sizes() if p >= n)
         if padded > n:
             pad = padded - n
 
@@ -236,7 +302,8 @@ class BatchVerifier:
 
     def _run_kernel(self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
         """Device dispatch — overridden by the mesh-sharded verifier."""
-        return _verify_kernel(
+        kernel = _verify_kernel_pallas if self.use_pallas else _verify_kernel
+        return kernel(
             jnp.asarray(ax),
             jnp.asarray(ay),
             jnp.asarray(az),

@@ -119,7 +119,7 @@ def _ppass(z):
     )
 
 
-def carry(z):
+def carry(z, passes: int = 4):
     """Reduce any bounded non-negative limb vector (a 39-column product or
     a 20-column sum) to loose-normalized 20-limb form.
 
@@ -129,21 +129,29 @@ def carry(z):
     pass 4 reaches limb0 <= 2^13+608, limbs[1..18] <= 2^13, limb19 <= 256.
     Every pass is a handful of full-width vector ops — no sequential
     carry chain.
+
+    ``passes`` may be lowered by callers whose inputs are tighter than
+    the worst case.  For sums/differences of loose-normalized values
+    (columns < 2^14.7) TWO passes reach the invariant: pass 1 leaves
+    limbs <= 8191 + 3 (limb 0 <= 8191 + 152, limb 19 <= 258), pass 2
+    absorbs the stragglers (limb 0 <= 8191 + 19, limbs <= 8192,
+    limb 19 <= 256) — bounds tested exhaustively at the extremes in
+    tests/test_tpu_field.py.
     """
     if z.shape[-1] > NLIMBS:
         z = _fold39(z)
-    for _ in range(4):
+    for _ in range(passes):
         z = _ppass(z)
     return z
 
 
 def add(a, b):
-    return carry(a + b)
+    return carry(a + b, passes=2)
 
 
 def sub(a, b):
     # a - b + 8p keeps every limb non-negative before the carry passes.
-    return carry(a + (jnp.asarray(SUB_PAD) - b))
+    return carry(a + (jnp.asarray(SUB_PAD) - b), passes=2)
 
 
 # prod[k] = sum_{i+j=k} a_i b_j.  The anti-diagonal collapse rides the
@@ -184,12 +192,39 @@ def mul(a, b):
 
 
 def mul_small(a, k: int):
-    """Multiply by a small non-negative constant (k < 2^17)."""
-    return carry(a * jnp.int32(k))
+    """Multiply by a small non-negative constant (k < 2^17).  k <= 4
+    keeps columns < 2^15.7, within the 2-pass carry regime."""
+    return carry(a * jnp.int32(k), passes=2 if k <= 4 else 4)
+
+
+# Squaring uses the symmetric half of the product: prod[k] =
+# sum_{i<j, i+j=k} 2 a_i a_j + [k even] a_{k/2}^2 — 210 upper-triangle
+# products instead of 400, with the factor 2 folded into the collapse
+# matrix.  Exactness: doubled hi-column sums stay < 2^20 (f32-exact) and
+# the recombined value equals the full convolution, so the mul bound
+# (1.55e9 < 2^31) carries over unchanged.
+_TRI_I, _TRI_J = np.triu_indices(NLIMBS)
+
+
+def _sqr_weights() -> np.ndarray:
+    w = np.zeros((len(_TRI_I), 2 * NLIMBS - 1), np.float32)
+    for t, (i, j) in enumerate(zip(_TRI_I, _TRI_J)):
+        w[t, i + j] = 1.0 if i == j else 2.0
+    return w
+
+
+W_SQR = _sqr_weights()
 
 
 def sqr(a):
-    return mul(a, a)
+    terms = a[..., _TRI_I] * a[..., _TRI_J]  # [..., 210] int32-safe
+    lo = (terms & MASK).astype(jnp.float32)
+    hi = (terms >> LIMB_BITS).astype(jnp.float32)
+    w = jnp.asarray(W_SQR)
+    slo = jnp.dot(lo, w, precision=jax.lax.Precision.HIGHEST)
+    shi = jnp.dot(hi, w, precision=jax.lax.Precision.HIGHEST)
+    prod = slo.astype(jnp.int32) + (shi.astype(jnp.int32) << LIMB_BITS)
+    return carry(prod)
 
 
 def _sqr_n(a, n: int):

@@ -1,0 +1,303 @@
+"""Pallas TPU kernel for the fused double-scalar multiplication.
+
+This is the VMEM-resident rewrite of ``curve.dual_scalar_mult`` — the
+hot loop of batched Ed25519 verification (reference hot spot:
+``Signature::verify_batch``, crypto/src/lib.rs:213-226).  The XLA
+version is HBM-bound: every field op round-trips intermediates through
+HBM, and slope-timing on hardware shows elementwise throughput pinned
+at memory bandwidth.  Here the whole 32-macro-step Straus scan runs
+inside ONE kernel with every intermediate in VMEM.
+
+Layout: limb-major ``[NLIMBS, Bt]`` — the batch tile rides the 128-wide
+lane dimension (full VPU utilization), limbs ride sublanes.  The
+schoolbook-product collapse is a constant one-hot matmul on the MXU
+(``[39, 400] @ [400, Bt]``), exact in f32 by the bound analysis in
+tpu/field.py.  Per-batch table selects use a 4-level tournament of
+``jnp.where`` (15 selects of a [4, 20, Bt] entry vs 16 one-hot
+multiply-adds).  Constant matrices (collapse weights, base-point
+table, curve constant, subtraction pad) are kernel INPUTS — Pallas
+kernels cannot capture traced constants — mapped to block (0, 0) so
+every grid tile reads the same copy.
+
+The kernel computes P = [s]B + [k]A for the whole tile; compressed-
+encoding comparison (pow_inv etc.) stays in the XLA path — it is a few
+percent of total time.  Correctness oracle: ``curve.dual_scalar_mult``
+(itself RFC-8032-vector-tested); parity is tested in interpret mode on
+CPU and on device in tests/test_tpu_ed25519.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import curve, field as F
+
+NL = F.NLIMBS  # 20
+NCOLS = 2 * NL - 1  # 39
+LANE_TILE = 128  # minimum batch tile (lane width)
+BT = 256  # batch tile: [20, 256] int32 = 3x2 vregs per coord
+
+_HIGH = jax.lax.Precision.HIGHEST
+
+# Host-side constants (numpy; shipped to the kernel as inputs).
+_WT = F.W_CONV.T.copy()  # [39, 400] collapse matrix, limb-major
+_BTAB_T = (
+    np.asarray(curve.B_TABLE8, np.float32)  # [256, 4, 20]
+    .reshape(1 << curve.B_WINDOW, 4 * NL)
+    .T.copy()
+)  # [80, 256]; limb values < 2^13+608 are f32-exact
+_D2_COL = curve.D2_LIMBS.reshape(NL, 1)  # curve constant 2d, limb-major
+_SUBPAD_COL = F.SUB_PAD.reshape(NL, 1)
+
+
+class _Env:
+    """Kernel-side handles to the constant inputs."""
+
+    def __init__(self, wt, btab, d2, subpad):
+        self.wt = wt  # [39, 400] f32
+        self.btab = btab  # [80, 256] f32
+        self.d2 = d2  # [NL, 1] int32
+        self.subpad = subpad  # [NL, 1] int32
+
+
+# ---- limb-major field ops (values, not refs; all [NL, Bt]) -----------------
+
+
+def _carry_t(z, passes: int):
+    """Parallel carry passes along axis -2 (the limb axis)."""
+    if z.shape[-2] > NL:
+        lo = z[..., :NL, :]
+        hi = z[..., NL:, :]
+        hi_lo = (hi & F.MASK) * F.FOLD
+        hi_hi = (hi >> F.LIMB_BITS) * F.FOLD
+        nhi = z.shape[-2] - NL
+        pad = [(0, 0)] * (z.ndim - 2)
+        add0 = jnp.pad(hi_lo, pad + [(0, NL - nhi), (0, 0)])
+        add1 = jnp.pad(hi_hi, pad + [(1, NL - nhi - 1), (0, 0)])
+        z = lo + add0 + add1
+    for _ in range(passes):
+        r = jnp.concatenate(
+            [z[..., : NL - 1, :] & F.MASK, z[..., NL - 1 :, :] & F.TOP_MASK],
+            axis=-2,
+        )
+        c = z[..., : NL - 1, :] >> F.LIMB_BITS
+        c_top = (z[..., NL - 1 :, :] >> F.TOP_SHIFT) * F.TOP_FOLD
+        z = jnp.concatenate([r[..., :1, :] + c_top, r[..., 1:, :] + c], axis=-2)
+    return z
+
+
+def _mul_t(env, a, b):
+    """[NL, Bt] x [NL, Bt] -> [NL, Bt]; conv collapse on the MXU."""
+    bt = a.shape[-1]
+    outer = (a[:, None, :] * b[None, :, :]).reshape(NL * NL, bt)
+    lo = (outer & F.MASK).astype(jnp.float32)
+    hi = (outer >> F.LIMB_BITS).astype(jnp.float32)
+    slo = jax.lax.dot(
+        env.wt, lo, precision=_HIGH, preferred_element_type=jnp.float32
+    )
+    shi = jax.lax.dot(
+        env.wt, hi, precision=_HIGH, preferred_element_type=jnp.float32
+    )
+    prod = slo.astype(jnp.int32) + (shi.astype(jnp.int32) << F.LIMB_BITS)
+    return _carry_t(prod, passes=4)
+
+
+def _add_t(a, b):
+    return _carry_t(a + b, passes=2)
+
+
+def _sub_t(env, a, b):
+    return _carry_t(a + (env.subpad - b), passes=2)
+
+
+def _dbl_small_t(a):
+    return _carry_t(a * jnp.int32(2), passes=2)
+
+
+# ---- limb-major point ops: points are [4, NL, Bt] stacks (X, Y, Z, T) ------
+
+
+def _point_add_t(env, p, q):
+    """Unified extended-coordinate addition (add-2008-hwcd-3)."""
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
+    x2, y2, z2, t2 = q[0], q[1], q[2], q[3]
+    a = _mul_t(env, _sub_t(env, y1, x1), _sub_t(env, y2, x2))
+    b = _mul_t(env, _add_t(y1, x1), _add_t(y2, x2))
+    c = _mul_t(env, _mul_t(env, t1, t2), env.d2)
+    d = _dbl_small_t(_mul_t(env, z1, z2))
+    e = _sub_t(env, b, a)
+    f = _sub_t(env, d, c)
+    g = _add_t(d, c)
+    h = _add_t(b, a)
+    return jnp.stack(
+        [_mul_t(env, e, f), _mul_t(env, g, h), _mul_t(env, f, g), _mul_t(env, e, h)]
+    )
+
+
+def _point_double_t(env, p):
+    """dbl-2008-hwcd."""
+    x1, y1, z1 = p[0], p[1], p[2]
+    a = _mul_t(env, x1, x1)
+    b = _mul_t(env, y1, y1)
+    c = _dbl_small_t(_mul_t(env, z1, z1))
+    h = _add_t(a, b)
+    xy = _add_t(x1, y1)
+    e = _sub_t(env, h, _mul_t(env, xy, xy))
+    g = _sub_t(env, a, b)
+    f = _add_t(c, g)
+    return jnp.stack(
+        [_mul_t(env, e, f), _mul_t(env, g, h), _mul_t(env, f, g), _mul_t(env, e, h)]
+    )
+
+
+def _identity_t(bt):
+    zeros = jnp.zeros((NL, bt), jnp.int32)
+    # iota mask instead of .at[].set — scatter has no Mosaic lowering
+    limb0 = jax.lax.broadcasted_iota(jnp.int32, (NL, bt), 0) == 0
+    one = jnp.where(limb0, 1, 0)
+    return jnp.stack([zeros, one, one, zeros])
+
+
+def _tournament_select(entries, nibble):
+    """entries: list of 16 [4, NL, Bt] points; nibble: [1, Bt] int32.
+    4-level tournament of jnp.where — 15 selects instead of 16
+    one-hot multiply-accumulates."""
+    level = entries
+    for bit in range(curve.WINDOW):
+        mask = ((nibble >> bit) & 1)[None, :, :] != 0  # [1, 1, Bt]
+        level = [
+            jnp.where(mask, hi, lo)
+            for lo, hi in zip(level[0::2], level[1::2])
+        ]
+    return level[0]
+
+
+def _select_base_t(env, byte, bt):
+    """Constant-table select via one-hot MXU matmul: [80, 256] @
+    [256, Bt] -> [4, NL, Bt]."""
+    nent = 1 << curve.B_WINDOW
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (nent, bt), 0) == byte
+    ).astype(jnp.float32)
+    sel = jax.lax.dot(
+        env.btab, onehot, precision=_HIGH, preferred_element_type=jnp.float32
+    )
+    return sel.astype(jnp.int32).reshape(4, NL, bt)
+
+
+# ---- the kernel ------------------------------------------------------------
+
+
+def _dsm_kernel(
+    wt, btab, d2, subpad, ax, ay, az, at, s_bytes, k_hi, k_lo, ox, oy, oz, ot
+):
+    """One batch tile: P = [s]B + [k]A.
+
+    wt/btab/d2/subpad: constant inputs (same block for every tile).
+    ax..at: [NL, Bt] limbs of A (the negated public keys).
+    s_bytes: [NWIN/2, Bt] MSB-first 8-bit windows of s.
+    k_hi, k_lo: [NWIN/2, Bt] MSB-first 4-bit window pairs of k.
+    ox..ot: [NL, Bt] output extended coordinates.
+    """
+    env = _Env(wt[:], btab[:], d2[:], subpad[:])
+    bt = ax.shape[-1]
+    a_point = jnp.stack([ax[:], ay[:], az[:], at[:]])
+
+    # A-multiples table [0]A..[15]A (unified add handles the identity)
+    entries = [_identity_t(bt), a_point]
+    for _ in range(2, 1 << curve.WINDOW):
+        entries.append(_point_add_t(env, entries[-1], a_point))
+
+    nsteps = curve.NWIN // 2
+
+    def step(i, acc):
+        # dynamic row reads from the refs (dynamic_slice on values has
+        # no Mosaic lowering; ref indexing with pl.ds does)
+        sb = s_bytes[pl.ds(i, 1), :]  # [1, Bt]
+        wh = k_hi[pl.ds(i, 1), :]
+        wl = k_lo[pl.ds(i, 1), :]
+        for _ in range(curve.WINDOW):
+            acc = _point_double_t(env, acc)
+        acc = _point_add_t(env, acc, _tournament_select(entries, wh))
+        for _ in range(curve.WINDOW):
+            acc = _point_double_t(env, acc)
+        acc = _point_add_t(env, acc, _tournament_select(entries, wl))
+        acc = _point_add_t(env, acc, _select_base_t(env, sb, bt))
+        return acc
+
+    out = jax.lax.fori_loop(0, nsteps, step, _identity_t(bt))
+    ox[:] = out[0]
+    oy[:] = out[1]
+    oz[:] = out[2]
+    ot[:] = out[3]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def dual_scalar_mult(s_win, k_win, a_point, *, interpret: bool = False):
+    """Drop-in for curve.dual_scalar_mult, Pallas-accelerated.
+
+    s_win, k_win: int32 [NWIN, batch] MSB-first 4-bit windows.
+    a_point: (X, Y, Z, T) with coords [batch, NL].
+    Returns (X, Y, Z, T) with coords [batch, NL].
+    batch must be a multiple of LANE_TILE (the BatchVerifier pads).
+    """
+    batch = s_win.shape[1]
+    bt = BT if batch % BT == 0 else LANE_TILE
+    if batch % bt:
+        raise ValueError(f"batch {batch} not a multiple of {bt}")
+
+    # pair 4-bit windows into the kernel's layout
+    s_pairs = s_win.reshape(curve.NWIN // 2, 2, batch)
+    s_bytes = s_pairs[:, 0] * (1 << curve.WINDOW) + s_pairs[:, 1]
+    k_pairs = k_win.reshape(curve.NWIN // 2, 2, batch)
+
+    coords_t = [jnp.transpose(c) for c in a_point]  # [NL, batch]
+
+    grid = (batch // bt,)
+
+    def const_spec(shape):
+        return pl.BlockSpec(
+            shape, lambda i: (0, 0), memory_space=pltpu.VMEM
+        )
+
+    limb_spec = pl.BlockSpec(
+        (NL, bt), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    win_spec = pl.BlockSpec(
+        (curve.NWIN // 2, bt), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct((NL, batch), jnp.int32)
+
+    ox, oy, oz, ot = pl.pallas_call(
+        _dsm_kernel,
+        grid=grid,
+        in_specs=[
+            const_spec(_WT.shape),
+            const_spec(_BTAB_T.shape),
+            const_spec(_D2_COL.shape),
+            const_spec(_SUBPAD_COL.shape),
+        ]
+        + [limb_spec] * 4
+        + [win_spec] * 3,
+        out_specs=[limb_spec] * 4,
+        out_shape=[out_shape] * 4,
+        interpret=interpret,
+    )(
+        jnp.asarray(_WT),
+        jnp.asarray(_BTAB_T),
+        jnp.asarray(_D2_COL),
+        jnp.asarray(_SUBPAD_COL),
+        *coords_t,
+        s_bytes,
+        k_pairs[:, 0],
+        k_pairs[:, 1],
+    )
+
+    return tuple(jnp.transpose(c) for c in (ox, oy, oz, ot))

@@ -128,3 +128,43 @@ def test_committee_precompute_cache(verifier):
     pks = [ref.public_from_seed(bytes([i]) * 32) for i in range(4)]
     verifier.precompute(pks)
     assert all(pk in verifier._point_cache for pk in pks)
+
+
+def test_pallas_dsm_parity_interpret():
+    """The Pallas double-scalar-mult kernel (tpu/pallas_dsm.py) must agree
+    with the XLA path bit-for-bit.  Runs in interpreter mode so the
+    parity check works on the CPU test mesh; on-device coverage comes
+    from the benchmark and the TPU rig."""
+    from hotstuff_tpu.tpu import pallas_dsm
+    from hotstuff_tpu.tpu.ed25519 import _bytes_to_windows_msb
+
+    B = pallas_dsm.LANE_TILE  # minimum lane-aligned batch
+    s_rows = np.stack(
+        [
+            np.frombuffer(
+                rng.randrange(ref.L).to_bytes(32, "little"), np.uint8
+            )
+            for _ in range(B)
+        ]
+    )
+    k_rows = np.stack(
+        [
+            np.frombuffer(
+                rng.randrange(ref.L).to_bytes(32, "little"), np.uint8
+            )
+            for _ in range(B)
+        ]
+    )
+    s_win = jnp.asarray(_bytes_to_windows_msb(s_rows).T)
+    k_win = jnp.asarray(_bytes_to_windows_msb(k_rows).T)
+    pts = [rand_point() for _ in range(B)]
+    a_point = tuple(
+        jnp.asarray(np.stack([curve.point_to_limbs(p)[c] for p in pts]))
+        for c in range(4)
+    )
+
+    x_out = curve.dual_scalar_mult(s_win, k_win, a_point)
+    p_out = pallas_dsm.dual_scalar_mult(s_win, k_win, a_point, interpret=True)
+    canon = jax.jit(F.canonical)
+    for xla, pal in zip(x_out, p_out):
+        assert (np.asarray(canon(xla)) == np.asarray(canon(pal))).all()

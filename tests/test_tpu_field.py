@@ -100,3 +100,42 @@ def test_canonical_and_eq():
 def test_is_odd():
     for x in [0, 1, 2, P - 1, P - 2, rand_int(), rand_int()]:
         assert int(jodd(to_dev(x))) == (x % P) & 1
+
+
+def _loose_max():
+    """The inclusive loose-normalized maxima (field.py invariant)."""
+    m = np.zeros(F.NLIMBS, np.int32)
+    m[0] = (1 << F.LIMB_BITS) + F.FOLD
+    m[1:19] = 1 << F.LIMB_BITS
+    m[19] = 256
+    return m
+
+
+def test_two_pass_carry_extremes():
+    """add/sub/mul_small(k<=4) use 2 carry passes — validate the invariant
+    holds (and values are right) at the exact loose-normalized maxima,
+    the worst case of the bound analysis in field.carry's docstring."""
+    extremes = [
+        _loose_max(),
+        np.zeros(F.NLIMBS, np.int32),
+        F.limbs_from_int(P - 1),
+        F.limbs_from_int(1),
+    ]
+    for a_limbs in extremes:
+        for b_limbs in extremes:
+            a_int = F.int_from_limbs(a_limbs)
+            b_int = F.int_from_limbs(b_limbs)
+            a = jnp.asarray(a_limbs)
+            b = jnp.asarray(b_limbs)
+            for out, want in [
+                (jadd(a, b), (a_int + b_int) % P),
+                (jsub(a, b), (a_int - b_int) % P),
+                (jmul_small(a, 2), a_int * 2 % P),
+                (jmul_small(a, 4), a_int * 4 % P),
+            ]:
+                arr = np.asarray(out)
+                assert arr.min() >= 0
+                assert arr[0] <= (1 << F.LIMB_BITS) + F.FOLD
+                assert arr[1:19].max() <= 1 << F.LIMB_BITS
+                assert arr[19] <= 256
+                assert F.int_from_limbs(arr) % P == want
