@@ -35,7 +35,8 @@ def task_local(args) -> int:
         faults=args.faults, nodes=args.nodes, verifier=args.verifier
     )
     print(summary)
-    _save_result(summary, args.faults, args.nodes, args.rate, args.verifier)
+    _save_result(summary, args.faults, args.nodes, args.rate, args.verifier,
+                 ok=parser.has_window())
     return 0
 
 
@@ -57,7 +58,8 @@ def task_tpu(args) -> int:
             faults=args.faults, nodes=nodes, verifier="tpu"
         )
         print(summary)
-        _save_result(summary, args.faults, nodes, args.rate, "tpu")
+        _save_result(summary, args.faults, nodes, args.rate, "tpu",
+                     ok=parser.has_window())
     return 0
 
 

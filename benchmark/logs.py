@@ -137,6 +137,12 @@ class LogParser:
         duration = max(end - start, 1e-9)
         return self.committed_payloads() / duration, duration
 
+    def has_window(self) -> bool:
+        """True when the run produced a real measurement window (at least
+        one commit) — failed runs must not be appended to results files
+        (the aggregator means every block in a file)."""
+        return bool(self.commits)
+
     def consensus_latency(self) -> float:
         """Mean proposal->commit latency (s)."""
         lat = [

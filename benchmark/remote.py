@@ -220,7 +220,8 @@ class RemoteBench:
                         faults=faults, nodes=nodes, verifier=verifier
                     )
                     print(summary)
-                    save_result(summary, faults, nodes, rate, verifier)
+                    save_result(summary, faults, nodes, rate, verifier,
+                                ok=parser.has_window())
 
 
 __all__ = ["RemoteBench", "TpuVmManager", "Settings", "subprocess"]

@@ -86,14 +86,14 @@ class BenchError(Exception):
     pass
 
 
-def save_result(summary: str, faults, nodes, rate, verifier) -> str:
+def save_result(summary: str, faults, nodes, rate, verifier, ok: bool = True) -> str:
     """Append a SUMMARY block to the results file for this config.
     Append — multiple runs of the same config aggregate (reference
     results files hold ~5 runs each, SURVEY.md §6).  Failed runs
-    (no measurement window at all) are NOT appended: the aggregator
-    means every block in the file, so one zero block would silently
-    drag the config's reported TPS down."""
-    if "Execution time: 0 s" in summary:
+    (``ok=False``: the parser saw no commits, LogParser.has_window) are
+    NOT appended: the aggregator means every block in the file, so one
+    zero block would silently drag the config's reported TPS down."""
+    if not ok:
         Print.warn("run produced no measurement window — result not saved")
         return ""
     os.makedirs(PathMaker.results_path(), exist_ok=True)

@@ -113,23 +113,34 @@ class ConsensusState:
 
 
 class ProposerMessage:
-    """Core -> Proposer commands (reference proposer.rs:17-21)."""
+    """Core -> Proposer commands (reference proposer.rs:17-21).
 
-    __slots__ = ("kind", "round", "qc", "tc", "rounds")
+    ``allow_empty`` (this build's addition): the core sets it when the
+    commit pipeline still holds uncommitted payload-carrying blocks — a
+    leader with an empty payload buffer may then propose an EMPTY block
+    so the 2-chain rule can commit the in-flight payloads within two
+    fast rounds, instead of parking their commit until the producer's
+    next burst arrives (bursty clients otherwise couple commit latency
+    to their burst interval)."""
+
+    __slots__ = ("kind", "round", "qc", "tc", "rounds", "allow_empty")
 
     MAKE = "make"
     CLEANUP = "cleanup"
 
-    def __init__(self, kind, round_=0, qc=None, tc=None, rounds=()):
+    def __init__(self, kind, round_=0, qc=None, tc=None, rounds=(), allow_empty=False):
         self.kind = kind
         self.round = round_
         self.qc = qc
         self.tc = tc
         self.rounds = list(rounds)
+        self.allow_empty = allow_empty
 
     @classmethod
-    def make(cls, round_: Round, qc: QC, tc: TC | None) -> "ProposerMessage":
-        return cls(cls.MAKE, round_=round_, qc=qc, tc=tc)
+    def make(
+        cls, round_: Round, qc: QC, tc: TC | None, allow_empty: bool = False
+    ) -> "ProposerMessage":
+        return cls(cls.MAKE, round_=round_, qc=qc, tc=tc, allow_empty=allow_empty)
 
     @classmethod
     def cleanup(cls, rounds: list[Round]) -> "ProposerMessage":
@@ -167,6 +178,11 @@ class Core:
         self.round: Round = 1
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
+        # Highest payload-carrying block round seen (in-memory latency
+        # hint for allow_empty proposals; resets to 0 on crash recovery,
+        # which merely restores the reference's defer-until-payload
+        # behavior until the next payload block flows through).
+        self.last_payload_round: Round = 0
         self.high_qc: QC = QC.genesis()
         self.timer = Timer(timeout_delay_ms)
         self.aggregator = Aggregator(committee, verifier, self_key=name)
@@ -237,8 +253,15 @@ class Core:
         if not (safety_rule_1 and safety_rule_2):
             return None
 
-        # Ensure we won't vote for contradicting blocks.
+        # Ensure we won't vote for contradicting blocks.  last_voted_round
+        # MUST be durable before the vote can leave this node: a crash
+        # between send and persist would recover a stale value and allow
+        # an equivocating re-vote for these rounds (a BFT safety
+        # violation).  The end-of-loop persist is only a catch-all for
+        # non-safety-critical state; this is the safety-critical write.
         self._increase_last_voted_round(block.round)
+        await self.persist_state()
+        self.state_changed = False
         vote = Vote.for_block(block, self.name)
         vote.signature = await self.signature_service.request_signature(
             vote.digest()
@@ -298,7 +321,12 @@ class Core:
 
     async def _generate_proposal(self, tc: TC | None) -> None:
         await self.tx_proposer.put(
-            ProposerMessage.make(self.round, self.high_qc, tc)
+            ProposerMessage.make(
+                self.round,
+                self.high_qc,
+                tc,
+                allow_empty=self.last_payload_round > self.last_committed_round,
+            )
         )
 
     async def _cleanup_proposer(self, b0: Block, b1: Block, block: Block) -> None:
@@ -352,6 +380,10 @@ class Core:
     async def _local_timeout_round(self) -> None:
         self.log.warning("Timeout reached for round %d", self.round)
         self._increase_last_voted_round(self.round)
+        # durable before the Timeout broadcast, same safety argument as
+        # in _make_vote
+        await self.persist_state()
+        self.state_changed = False
         timeout = Timeout(high_qc=self.high_qc, round=self.round, author=self.name)
         timeout.signature = await self.signature_service.request_signature(
             timeout.digest()
@@ -377,6 +409,23 @@ class Core:
         b0, b1 = ancestors
 
         await self.store_block(block)
+        if block.payloads and block.round > self.last_payload_round:
+            self.last_payload_round = block.round
+            # If we lead the current round and our Make went out before
+            # this payload block was processed (votes can overtake the
+            # proposal), the proposer may be sitting on a deferred Make
+            # with a stale allow_empty=False — with an idle producer the
+            # commit would then wait out the full view-change timeout.
+            # Re-issue; the proposer drops it if a block for this round
+            # was already made.  Skip the TC edge (high_qc not adjacent):
+            # re-issuing without the original TC would propose a block
+            # followers refuse to vote for.
+            if (
+                self.name == self.leader_elector.get_leader(self.round)
+                and self.high_qc.round + 1 == self.round
+                and self.last_payload_round > self.last_committed_round
+            ):
+                await self._generate_proposal(None)
         await self._cleanup_proposer(b0, b1, block)
 
         # 2-chain commit rule.
@@ -463,6 +512,21 @@ class Core:
                         await self._dispatch(message)
                     except ConsensusError as e:
                         self.log.warning("%s", e)
+                    # burst drain: handle whatever queued while the
+                    # handler ran in THIS wake-up — re-arming a fresh
+                    # get() task per message costs a task create + two
+                    # switches each, which under load dominates the loop.
+                    # Bounded so a message flood cannot starve the timer
+                    # branch.
+                    for _ in range(64):
+                        try:
+                            message = self.rx_message.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        try:
+                            await self._dispatch(message)
+                        except ConsensusError as e:
+                            self.log.warning("%s", e)
                 if loop_task in done:
                     block = loop_task.result()
                     loop_task = asyncio.ensure_future(self.rx_loopback.get())
@@ -470,6 +534,15 @@ class Core:
                         await self._process_block(block)
                     except ConsensusError as e:
                         self.log.warning("%s", e)
+                    for _ in range(64):
+                        try:
+                            block = self.rx_loopback.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        try:
+                            await self._process_block(block)
+                        except ConsensusError as e:
+                            self.log.warning("%s", e)
                 if timer_task in done:
                     timer_task = asyncio.ensure_future(self.timer.wait())
                     # skip stale fires: a message handled above may have

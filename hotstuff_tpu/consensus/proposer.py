@@ -70,6 +70,12 @@ class Proposer:
         self.pending: deque[Digest] = deque()
         self.seen: OrderedDict[Digest, None] = OrderedDict()
         self.deferred: ProposerMessage | None = None
+        # Highest round a block was actually created for: re-issued Makes
+        # for the same round are dropped, so (a) the core may safely
+        # re-send a Make when allow_empty conditions change, and (b) this
+        # node can never produce two blocks for one round (leader
+        # equivocation guard).
+        self.last_made_round: Round = 0
         self.network = network if network is not None else ReliableSender()
         self._task: asyncio.Task | None = None
         self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
@@ -84,14 +90,22 @@ class Proposer:
             self.seen.popitem(last=False)
         self.pending.append(digest)
 
-    async def _make_block(self, round_: Round, qc: QC, tc: TC | None) -> None:
-        if not self.pending:
+    async def _make_block(
+        self, round_: Round, qc: QC, tc: TC | None, allow_empty: bool = False
+    ) -> None:
+        if round_ <= self.last_made_round:
+            return  # already proposed for this round (equivocation guard)
+        if not self.pending and not allow_empty:
             # Defer: fire the moment the next payload arrives instead of
             # wedging the round until the view-change timer (see module
             # docstring).  A newer Make supersedes this one.
             self.deferred = ProposerMessage.make(round_, qc, tc)
             self.log.info("Round: %d, no payloads yet - proposal deferred", round_)
             return
+        # allow_empty: the core signalled that uncommitted payload blocks
+        # are in flight — an empty block advances the 2-chain so they
+        # commit now rather than on the producer's next burst.
+        self.last_made_round = round_
         take = min(len(self.pending), MAX_BLOCK_PAYLOADS)
         payloads = tuple(self.pending.popleft() for _ in range(take))
 
@@ -171,7 +185,10 @@ class Proposer:
                     if message.kind == ProposerMessage.MAKE:
                         self.deferred = None  # superseded
                         await self._make_block(
-                            message.round, message.qc, message.tc
+                            message.round,
+                            message.qc,
+                            message.tc,
+                            message.allow_empty,
                         )
                     else:
                         # Cleanup(rounds): the chain advanced through these

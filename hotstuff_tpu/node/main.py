@@ -30,8 +30,15 @@ LEVELS = [logging.ERROR, logging.WARNING, logging.INFO, logging.DEBUG]
 
 
 def setup_logging(verbosity: int) -> None:
+    import os
+
+    # HOTSTUFF_LOG_LEVEL overrides the -v count (harness runs pin -vv for
+    # the log-scrape contract; this lets an operator crank one run to
+    # DEBUG without editing the harness)
+    env = os.environ.get("HOTSTUFF_LOG_LEVEL", "")
+    level = getattr(logging, env.upper(), None) if env else None
     logging.basicConfig(
-        level=LEVELS[min(verbosity, 3)],
+        level=level if level is not None else LEVELS[min(verbosity, 3)],
         format="%(asctime)s.%(msecs)03dZ [%(levelname)s] %(name)s %(message)s",
         datefmt="%Y-%m-%dT%H:%M:%S",
     )

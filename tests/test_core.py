@@ -185,3 +185,67 @@ async def test_recovery_resumes_round(tmp_path):
     assert h.core.last_voted_round == 6
     assert h.core.last_committed_round == 5
     teardown(h)
+
+
+@async_test
+async def test_allow_empty_proposal_when_payloads_in_flight(tmp_path):
+    """A Make issued while payload-carrying blocks are uncommitted sets
+    allow_empty, so the next leader can advance the 2-chain with an empty
+    block instead of parking the commit until the producer's next burst
+    (this build's latency fix; the reference always defers,
+    proposer.rs:74-78)."""
+    base = fresh_base_port()
+    h = make_core(tmp_path, base, name_idx=3)  # leader of round 3
+    blocks = chain(2)  # payload-carrying blocks for rounds 1..2
+    h.core.spawn()
+    for b in blocks:
+        await h.rx_message.put((TAG_PROPOSE, b))
+
+    # voting on b2 as round-3 leader needs 2f+1 votes to form the QC
+    for pk, sk in keys()[:3]:
+        await h.rx_message.put((TAG_VOTE, signed_vote(blocks[1], pk, sk)))
+
+    while True:
+        message: ProposerMessage = await asyncio.wait_for(
+            h.tx_proposer.get(), timeout=2.0
+        )
+        if message.kind == ProposerMessage.MAKE:
+            break
+    assert message.round == 3
+    # blocks 1..2 carry payloads and nothing is committed yet
+    assert message.allow_empty
+    teardown(h)
+
+
+@async_test
+async def test_proposer_makes_empty_block_when_allowed():
+    """Proposer with an empty buffer: allow_empty Make emits an empty
+    block on the loopback; without allow_empty it defers."""
+    from hotstuff_tpu.consensus.proposer import Proposer
+
+    name, secret = keys()[0]
+    com = committee(fresh_base_port())
+    loopback: asyncio.Queue = asyncio.Queue()
+    proposer = Proposer(
+        name,
+        com,
+        SignatureService(secret),
+        rx_producer=asyncio.Queue(),
+        rx_message=asyncio.Queue(),
+        tx_loopback=loopback,
+    )
+    from hotstuff_tpu.consensus.messages import QC
+
+    # deferred: no payloads, allow_empty=False
+    await proposer._make_block(5, QC.genesis(), None, allow_empty=False)
+    assert proposer.deferred is not None and loopback.empty()
+
+    # allow_empty=True -> an empty block is created and looped back
+    # (broadcast ACK-wait is cancelled on shutdown; peers are not up)
+    task = asyncio.ensure_future(
+        proposer._make_block(5, QC.genesis(), None, allow_empty=True)
+    )
+    block = await asyncio.wait_for(loopback.get(), timeout=2.0)
+    assert block.round == 5 and block.payloads == ()
+    task.cancel()
+    proposer.shutdown()
