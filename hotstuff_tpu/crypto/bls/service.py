@@ -28,12 +28,23 @@ from . import (
 
 
 class BlsVerifier:
-    """VerifierBackend over BLS bytes; caches decoded public keys."""
+    """VerifierBackend over BLS bytes; caches decoded public keys.
+
+    ``aggregator="tpu"`` runs the G1 signature sum on device
+    (hotstuff_tpu/tpu/bls.py — the psum-shaped reduction of
+    docs/BLS_TPU_DESIGN.md); the pairing equality stays on the host in
+    both modes, one constant-cost call per QC."""
 
     name = "bls-cpu"
 
-    def __init__(self):
+    def __init__(self, aggregator: str = "cpu"):
         self._pk_cache: dict[bytes, BlsPublicKey | None] = {}
+        self._tpu_agg = None
+        if aggregator == "tpu":
+            from ...tpu.bls import TpuG1Aggregator
+
+            self._tpu_agg = TpuG1Aggregator()
+            self.name = "bls-tpu"
 
     def _pk(self, pk_bytes: bytes) -> BlsPublicKey | None:
         if pk_bytes not in self._pk_cache:
@@ -67,7 +78,12 @@ class BlsVerifier:
             sigs.append(s)
         if not pks:
             return False
-        agg_sig = aggregate_signatures(sigs)
+        if self._tpu_agg is not None:
+            agg_sig = BlsSignature(
+                self._tpu_agg.aggregate([s.point for s in sigs])
+            )
+        else:
+            agg_sig = aggregate_signatures(sigs)
         return aggregate_public_keys(pks).verify(msg, agg_sig)
 
     def verify_many(self, digests, pks, sigs) -> list[bool]:

@@ -121,7 +121,13 @@ async def run_client(
     from ..consensus.wire import encode_producer
 
     log.info("Waiting for all nodes to be online...")
-    live = await wait_for_nodes(addresses, expect_faults=expect_faults)
+    # Boot time scales with committee size when many node processes share
+    # few cores (each pays interpreter+import startup): give large
+    # committees a proportionally longer grace window.
+    boot_timeout = max(15.0, 3.0 * len(addresses))
+    live = await wait_for_nodes(
+        addresses, timeout=boot_timeout, expect_faults=expect_faults
+    )
     if not live:
         log.error("No nodes reachable")
         return 0

@@ -25,19 +25,68 @@ from .config import Secret, read_committee, read_parameters
 log = logging.getLogger(__name__)
 
 
+class LazyDeviceVerifier:
+    """Defers the jax/numpy import (seconds of interpreter time per node
+    process, serialized across a co-located committee sharing few cores)
+    until a batch is actually big enough for the device.  Small batches
+    route to the CPU backend exactly like the device verifier's own
+    hybrid routing, so committees whose batches never reach
+    ``min_device_batch`` boot and run without ever importing jax."""
+
+    min_device_batch = 64
+
+    def __init__(self, kind: str):
+        self._kind = kind
+        self._cpu = CpuVerifier()
+        self._device: VerifierBackend | None = None
+        self._precomputed: list[bytes] = []
+        self.name = kind
+
+    def _materialize(self) -> VerifierBackend:
+        if self._device is None:
+            if self._kind == "tpu":
+                from ..tpu.ed25519 import BatchVerifier
+
+                self._device = BatchVerifier(
+                    min_device_batch=self.min_device_batch
+                )
+            else:  # tpu-sharded: batch sharded over every visible device
+                from ..parallel.mesh import ShardedBatchVerifier
+
+                self._device = ShardedBatchVerifier(
+                    min_device_batch=self.min_device_batch
+                )
+            if self._precomputed:
+                self._device.precompute(self._precomputed)
+        return self._device
+
+    def precompute(self, pubkeys: list[bytes]) -> None:
+        self._precomputed = list(pubkeys)
+        if self._device is not None:
+            self._device.precompute(pubkeys)
+
+    def warmup(self, batch: int | None = None) -> None:
+        self._materialize().warmup(batch)
+
+    def verify_one(self, digest, pk, sig) -> bool:
+        return self._cpu.verify_one(digest, pk, sig)
+
+    def verify_shared_msg(self, digest, votes) -> bool:
+        if len(votes) < self.min_device_batch:
+            return self._cpu.verify_shared_msg(digest, votes)
+        return self._materialize().verify_shared_msg(digest, votes)
+
+    def verify_many(self, digests, pks, sigs) -> list[bool]:
+        if len(digests) < self.min_device_batch:
+            return self._cpu.verify_many(digests, pks, sigs)
+        return self._materialize().verify_many(digests, pks, sigs)
+
+
 def make_verifier(kind: str) -> VerifierBackend:
     if kind == "cpu":
         return CpuVerifier()
-    if kind == "tpu":
-        from ..tpu.ed25519 import BatchVerifier
-
-        return BatchVerifier()
-    if kind == "tpu-sharded":
-        # batch sharded over every visible device (multi-chip execution;
-        # on one chip this degenerates to the plain TPU backend's shape)
-        from ..parallel.mesh import ShardedBatchVerifier
-
-        return ShardedBatchVerifier()
+    if kind in ("tpu", "tpu-sharded"):
+        return LazyDeviceVerifier(kind)
     raise ValueError(f"unknown verifier backend '{kind}'")
 
 

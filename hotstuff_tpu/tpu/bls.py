@@ -1,0 +1,284 @@
+"""BLS12-381 G1 aggregation on TPU — the threshold-variant device path.
+
+Implements the device side of docs/BLS_TPU_DESIGN.md: batched G1 point
+aggregation (the psum-shaped reduction that makes BLS QC verification
+scale with committee size), leaving the per-QC pairing equality on the
+host (crypto/bls/pairing.py), where it is one constant-cost call.
+
+Two design changes vs the original design note, found during
+implementation:
+
+1. **Field reduction.**  The note proposed reusing the Ed25519
+   fold-constant reduction with a fold *vector* for q.  That does not
+   converge: q is not pseudo-Mersenne, so 2^390 mod q is itself 381 bits
+   and each fold pass removes only ~9 bits.  Fq instead uses
+   **Montgomery arithmetic in CIOS form, vectorized over the batch**:
+   30 limbs of 13 bits (30x13 = 390 >= 381) in int32.  The limb
+   recurrence is sequential (30 steps, each a full-width batched
+   multiply-accumulate) with lazy column accumulators; only the limb-0
+   carry is propagated exactly per step (the quotient digit m needs just
+   the exact low 13 bits: m = ((t0 & MASK) * mu) & MASK), and a parallel
+   carry pass every 8 steps keeps every column inside int32.
+
+2. **Point formulas.**  Jacobian addition needs P==Q / P==-Q / identity
+   case analysis, and deciding "h == 0 (mod q)" on device costs a full
+   canonicalization per addition.  Instead points are homogeneous
+   projective (X : Y : Z) with the **complete addition formulas of
+   Renes-Costello-Batina 2015 (Algorithm 7, a = 0, b3 = 12)** — one
+   branchless 12-mul formula valid for EVERY input pair in the
+   prime-order subgroup, identity (0 : 1 : 0) included.  Aggregation
+   inputs are vote signatures, which the CPU layer subgroup-checks on
+   deserialization, so completeness holds.
+
+Arithmetic is SIGNED-LOOSE end to end: values are congruences mod q
+with limbs a hair over 13 bits (possibly negative — two's-complement
+``& MASK`` and arithmetic ``>>`` keep every CIOS step algebraically
+exact for signed values), ops end with one parallel carry pass (no
+sequential chains, no conditional subtractions, no subtraction pads on
+device — tiny XLA graphs), and canonicalization happens once on the
+host after the aggregate is fetched (``from_mont_int`` reduces mod q).
+
+Magnitude audit (worst case in point_add): REDC outputs are < 1.5q;
+the deepest add/sub/x12 chain is y3 = 12*(REDC - (REDC + REDC)),
+magnitude < 12*(1.5q + 3q) = 54q, fed back into mont_mul.  REDC with
+R/q = 2^390/q > 500 maps products of such inputs (|ab| < 54q * 20q <
+2^773) to outputs < |ab|/R + q < 3.2q — still far below R, so the
+recursion is stable.  Limb magnitudes: one carry pass bounds limbs by
+2^13 + (peak column >> 13); the x12 scaling peaks columns at ~2^17,
+so loose limbs stay < 2^13 + 2^5.  CIOS columns accumulate at most
+8 steps * 2 products * (2^13.1)^2 + residual 2^19 < 2^31.
+
+Correctness oracle: the pure-Python backend (crypto/bls/curve.py),
+tested in tests/test_tpu_bls.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.curve import G1Point
+from ..crypto.bls.fields import P as Q
+
+NLIMBS = 30
+LIMB_BITS = 13
+MASK = (1 << LIMB_BITS) - 1
+NCOLS = NLIMBS + 2  # lazy CIOS accumulator columns (carry headroom)
+
+RADIX = 1 << (NLIMBS * LIMB_BITS)  # 2^390
+R_MONT = RADIX % Q
+# mu = -q^{-1} mod 2^13 (the CIOS per-limb quotient constant)
+MU = (-pow(Q, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+_CARRY_EVERY = 8
+B3 = 12  # 3*b for y^2 = x^3 + 4
+
+
+def _int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    assert x == 0
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr.tolist()))
+
+
+Q_LIMBS = _int_to_limbs(Q)
+Q_EXT = np.concatenate([Q_LIMBS, np.zeros(NCOLS - NLIMBS, np.int32)])
+
+# Fold vectors for the two overflow columns of the CIOS accumulator:
+# parallel carry passes move carries UP into columns 30/31 and never
+# back down, so the final normalization folds their content into the
+# low 30 limbs mod q.  Weights: col 30 = 2^390, its >>13 half and
+# col 31 = 2^403, col 31's >>13 half = 2^416.
+_C390 = _int_to_limbs((1 << 390) % Q)
+_C403 = _int_to_limbs((1 << 403) % Q)
+_C416 = _int_to_limbs((1 << 416) % Q)
+
+
+def to_mont_limbs(x: int) -> np.ndarray:
+    """Host: integer mod q -> Montgomery-form limb vector."""
+    return _int_to_limbs((x % Q) * R_MONT % Q)
+
+
+def from_mont_int(limbs) -> int:
+    """Host: loose Montgomery-form limbs -> canonical integer mod q."""
+    return limbs_to_int(limbs) * pow(R_MONT, -1, Q) % Q
+
+
+def _pass(t):
+    """One parallel carry pass.  The TOP limb accumulates its incoming
+    carry unmasked (values stay < 2^390-ish; masking would drop bits),
+    growing by a few units per pass — harmless for int32."""
+    r = jnp.concatenate([t[..., :-1] & MASK, t[..., -1:]], axis=-1)
+    c = t[..., :-1] >> LIMB_BITS
+    pad_cfg = [(0, 0)] * (t.ndim - 1)
+    return r + jnp.pad(c, pad_cfg + [(1, 0)])[..., : t.shape[-1]]
+
+
+def mont_mul(a, b):
+    """Batched Montgomery product of signed-loose inputs (|value| < ~60q,
+    |limb| < 2^13.1 — see the module docstring's magnitude audit).
+    Output magnitude < 3.2q, loose limbs.  a, b: int32 [..., NLIMBS]."""
+    pad_cfg = [(0, 0)] * (a.ndim - 1)
+    b_ext = jnp.pad(b, pad_cfg + [(0, NCOLS - NLIMBS)])
+    q_ext = jnp.asarray(Q_EXT)
+    mu = jnp.int32(MU)
+    t = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (NCOLS,), jnp.int32)
+
+    for i in range(NLIMBS):
+        t = t + a[..., i : i + 1] * b_ext
+        m = ((t[..., :1] & MASK) * mu) & MASK
+        t = t + m * q_ext
+        # t0 is now ≡ 0 mod 2^13; propagate its exact carry and shift
+        # the limb window down one position
+        carry0 = t[..., :1] >> LIMB_BITS
+        t = jnp.concatenate(
+            [t[..., 1:2] + carry0, t[..., 2:], jnp.zeros_like(t[..., :1])],
+            axis=-1,
+        )
+        if (i % _CARRY_EVERY) == _CARRY_EVERY - 1:
+            t = _pass(t)
+
+    t = _pass(_pass(t))
+    # fold the overflow columns (carry residue parked above limb 29 by
+    # the upward-only passes) back into the 30-limb window mod q —
+    # dropping them loses k*2^390 ≡ k*R, i.e. an off-by-k in the value
+    # domain.  Signed split keeps every product < 2^26.
+    c30 = t[..., NLIMBS : NLIMBS + 1]
+    c31 = t[..., NLIMBS + 1 : NLIMBS + 2]
+    lo30, hi30 = c30 & MASK, c30 >> LIMB_BITS
+    lo31, hi31 = c31 & MASK, c31 >> LIMB_BITS
+    head = (
+        t[..., :NLIMBS]
+        + lo30 * jnp.asarray(_C390)
+        + (hi30 + lo31) * jnp.asarray(_C403)
+        + hi31 * jnp.asarray(_C416)
+    )
+    return _pass(_pass(head))
+
+
+def madd(a, b):
+    return _pass(a + b)
+
+
+def msub(a, b):
+    # signed-loose: negative limbs/values are fine (see module docstring)
+    return _pass(a - b)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small non-negative integer constant (k <= 16:
+    loose limbs * 16 < 2^18, one pass restores looseness).  Montgomery
+    form is linear, so plain integer scaling stays in-form."""
+    return _pass(a * jnp.int32(k))
+
+
+# ---- complete projective G1 (Renes-Costello-Batina 2015, Alg. 7) -----------
+# Point = (X, Y, Z) loose Montgomery limb arrays; identity = (0 : 1 : 0).
+
+
+def point_add(p, q):
+    """Complete addition: valid for every pair of subgroup points,
+    including P == Q, P == -Q, and either operand at infinity."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = mont_mul(x1, x2)
+    t1 = mont_mul(y1, y2)
+    t2 = mont_mul(z1, z2)
+    t3 = mont_mul(madd(x1, y1), madd(x2, y2))
+    t3 = msub(t3, madd(t0, t1))
+    t4 = mont_mul(madd(y1, z1), madd(y2, z2))
+    t4 = msub(t4, madd(t1, t2))
+    x3 = mont_mul(madd(x1, z1), madd(x2, z2))
+    y3 = msub(x3, madd(t0, t2))
+    x3 = madd(t0, t0)
+    t0 = madd(x3, t0)
+    t2 = mul_small(t2, B3)
+    z3 = madd(t1, t2)
+    t1 = msub(t1, t2)
+    y3 = mul_small(y3, B3)
+    x3 = mont_mul(t4, y3)
+    t2 = mont_mul(t3, t1)
+    x3 = msub(t2, x3)
+    y3 = mont_mul(y3, t0)
+    t1 = mont_mul(t1, z3)
+    y3 = madd(t1, y3)
+    t0 = mont_mul(t0, t3)
+    z3 = mont_mul(z3, t4)
+    z3 = madd(z3, t0)
+    return (x3, y3, z3)
+
+
+@partial(jax.jit, static_argnames=())
+def _aggregate_kernel(xs, ys, zs):
+    """Tree-reduce a [B, NLIMBS] batch of projective points to one point.
+    B must be a power of two (callers pad with the identity)."""
+    p = (xs, ys, zs)
+    while p[0].shape[0] > 1:
+        half = p[0].shape[0] // 2
+        p = point_add(
+            tuple(c[:half] for c in p), tuple(c[half:] for c in p)
+        )
+    return tuple(c[0] for c in p)
+
+
+# ---- host driver ------------------------------------------------------------
+
+
+class TpuG1Aggregator:
+    """Aggregate G1 points (vote signatures) on device.
+
+    The device does the O(n) part (the point sum); the caller feeds the
+    resulting aggregate into the host pairing check — one constant-cost
+    pairing per QC regardless of committee size (docs/BLS_TPU_DESIGN.md).
+
+    Inputs must be subgroup points (the CPU deserialization layer
+    checks; completeness of the addition formula depends on it)."""
+
+    PAD_SIZES = (8, 32, 128, 512)
+
+    def aggregate(self, points: list[G1Point]) -> G1Point:
+        real = [pt for pt in points if not pt.inf]
+        if not real:
+            return G1Point.identity()
+        padded = next(
+            (s for s in self.PAD_SIZES if s >= len(real)),
+            1 << (len(real) - 1).bit_length(),
+        )
+        xs = np.zeros((padded, NLIMBS), np.int32)
+        ys = np.zeros((padded, NLIMBS), np.int32)
+        zs = np.zeros((padded, NLIMBS), np.int32)
+        one = to_mont_limbs(1)
+        for i, pt in enumerate(real):
+            xs[i] = to_mont_limbs(pt.x)
+            ys[i] = to_mont_limbs(pt.y)
+            zs[i] = one
+        for i in range(len(real), padded):
+            ys[i] = one  # identity rows: (0 : 1 : 0)
+
+        x, y, z = _aggregate_kernel(
+            jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs)
+        )
+        return self._projective_to_affine(
+            np.asarray(x), np.asarray(y), np.asarray(z)
+        )
+
+    @staticmethod
+    def _projective_to_affine(x, y, z) -> G1Point:
+        zi = from_mont_int(z)
+        if zi == 0:
+            return G1Point.identity()
+        xi = from_mont_int(x)
+        yi = from_mont_int(y)
+        z_inv = pow(zi, Q - 2, Q)
+        return G1Point(xi * z_inv % Q, yi * z_inv % Q)
