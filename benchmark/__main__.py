@@ -105,6 +105,18 @@ def task_remote_bench(args) -> int:
     return 0
 
 
+def task_logs(args) -> int:
+    """Re-parse an existing logs directory and print the SUMMARY
+    (reference fabfile.py `logs` task)."""
+    from .logs import LogParser
+
+    parser = LogParser.process(args.dir)
+    # faults/verifier are not recoverable from logs — print '?' rather
+    # than plausible-looking defaults; node count = number of node logs
+    print(parser.result(faults="?", nodes=parser.num_node_logs, verifier="?"))
+    return 0
+
+
 def task_aggregate(_args) -> int:
     print_summary(aggregate())
     return 0
@@ -138,6 +150,10 @@ def main(argv=None) -> int:
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
     p.set_defaults(fn=task_tpu)
+
+    p = sub.add_parser("logs")
+    p.add_argument("--dir", default=PathMaker.logs_path())
+    p.set_defaults(fn=task_logs)
 
     p = sub.add_parser("aggregate")
     p.set_defaults(fn=task_aggregate)

@@ -58,6 +58,7 @@ class LogParser:
         """Args are the log *contents* (one string per file)."""
         if not node_logs:
             raise BenchError("No node logs to parse")
+        self.num_node_logs = len(node_logs)
 
         # merged earliest observation per block digest
         self.proposals: dict[str, float] = {}

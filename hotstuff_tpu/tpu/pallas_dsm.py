@@ -124,8 +124,13 @@ def _dbl_small_t(a):
 # ---- limb-major point ops: points are [4, NL, Bt] stacks (X, Y, Z, T) ------
 
 
-def _point_add_t(env, p, q):
-    """Unified extended-coordinate addition (add-2008-hwcd-3)."""
+def _point_add_t(env, p, q, need_t: bool = True):
+    """Unified extended-coordinate addition (add-2008-hwcd-3).
+
+    ``need_t=False`` skips producing the T coordinate (one mul):
+    doublings ignore their input's T, so an addition feeding a doubling
+    run — or the final scan output, which only X/Y/Z reach — never
+    needs it.  The slot is zero-filled to keep the carry shape."""
     x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
     x2, y2, z2, t2 = q[0], q[1], q[2], q[3]
     a = _mul_t(env, _sub_t(env, y1, x1), _sub_t(env, y2, x2))
@@ -136,13 +141,16 @@ def _point_add_t(env, p, q):
     f = _sub_t(env, d, c)
     g = _add_t(d, c)
     h = _add_t(b, a)
+    t_out = _mul_t(env, e, h) if need_t else jnp.zeros_like(e)
     return jnp.stack(
-        [_mul_t(env, e, f), _mul_t(env, g, h), _mul_t(env, f, g), _mul_t(env, e, h)]
+        [_mul_t(env, e, f), _mul_t(env, g, h), _mul_t(env, f, g), t_out]
     )
 
 
-def _point_double_t(env, p):
-    """dbl-2008-hwcd."""
+def _point_double_t(env, p, need_t: bool = True):
+    """dbl-2008-hwcd.  ``need_t=False`` as in _point_add_t: only the
+    LAST doubling of a run (whose output feeds an addition) must
+    produce T."""
     x1, y1, z1 = p[0], p[1], p[2]
     a = _mul_t(env, x1, x1)
     b = _mul_t(env, y1, y1)
@@ -152,8 +160,9 @@ def _point_double_t(env, p):
     e = _sub_t(env, h, _mul_t(env, xy, xy))
     g = _sub_t(env, a, b)
     f = _add_t(c, g)
+    t_out = _mul_t(env, e, h) if need_t else jnp.zeros_like(e)
     return jnp.stack(
-        [_mul_t(env, e, f), _mul_t(env, g, h), _mul_t(env, f, g), _mul_t(env, e, h)]
+        [_mul_t(env, e, f), _mul_t(env, g, h), _mul_t(env, f, g), t_out]
     )
 
 
@@ -223,13 +232,18 @@ def _dsm_kernel(
         sb = s_bytes[pl.ds(i, 1), :]  # [1, Bt]
         wh = k_hi[pl.ds(i, 1), :]
         wl = k_lo[pl.ds(i, 1), :]
-        for _ in range(curve.WINDOW):
-            acc = _point_double_t(env, acc)
-        acc = _point_add_t(env, acc, _tournament_select(entries, wh))
-        for _ in range(curve.WINDOW):
-            acc = _point_double_t(env, acc)
+        # need_t schedule: doublings ignore input T, additions consume
+        # it — so only the last doubling of each run and the addition
+        # feeding another addition produce T (8 muls saved per step)
+        for j in range(curve.WINDOW):
+            acc = _point_double_t(env, acc, need_t=j == curve.WINDOW - 1)
+        acc = _point_add_t(
+            env, acc, _tournament_select(entries, wh), need_t=False
+        )
+        for j in range(curve.WINDOW):
+            acc = _point_double_t(env, acc, need_t=j == curve.WINDOW - 1)
         acc = _point_add_t(env, acc, _tournament_select(entries, wl))
-        acc = _point_add_t(env, acc, _select_base_t(env, sb, bt))
+        acc = _point_add_t(env, acc, _select_base_t(env, sb, bt), need_t=False)
         return acc
 
     out = jax.lax.fori_loop(0, nsteps, step, _identity_t(bt))
@@ -245,7 +259,10 @@ def dual_scalar_mult(s_win, k_win, a_point, *, interpret: bool = False):
 
     s_win, k_win: int32 [NWIN, batch] MSB-first 4-bit windows.
     a_point: (X, Y, Z, T) with coords [batch, NL].
-    Returns (X, Y, Z, T) with coords [batch, NL].
+    Returns (X, Y, Z, T) with coords [batch, NL] — T is NOT computed
+    (zeros): the only consumer, compressed_equals, reads X/Y/Z, and the
+    scan's need_t schedule skips the final extended coordinate (one mul
+    per point op saved).
     batch must be a multiple of LANE_TILE (the BatchVerifier pads).
     """
     batch = s_win.shape[1]

@@ -166,5 +166,7 @@ def test_pallas_dsm_parity_interpret():
     x_out = curve.dual_scalar_mult(s_win, k_win, a_point)
     p_out = pallas_dsm.dual_scalar_mult(s_win, k_win, a_point, interpret=True)
     canon = jax.jit(F.canonical)
-    for xla, pal in zip(x_out, p_out):
+    # X, Y, Z only: the pallas kernel's need_t schedule leaves T
+    # uncomputed (compressed_equals never reads it)
+    for xla, pal in list(zip(x_out, p_out))[:3]:
         assert (np.asarray(canon(xla)) == np.asarray(canon(pal))).all()
