@@ -29,6 +29,7 @@ def task_local(args) -> int:
         faults=args.faults,
         timeout_delay=args.timeout_delay,
         verifier=args.verifier,
+        transport=args.transport,
     )
     parser = bench.run()
     summary = parser.result(
@@ -141,6 +142,7 @@ def main(argv=None) -> int:
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
     p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
+    p.add_argument("--transport", choices=["asyncio", "native"], default="asyncio")
     p.set_defaults(fn=task_local)
 
     p = sub.add_parser("tpu")

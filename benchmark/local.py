@@ -37,6 +37,7 @@ class LocalBench:
         timeout_delay: int = 5_000,
         sync_retry_delay: int = 10_000,
         verifier: str = "cpu",
+        transport: str = "asyncio",
         base_port: int = BASE_PORT,
     ):
         self.nodes = nodes
@@ -46,6 +47,7 @@ class LocalBench:
         self.timeout_delay = timeout_delay
         self.sync_retry_delay = sync_retry_delay
         self.verifier = verifier
+        self.transport = transport
         self.base_port = base_port
         self._procs: list[subprocess.Popen] = []
 
@@ -146,6 +148,8 @@ class LocalBench:
                         PathMaker.parameters_file(),
                         "--verifier",
                         self.verifier,
+                        "--transport",
+                        self.transport,
                     ],
                     PathMaker.node_log_file(i),
                 )

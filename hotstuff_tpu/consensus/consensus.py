@@ -95,6 +95,7 @@ class Consensus:
         tx_commit: asyncio.Queue,
         verifier: VerifierBackend | None = None,
         bind_host: str = "0.0.0.0",
+        transport: str = "asyncio",
     ) -> "Consensus":
         self = cls()
         # NOTE: this log entry is used to compute performance.
@@ -114,7 +115,25 @@ class Consensus:
             raise ValueError("Our public key is not in the committee")
         # Bind on all interfaces, listen on our committee port
         # (consensus.rs:61-73 rewrites the IP to 0.0.0.0).
-        self.receiver = NetworkReceiver(
+        # transport="native": the C++ epoll reactor (network/native.py)
+        # carries the framed TCP I/O; the actor graph is unchanged.
+        if transport == "native":
+            from ..network.native import (
+                NativeReceiver,
+                NativeReliableSender,
+                NativeSimpleSender,
+            )
+
+            receiver_cls = NativeReceiver
+            make_sender = NativeSimpleSender
+            make_reliable = NativeReliableSender
+        else:
+            from ..network import ReliableSender, SimpleSender
+
+            receiver_cls = NetworkReceiver
+            make_sender = SimpleSender
+            make_reliable = ReliableSender
+        self.receiver = receiver_cls(
             bind_host,
             address[1],
             ConsensusReceiverHandler(tx_consensus, tx_helper, tx_producer),
@@ -134,6 +153,7 @@ class Consensus:
             store,
             tx_loopback,
             parameters.sync_retry_delay,
+            network=make_sender(),
         )
 
         self.core = Core(
@@ -149,6 +169,7 @@ class Consensus:
             rx_loopback=tx_loopback,
             tx_proposer=tx_proposer,
             tx_commit=tx_commit,
+            network=make_sender(),
         )
         self._tasks.append(self.core.spawn())
 
@@ -159,10 +180,13 @@ class Consensus:
             rx_producer=tx_producer,
             rx_message=tx_proposer,
             tx_loopback=tx_loopback,
+            network=make_reliable(),
         )
         self._tasks.append(self.proposer.spawn())
 
-        self.helper = Helper(committee, store, rx_requests=tx_helper)
+        self.helper = Helper(
+            committee, store, rx_requests=tx_helper, network=make_sender()
+        )
         self._tasks.append(self.helper.spawn())
         return self
 

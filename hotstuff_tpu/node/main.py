@@ -51,6 +51,7 @@ async def _run_node(args) -> None:
         store_path=args.store,
         parameters_file=args.parameters,
         verifier_backend=args.verifier,
+        transport=args.transport,
     )
     await node.analyze_block()
 
@@ -101,6 +102,13 @@ def main(argv=None) -> int:
     p_run.add_argument("--committee", required=True)
     p_run.add_argument("--store", required=True)
     p_run.add_argument("--parameters", default=None)
+    p_run.add_argument(
+        "--transport",
+        choices=["asyncio", "native"],
+        default="asyncio",
+        help="framed-TCP transport: asyncio (default) or the native C++ "
+        "epoll reactor (network/native.py)",
+    )
     p_run.add_argument(
         "--verifier",
         choices=["cpu", "tpu", "tpu-sharded"],

@@ -107,6 +107,7 @@ class Node:
         parameters_file: str | None = None,
         verifier_backend: str = "cpu",
         bind_host: str = "0.0.0.0",
+        transport: str = "asyncio",
     ) -> "Node":
         self = cls()
         committee = read_committee(committee_file)
@@ -146,6 +147,7 @@ class Node:
             self.commit,
             verifier=verifier,
             bind_host=bind_host,
+            transport=transport,
         )
         log.info("Node %s successfully booted", secret.name)
         return self

@@ -1,0 +1,591 @@
+// Native TCP transport reactor for hotstuff_tpu.
+//
+// The reference's network crate is native (tokio TCP with
+// LengthDelimitedCodec framing, network/src/receiver.rs:70); this is the
+// framework's native equivalent: a single epoll reactor thread owning
+// every socket, with a C API consumed through ctypes
+// (hotstuff_tpu/network/native.py).  Semantics mirrored:
+//
+// - length-delimited framing: u32 big-endian prefix, 64 MB cap
+//   (framing.py / reference receiver.rs:70);
+// - outbound peers (SimpleSender, simple_sender.rs:22-143): one
+//   persistent connection per peer, bounded queue of 1000 frames,
+//   frames dropped when the peer is down (reconnect attempted on the
+//   next send), inbound frames on the same socket (ACKs) surfaced to
+//   the caller;
+// - inbound listener (Receiver, receiver.rs:31-89): accepted
+//   connections deliver frames to the caller, which may write replies
+//   (ACKs) back on the same connection.
+//
+// Bridge to asyncio: a notify pipe becomes readable whenever the event
+// queue transitions from empty to non-empty; the Python side registers
+// it with loop.add_reader and drains ht_next() without blocking.
+//
+// Thread model: the reactor thread owns all sockets.  ht_send/ht_reply
+// only take a lock and append to an outbox, then wake the reactor via
+// a second (wake) pipe.  No socket syscall ever happens off-thread.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 64u * 1024u * 1024u;
+constexpr size_t kQueueCap = 1000;  // per-peer outbox (reference cap)
+
+enum EventKind : int {
+  kFrameFromAccepted = 1,
+  kFrameFromPeer = 2,
+  kAcceptedClosed = 3,
+  kPeerClosed = 4,
+};
+
+struct Event {
+  long src;
+  int kind;
+  std::string payload;
+};
+
+struct Conn {
+  int fd = -1;
+  bool outbound = false;     // outbound peer (reconnects) vs accepted
+  long listener = -1;        // owning listener id (accepted conns)
+  bool connecting = false;   // nonblocking connect in flight
+  std::string host;          // outbound only
+  int port = 0;              // outbound only
+  std::string rbuf;          // partial inbound bytes
+  std::string wbuf;          // bytes queued on the socket
+  std::deque<std::string> outbox;  // framed messages not yet in wbuf
+  bool closed = false;
+};
+
+int set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void frame_into(std::string& out, const uint8_t* data, int len) {
+  uint32_t be = htonl(static_cast<uint32_t>(len));
+  out.append(reinterpret_cast<const char*>(&be), 4);
+  out.append(reinterpret_cast<const char*>(data), static_cast<size_t>(len));
+}
+
+struct Reactor {
+  int epfd = -1;
+  int notify_r = -1, notify_w = -1;  // events pending -> readable
+  int wake_r = -1, wake_w = -1;      // off-thread poke of the reactor
+  std::thread thread;
+  bool running = false;
+
+  std::mutex mu;  // guards events, conns map mutation, outboxes, next_id
+  std::deque<Event> events;
+  std::map<long, Conn> conns;
+  std::map<int, long> fd_to_id;
+  std::map<int, long> listeners;  // listener fd -> id
+  long next_id = 1;
+
+  void push_event(long src, int kind, std::string payload) {
+    bool was_empty;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      was_empty = events.empty();
+      events.push_back(Event{src, kind, std::move(payload)});
+    }
+    if (was_empty) {
+      char b = 1;
+      (void)!write(notify_w, &b, 1);
+    }
+  }
+
+  void arm(int fd, bool want_write) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
+    ev.data.fd = fd;
+    epoll_ctl(epfd, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void add_fd(int fd, bool want_write) {
+    epoll_event ev{};
+    ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
+    ev.data.fd = fd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void close_conn(long id, bool notify) {
+    bool was_accepted = false;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(id);
+      if (it == conns.end()) return;
+      Conn& c = it->second;
+      if (c.fd >= 0) {
+        epoll_ctl(epfd, EPOLL_CTL_DEL, c.fd, nullptr);
+        fd_to_id.erase(c.fd);
+        ::close(c.fd);
+        c.fd = -1;
+      }
+      c.connecting = false;
+      c.rbuf.clear();
+      c.wbuf.clear();
+      if (!c.outbound) {
+        c.closed = true;
+        was_accepted = true;
+      } else {
+        // best-effort semantics: frames queued while down are dropped
+        c.outbox.clear();
+      }
+    }
+    if (notify) push_event(id, was_accepted ? kAcceptedClosed : kPeerClosed, "");
+  }
+
+  // try to open the outbound connection for peer `id` (reactor thread)
+  void start_connect(long id) {
+    std::string host;
+    int port;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(id);
+      if (it == conns.end() || it->second.fd >= 0 || it->second.connecting)
+        return;
+      host = it->second.host;
+      port = it->second.port;
+    }
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return;
+    set_nonblock(fd);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) {
+      ::close(fd);
+      return;
+    }
+    int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+    bool failed = false;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(id);
+      if (it == conns.end()) {
+        ::close(fd);
+        return;
+      }
+      if (rc == 0 || errno == EINPROGRESS) {
+        it->second.fd = fd;
+        it->second.connecting = (rc != 0);
+        fd_to_id[fd] = id;
+        add_fd(fd, true);  // EPOLLOUT signals connect completion
+      } else {
+        ::close(fd);
+        it->second.outbox.clear();  // drop (peer down)
+        failed = true;
+      }
+    }
+    if (failed) push_event(id, kPeerClosed, "");
+  }
+
+  void flush_outbox_locked(Conn& c) {
+    while (!c.outbox.empty() && c.wbuf.size() < (1u << 20)) {
+      c.wbuf += c.outbox.front();
+      c.outbox.pop_front();
+    }
+  }
+
+  void handle_writable(long id) {
+    bool broken = false;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(id);
+      if (it == conns.end() || it->second.fd < 0) return;
+      Conn& c = it->second;
+      if (c.connecting) {
+        int err = 0;
+        socklen_t len = sizeof(err);
+        getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err != 0) {
+          // connect failed: drop queued frames (best-effort)
+          epoll_ctl(epfd, EPOLL_CTL_DEL, c.fd, nullptr);
+          fd_to_id.erase(c.fd);
+          ::close(c.fd);
+          c.fd = -1;
+          c.connecting = false;
+          c.outbox.clear();
+          broken = true;  // emits kPeerClosed below
+        } else {
+          c.connecting = false;
+        }
+      }
+      if (!broken) {
+        flush_outbox_locked(c);
+        while (!c.wbuf.empty()) {
+          ssize_t n = ::send(c.fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
+          if (n > 0) {
+            c.wbuf.erase(0, static_cast<size_t>(n));
+            flush_outbox_locked(c);
+          } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            break;
+          } else {
+            broken = true;
+            break;
+          }
+        }
+      }
+      if (!broken) arm(c.fd, !c.wbuf.empty() || !c.outbox.empty());
+    }
+    if (broken) close_conn(id, true);
+  }
+
+  void handle_readable(long id) {
+    int fd;
+    bool outbound;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = conns.find(id);
+      if (it == conns.end() || it->second.fd < 0) return;
+      fd = it->second.fd;
+      outbound = it->second.outbound;
+    }
+    char buf[64 * 1024];
+    while (true) {
+      ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        std::string* rbuf;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = conns.find(id);
+          if (it == conns.end()) return;
+          rbuf = &it->second.rbuf;
+          rbuf->append(buf, static_cast<size_t>(n));
+        }
+        // extract complete frames
+        bool violation = false;
+        while (true) {
+          std::string payload;
+          bool have = false;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            auto it = conns.find(id);
+            if (it == conns.end()) return;
+            std::string& r = it->second.rbuf;
+            if (r.size() >= 4) {
+              uint32_t be;
+              memcpy(&be, r.data(), 4);
+              uint32_t len = ntohl(be);
+              if (len > kMaxFrame) {
+                violation = true;  // protocol violation: drop the conn
+              } else if (r.size() >= 4 + len) {
+                payload = r.substr(4, len);
+                r.erase(0, 4 + static_cast<size_t>(len));
+                have = true;
+              }
+            }
+          }
+          if (violation) {
+            close_conn(id, true);
+            return;
+          }
+          if (!have) break;
+          push_event(id, outbound ? kFrameFromPeer : kFrameFromAccepted,
+                     std::move(payload));
+        }
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return;
+      } else {
+        close_conn(id, true);
+        return;
+      }
+    }
+  }
+
+  void handle_accept(int lfd) {
+    while (true) {
+      int fd = ::accept(lfd, nullptr, nullptr);
+      if (fd < 0) return;
+      set_nonblock(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      long id;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        id = next_id++;
+        Conn c;
+        c.fd = fd;
+        c.outbound = false;
+        auto lit = listeners.find(lfd);
+        c.listener = lit != listeners.end() ? lit->second : -1;
+        conns[id] = std::move(c);
+        fd_to_id[fd] = id;
+      }
+      add_fd(fd, false);
+    }
+  }
+
+  void run() {
+    epoll_event evs[64];
+    while (running) {
+      int n = epoll_wait(epfd, evs, 64, 200);
+      for (int i = 0; i < n; i++) {
+        int fd = evs[i].data.fd;
+        if (fd == wake_r) {
+          char tmp[256];
+          while (read(wake_r, tmp, sizeof(tmp)) > 0) {
+          }
+          // flush every outbound conn with pending frames; start
+          // connections for peers that are down
+          std::vector<long> want;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            for (auto& [id, c] : conns) {
+              if (!c.outbox.empty() || !c.wbuf.empty()) want.push_back(id);
+            }
+          }
+          for (long id : want) {
+            bool need_connect = false;
+            {
+              std::lock_guard<std::mutex> g(mu);
+              auto it = conns.find(id);
+              if (it == conns.end()) continue;
+              need_connect =
+                  it->second.outbound && it->second.fd < 0 &&
+                  !it->second.connecting;
+            }
+            if (need_connect) start_connect(id);
+            std::lock_guard<std::mutex> g(mu);
+            auto it = conns.find(id);
+            if (it != conns.end() && it->second.fd >= 0 &&
+                !it->second.connecting) {
+              arm(it->second.fd, true);
+            }
+          }
+          continue;
+        }
+        bool is_listener;
+        long id = -1;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto lit = listeners.find(fd);
+          is_listener = lit != listeners.end();
+          if (!is_listener) {
+            auto fit = fd_to_id.find(fd);
+            if (fit == fd_to_id.end()) continue;
+            id = fit->second;
+          }
+        }
+        if (is_listener) {
+          handle_accept(fd);
+          continue;
+        }
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+          // treat as readable first (drain), then close
+          handle_readable(id);
+          continue;
+        }
+        if (evs[i].events & EPOLLOUT) handle_writable(id);
+        if (evs[i].events & EPOLLIN) handle_readable(id);
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ht_start() {
+  auto* r = new Reactor();
+  r->epfd = epoll_create1(0);
+  int p1[2], p2[2];
+  if (pipe(p1) != 0 || pipe(p2) != 0) {
+    delete r;
+    return nullptr;
+  }
+  r->notify_r = p1[0];
+  r->notify_w = p1[1];
+  r->wake_r = p2[0];
+  r->wake_w = p2[1];
+  set_nonblock(r->notify_r);
+  set_nonblock(r->notify_w);
+  set_nonblock(r->wake_r);
+  set_nonblock(r->wake_w);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = r->wake_r;
+  epoll_ctl(r->epfd, EPOLL_CTL_ADD, r->wake_r, &ev);
+  r->running = true;
+  r->thread = std::thread([r] { r->run(); });
+  return r;
+}
+
+int ht_notify_fd(void* rp) {
+  return static_cast<Reactor*>(rp)->notify_r;
+}
+
+long ht_listen(void* rp, const char* ip, int port) {
+  auto* r = static_cast<Reactor*>(rp);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, ip, &sa.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      listen(fd, 128) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  set_nonblock(fd);
+  long id;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    id = r->next_id++;
+    r->listeners[fd] = id;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(r->epfd, EPOLL_CTL_ADD, fd, &ev);
+  return id;
+}
+
+long ht_connect(void* rp, const char* ip, int port) {
+  auto* r = static_cast<Reactor*>(rp);
+  std::lock_guard<std::mutex> g(r->mu);
+  long id = r->next_id++;
+  Conn c;
+  c.outbound = true;
+  c.host = ip;
+  c.port = port;
+  r->conns[id] = std::move(c);
+  return id;
+}
+
+int ht_send(void* rp, long peer, const uint8_t* data, int len) {
+  auto* r = static_cast<Reactor*>(rp);
+  if (len < 0 || static_cast<uint32_t>(len) > kMaxFrame) return -1;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->conns.find(peer);
+    if (it == r->conns.end() || !it->second.outbound) return -1;
+    if (it->second.outbox.size() >= kQueueCap) return -1;  // drop
+    std::string framed;
+    frame_into(framed, data, len);
+    it->second.outbox.push_back(std::move(framed));
+  }
+  char b = 1;
+  (void)!write(r->wake_w, &b, 1);
+  return 0;
+}
+
+int ht_reply(void* rp, long conn, const uint8_t* data, int len) {
+  auto* r = static_cast<Reactor*>(rp);
+  if (len < 0 || static_cast<uint32_t>(len) > kMaxFrame) return -1;
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->conns.find(conn);
+    if (it == r->conns.end() || it->second.outbound || it->second.closed)
+      return -1;
+    std::string framed;
+    frame_into(framed, data, len);
+    it->second.outbox.push_back(std::move(framed));
+  }
+  char b = 1;
+  (void)!write(r->wake_w, &b, 1);
+  return 0;
+}
+
+// Drain one event.  Returns payload length (>= 0) with *src/*kind set,
+// -1 when the queue is empty, -2 when the buffer is too small (event
+// stays queued; call again with a bigger buffer of at least the
+// returned-in-*kind size... simpler: capacity >= 64 MB never triggers).
+int ht_next(void* rp, long* src, int* kind, uint8_t* buf, int cap) {
+  auto* r = static_cast<Reactor*>(rp);
+  std::lock_guard<std::mutex> g(r->mu);
+  if (r->events.empty()) {
+    // drain the notify pipe only when empty so the fd stays readable
+    // while events remain
+    char tmp[256];
+    while (read(r->notify_r, tmp, sizeof(tmp)) > 0) {
+    }
+    return -1;
+  }
+  Event& e = r->events.front();
+  if (static_cast<int>(e.payload.size()) > cap) return -2;
+  *src = e.src;
+  *kind = e.kind;
+  int n = static_cast<int>(e.payload.size());
+  memcpy(buf, e.payload.data(), e.payload.size());
+  if (e.kind == kAcceptedClosed) {
+    // reap: the consumer has now seen the close — the entry is dead
+    // (outbound peers are NOT reaped: their ids are stable handles that
+    // reconnect on the next send)
+    r->conns.erase(e.src);
+  }
+  r->events.pop_front();
+  return n;
+}
+
+// Close a listener: stop accepting; existing connections are unaffected.
+int ht_close_listener(void* rp, long listener_id) {
+  auto* r = static_cast<Reactor*>(rp);
+  std::lock_guard<std::mutex> g(r->mu);
+  for (auto it = r->listeners.begin(); it != r->listeners.end(); ++it) {
+    if (it->second == listener_id) {
+      epoll_ctl(r->epfd, EPOLL_CTL_DEL, it->first, nullptr);
+      ::close(it->first);
+      r->listeners.erase(it);
+      return 0;
+    }
+  }
+  return -1;
+}
+
+// Owning listener id of an accepted connection (-1 if unknown) — the
+// Python side routes frames to the right receiver with this.
+long ht_conn_listener(void* rp, long conn) {
+  auto* r = static_cast<Reactor*>(rp);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->conns.find(conn);
+  if (it == r->conns.end() || it->second.outbound) return -1;
+  return it->second.listener;
+}
+
+void ht_stop(void* rp) {
+  auto* r = static_cast<Reactor*>(rp);
+  r->running = false;
+  char b = 1;
+  (void)!write(r->wake_w, &b, 1);
+  if (r->thread.joinable()) r->thread.join();
+  std::lock_guard<std::mutex> g(r->mu);
+  for (auto& [id, c] : r->conns) {
+    if (c.fd >= 0) ::close(c.fd);
+  }
+  for (auto& [fd, id] : r->listeners) ::close(fd);
+  ::close(r->epfd);
+  ::close(r->notify_r);
+  ::close(r->notify_w);
+  ::close(r->wake_r);
+  ::close(r->wake_w);
+  delete r;
+}
+
+}  // extern "C"

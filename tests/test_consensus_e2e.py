@@ -13,7 +13,9 @@ from hotstuff_tpu.store import Store
 from .common import async_test, committee, fresh_base_port, keys
 
 
-async def _spawn_committee(tmp_path, base, indices, timeout_delay=1_000):
+async def _spawn_committee(
+    tmp_path, base, indices, timeout_delay=1_000, transport="asyncio"
+):
     com = committee(base)
     nodes = []
     for i in indices:
@@ -28,6 +30,7 @@ async def _spawn_committee(tmp_path, base, indices, timeout_delay=1_000):
             store,
             commit_q,
             bind_host="127.0.0.1",
+            transport=transport,
         )
         nodes.append((stack, commit_q, store))
     return nodes
@@ -91,5 +94,32 @@ async def test_end_to_end_one_crash_fault(tmp_path):
             while committed.round == 0:
                 committed = await asyncio.wait_for(commit_q.get(), timeout=30.0)
             assert committed.round >= 1
+    finally:
+        await _shutdown(nodes, feeder)
+
+
+@async_test
+async def test_end_to_end_native_transport(tmp_path):
+    """The full committee over the native C++ transport (one shared
+    epoll reactor carrying every node's framed TCP in this process):
+    all nodes commit a mutually consistent chain."""
+    import pytest
+
+    pytest.importorskip("hotstuff_tpu.network.native")
+    base = fresh_base_port()
+    nodes = await _spawn_committee(tmp_path, base, range(4), transport="native")
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    try:
+        chains = []
+        for _, commit_q, _ in nodes:
+            committed = [
+                await asyncio.wait_for(commit_q.get(), timeout=20.0)
+                for _ in range(3)
+            ]
+            chains.append(committed)
+        digests = [[b.digest() for b in committed] for committed in chains]
+        common_len = min(len(d) for d in digests)
+        for d in digests[1:]:
+            assert d[:common_len] == digests[0][:common_len]
     finally:
         await _shutdown(nodes, feeder)
