@@ -109,6 +109,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.ht_conn_listener.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.ht_close_listener.restype = ctypes.c_int
     lib.ht_close_listener.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.ht_close_conn.restype = ctypes.c_int
+    lib.ht_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.ht_stop.restype = None
     lib.ht_stop.argtypes = [ctypes.c_void_p]
     return lib
@@ -279,6 +281,9 @@ class NativeReceiver:
     async def shutdown(self) -> None:
         for t in list(self._workers.values()):
             t.cancel()
+        if self.reactor.handle:
+            for conn_id in self._queues:
+                self.reactor.lib.ht_close_conn(self.reactor.handle, conn_id)
         self._workers.clear()
         self._queues.clear()
         self.reactor._routers.pop(self._listener, None)
@@ -289,9 +294,16 @@ class NativeReceiver:
             self._listener = -1
 
 
-def _resolve(host: str) -> str:
+_RESOLVE_CACHE: dict[str, str] = {}
+
+
+def _resolve(host: str) -> str | None:
     """Host-side name resolution — the C++ reactor takes dotted quads
-    only (inet_pton), while the asyncio transport resolves names."""
+    only (inet_pton), while the asyncio transport resolves names.
+    Returns None on failure: callers log and DROP (matching the asyncio
+    senders, which catch OSError in their connection tasks — a DNS blip
+    must not crash a consensus actor).  Successful lookups are cached,
+    so the blocking gethostbyname happens once per peer."""
     import ipaddress
     import socket
 
@@ -301,7 +313,16 @@ def _resolve(host: str) -> str:
         ipaddress.ip_address(host)
         return host
     except ValueError:
-        return socket.gethostbyname(host)
+        pass
+    cached = _RESOLVE_CACHE.get(host)
+    if cached is None:
+        try:
+            cached = socket.gethostbyname(host)
+        except OSError as e:
+            log.warning("cannot resolve %s: %s", host, e)
+            return None
+        _RESOLVE_CACHE[host] = cached
+    return cached
 
 
 class NativeSimpleSender:
@@ -311,10 +332,12 @@ class NativeSimpleSender:
         self.reactor = Reactor.shared()
         self._peers: dict[Address, int] = {}
 
-    def _peer(self, address: Address) -> int:
+    def _peer(self, address: Address) -> int | None:
         peer = self._peers.get(address)
         if peer is None:
             host = _resolve(address[0])
+            if host is None:
+                return None  # unresolvable: drop (best-effort semantics)
             peer = self.reactor.lib.ht_connect(
                 self.reactor.handle, host.encode(), address[1]
             )
@@ -323,8 +346,11 @@ class NativeSimpleSender:
 
     async def send(self, address: Address, payload: bytes) -> None:
         self.reactor.ensure_reader()
+        peer = self._peer(address)
+        if peer is None:
+            return
         self.reactor.lib.ht_send(
-            self.reactor.handle, self._peer(address), payload, len(payload)
+            self.reactor.handle, peer, payload, len(payload)
         )
 
     async def broadcast(self, addresses: list[Address], payload: bytes) -> None:
@@ -340,6 +366,9 @@ class NativeSimpleSender:
             await self.send(address, payload)
 
     def close(self) -> None:
+        if self.reactor.handle:
+            for pid in self._peers.values():
+                self.reactor.lib.ht_close_conn(self.reactor.handle, pid)
         self._peers.clear()
 
 
@@ -374,10 +403,12 @@ class NativeReliableSender:
         self._delay: dict[int, float] = {}
         self._retry_handle: dict[int, object] = {}
 
-    def _peer(self, address: Address) -> int:
+    def _peer(self, address: Address) -> int | None:
         pid = self._peers.get(address)
         if pid is None:
             host = _resolve(address[0])
+            if host is None:
+                return None  # unresolvable: the future stays pending
             pid = self.reactor.lib.ht_connect(
                 self.reactor.handle, host.encode(), address[1]
             )
@@ -395,6 +426,10 @@ class NativeReliableSender:
         self.reactor.ensure_reader()
         pid = self._peer(address)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        if pid is None:
+            # like a peer that never comes up: the caller's quorum wait
+            # proceeds on the other handles (it cancels this one)
+            return fut
         self._queue[pid].append((payload, fut))
         self._flush(pid)
         return fut
@@ -465,6 +500,8 @@ class NativeReliableSender:
             handle = self._retry_handle.pop(pid, None)
             if handle is not None:
                 handle.cancel()
+            if self.reactor.handle:
+                self.reactor.lib.ht_close_conn(self.reactor.handle, pid)
         for q in self._queue.values():
             for _, fut in q:
                 if not fut.done():

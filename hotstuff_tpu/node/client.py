@@ -24,7 +24,7 @@ import logging
 import sys
 
 from ..crypto import Digest
-from ..network.framing import read_frame, send_frame, set_nodelay
+from ..network.framing import read_frame, set_nodelay, write_frame
 from .config import read_committee
 
 log = logging.getLogger("client")
@@ -53,9 +53,6 @@ class _NodeConn:
                 await read_frame(reader)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
-
-    async def send(self, payload: bytes) -> None:
-        await send_frame(self.writer, payload)
 
     def close(self) -> None:
         if self._sink is not None:
@@ -150,6 +147,10 @@ async def run_client(
     try:
         while loop.time() - start < duration:
             slot_start = loop.time()
+            # write the whole burst per connection without per-frame
+            # drain syncs — one drain per (conn, burst) keeps the client
+            # from becoming the bottleneck at large committees (each
+            # drain is an await even when the buffer has room)
             for i in range(burst):
                 digest = Digest.random()
                 if i == 0:
@@ -157,8 +158,10 @@ async def run_client(
                     log.info("Sending sample payload %s", digest)
                 message = encode_producer(digest)
                 for c in conns:
-                    await c.send(message)
+                    write_frame(c.writer, message)
                 sent += 1
+            for c in conns:
+                await c.writer.drain()
             counter += 1
             elapsed = loop.time() - slot_start
             if elapsed > BURST_INTERVAL:

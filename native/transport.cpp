@@ -35,6 +35,7 @@
 #include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <atomic>
 #include <string>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -89,7 +90,7 @@ struct Reactor {
   int notify_r = -1, notify_w = -1;  // events pending -> readable
   int wake_r = -1, wake_w = -1;      // off-thread poke of the reactor
   std::thread thread;
-  bool running = false;
+  std::atomic<bool> running{false};
 
   std::mutex mu;  // guards events, conns map mutation, outboxes, next_id
   std::deque<Event> events;
@@ -504,6 +505,8 @@ int ht_reply(void* rp, long conn, const uint8_t* data, int len) {
     auto it = r->conns.find(conn);
     if (it == r->conns.end() || it->second.outbound || it->second.closed)
       return -1;
+    if (it->second.outbox.size() >= kQueueCap)
+      return -1;  // peer not reading its replies: drop, don't balloon
     std::string framed;
     frame_into(framed, data, len);
     it->second.outbox.push_back(std::move(framed));
@@ -542,6 +545,15 @@ int ht_next(void* rp, long* src, int* kind, uint8_t* buf, int cap) {
   }
   r->events.pop_front();
   return n;
+}
+
+// Close one connection (accepted or outbound peer) and forget it.
+int ht_close_conn(void* rp, long conn) {
+  auto* r = static_cast<Reactor*>(rp);
+  r->close_conn(conn, false);
+  std::lock_guard<std::mutex> g(r->mu);
+  r->conns.erase(conn);
+  return 0;
 }
 
 // Close a listener: stop accepting; existing connections are unaffected.
