@@ -294,7 +294,8 @@ class NativeReceiver:
             self._listener = -1
 
 
-_RESOLVE_CACHE: dict[str, str] = {}
+_RESOLVE_CACHE: dict[str, tuple[str | None, float]] = {}
+_RESOLVE_NEG_TTL = 30.0  # retry failed lookups after this many seconds
 
 
 def _resolve(host: str) -> str | None:
@@ -302,10 +303,12 @@ def _resolve(host: str) -> str | None:
     only (inet_pton), while the asyncio transport resolves names.
     Returns None on failure: callers log and DROP (matching the asyncio
     senders, which catch OSError in their connection tasks — a DNS blip
-    must not crash a consensus actor).  Successful lookups are cached,
-    so the blocking gethostbyname happens once per peer."""
+    must not crash a consensus actor).  Lookups are cached — successes
+    forever, failures for a short TTL — so the blocking gethostbyname
+    cannot run on the event loop for every send to a dead name."""
     import ipaddress
     import socket
+    import time
 
     if host in ("localhost",):
         return "127.0.0.1"
@@ -314,15 +317,18 @@ def _resolve(host: str) -> str | None:
         return host
     except ValueError:
         pass
-    cached = _RESOLVE_CACHE.get(host)
-    if cached is None:
-        try:
-            cached = socket.gethostbyname(host)
-        except OSError as e:
-            log.warning("cannot resolve %s: %s", host, e)
-            return None
-        _RESOLVE_CACHE[host] = cached
-    return cached
+    hit = _RESOLVE_CACHE.get(host)
+    now = time.monotonic()
+    if hit is not None and (hit[0] is not None or now < hit[1]):
+        return hit[0]
+    try:
+        resolved = socket.gethostbyname(host)
+        _RESOLVE_CACHE[host] = (resolved, 0.0)
+    except OSError as e:
+        log.warning("cannot resolve %s: %s", host, e)
+        _RESOLVE_CACHE[host] = (None, now + _RESOLVE_NEG_TTL)
+        return None
+    return resolved
 
 
 class NativeSimpleSender:
@@ -402,6 +408,9 @@ class NativeReliableSender:
         self._sent: dict[int, int] = {}  # pid -> sent prefix length
         self._delay: dict[int, float] = {}
         self._retry_handle: dict[int, object] = {}
+        # futures returned for unresolvable peers: never transmitted,
+        # but close() must still cancel them so no caller hangs
+        self._orphans: list[asyncio.Future] = []
 
     def _peer(self, address: Address) -> int | None:
         pid = self._peers.get(address)
@@ -428,7 +437,9 @@ class NativeReliableSender:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         if pid is None:
             # like a peer that never comes up: the caller's quorum wait
-            # proceeds on the other handles (it cancels this one)
+            # proceeds on the other handles (it cancels this one); the
+            # orphan list lets close() cancel it if nobody does
+            self._orphans.append(fut)
             return fut
         self._queue[pid].append((payload, fut))
         self._flush(pid)
@@ -506,6 +517,10 @@ class NativeReliableSender:
             for _, fut in q:
                 if not fut.done():
                     fut.cancel()  # no caller may hang on a dead sender
+        for fut in self._orphans:
+            if not fut.done():
+                fut.cancel()
+        self._orphans.clear()
         self._peers.clear()
         self._queue.clear()
         self._sent.clear()

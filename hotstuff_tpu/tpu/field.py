@@ -125,10 +125,12 @@ def carry(z, passes: int = 4):
 
     Convergence (inputs non-negative, columns < 2^31):
     after fold, columns < ~1.91e9; pass 1 leaves limbs <= 8191 + 233k
-    (limb 0 <= 8191 + 1.4e8); pass 2 <= ~26k; pass 3 <= ~8.8k;
-    pass 4 reaches limb0 <= 2^13+608, limbs[1..18] <= 2^13, limb19 <= 256.
-    Every pass is a handful of full-width vector ops — no sequential
-    carry chain.
+    (limb 0 <= 8191 + 1.4e8); pass 2 <= ~26k (limb 1 inherits limb 0's
+    large carry, so THREE passes do NOT suffice — a host search finds
+    product-scale inputs leaving a limb at 8193 after 3 passes);
+    pass 3 <= ~8.8k; pass 4 reaches limb0 <= 2^13+608,
+    limbs[1..18] <= 2^13, limb19 <= 256.  Every pass is a handful of
+    full-width vector ops — no sequential carry chain.
 
     ``passes`` may be lowered by callers whose inputs are tighter than
     the worst case.  For sums/differences of loose-normalized values

@@ -72,6 +72,7 @@ struct Conn {
   std::string wbuf;          // bytes queued on the socket
   std::deque<std::string> outbox;  // framed messages not yet in wbuf
   bool closed = false;
+  bool pending_close = false;  // Python asked; reactor thread executes
 };
 
 int set_nonblock(int fd) {
@@ -98,6 +99,11 @@ struct Reactor {
   std::map<int, long> fd_to_id;
   std::map<int, long> listeners;  // listener fd -> id
   long next_id = 1;
+
+  void wake() {
+    char b = 1;
+    (void)!write(wake_w, &b, 1);
+  }
 
   void push_event(long src, int kind, std::string payload) {
     bool was_empty;
@@ -346,6 +352,24 @@ struct Reactor {
           char tmp[256];
           while (read(wake_r, tmp, sizeof(tmp)) > 0) {
           }
+          // execute closes requested off-thread
+          std::vector<long> doomed;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            for (auto& [id, c] : conns) {
+              if (c.pending_close) doomed.push_back(id);
+            }
+          }
+          for (long id : doomed) {
+            // notify: Python cleans up per-connection workers / pending
+            // ACK futures off the close event
+            close_conn(id, true);
+            std::lock_guard<std::mutex> g(mu);
+            auto it = conns.find(id);
+            // accepted conns are reaped when the close event is
+            // consumed; outbound handles are being discarded entirely
+            if (it != conns.end() && it->second.outbound) conns.erase(it);
+          }
           // flush every outbound conn with pending frames; start
           // connections for peers that are down
           std::vector<long> want;
@@ -505,8 +529,15 @@ int ht_reply(void* rp, long conn, const uint8_t* data, int len) {
     auto it = r->conns.find(conn);
     if (it == r->conns.end() || it->second.outbound || it->second.closed)
       return -1;
-    if (it->second.outbox.size() >= kQueueCap)
-      return -1;  // peer not reading its replies: drop, don't balloon
+    if (it->second.outbox.size() >= kQueueCap) {
+      // peer not reading its replies: close the connection rather than
+      // silently dropping an ACK (a dropped ACK on a live connection
+      // would permanently desync the sender's FIFO ACK pairing; a
+      // close makes the peer reconnect and retransmit)
+      it->second.pending_close = true;
+      r->wake();
+      return -1;
+    }
     std::string framed;
     frame_into(framed, data, len);
     it->second.outbox.push_back(std::move(framed));
@@ -547,12 +578,19 @@ int ht_next(void* rp, long* src, int* kind, uint8_t* buf, int cap) {
   return n;
 }
 
-// Close one connection (accepted or outbound peer) and forget it.
+// Ask the reactor thread to close a connection (accepted or outbound)
+// and forget it.  Deferred to the reactor: only it may ::close() an fd
+// it could concurrently be reading/writing (an off-thread close would
+// race with recv/send and could hit a recycled fd number).
 int ht_close_conn(void* rp, long conn) {
   auto* r = static_cast<Reactor*>(rp);
-  r->close_conn(conn, false);
-  std::lock_guard<std::mutex> g(r->mu);
-  r->conns.erase(conn);
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->conns.find(conn);
+    if (it == r->conns.end()) return -1;
+    it->second.pending_close = true;
+  }
+  r->wake();
   return 0;
 }
 
