@@ -92,3 +92,29 @@ async def test_node_boot_and_client_commits(tmp_path):
         client.cancel()
         for node in nodes:
             await node.shutdown()
+
+
+def test_lazy_device_verifier_routes_without_jax():
+    """Small batches route to CPU without materializing the device
+    backend (importing jax costs seconds per node process — the lazy
+    wrapper exists so small committees never pay it)."""
+    import sys
+
+    from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+    from hotstuff_tpu.node.node import LazyDeviceVerifier
+
+    v = LazyDeviceVerifier("tpu")
+    pk, sk = generate_keypair(b"\x11" * 32, 3)
+    d = Digest.of(b"lazy-verifier probe")
+    sig = Signature.new(d, sk)
+
+    assert v.verify_one(d, pk, sig)
+    assert v.verify_shared_msg(d, [(pk, sig)] * 3)
+    assert v.verify_many(
+        [d.to_bytes()] * 2, [pk.to_bytes()] * 2, [sig.to_bytes()] * 2
+    ) == [True, True]
+    # the device backend was never constructed for sub-threshold batches
+    assert v._device is None
+    # precompute is deferred, not lost
+    v.precompute([pk.to_bytes()])
+    assert v._precomputed and v._device is None
