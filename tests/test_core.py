@@ -249,3 +249,29 @@ async def test_proposer_makes_empty_block_when_allowed():
     assert block.round == 5 and block.payloads == ()
     task.cancel()
     proposer.shutdown()
+
+
+@async_test
+async def test_wrong_leader_proposal_rejected(tmp_path):
+    """A Byzantine node proposing out of turn is rejected: no vote is
+    emitted for a round-1 block authored by anyone but round 1's leader
+    (core.rs:420-427 WrongLeader)."""
+    from .common import qc_for_block, signed_block
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus.messages import QC
+
+    base = fresh_base_port()
+    h = make_core(tmp_path, base, name_idx=0)
+    # round 1's leader is keys()[1 % 4]; author with keys()[3] instead
+    author, secret = keys()[3]
+    bad = signed_block(author, secret, 1, qc=QC.genesis(), payload=Digest.random())
+
+    listen = asyncio.ensure_future(listener(base + 2))  # round-2 leader's port
+    await asyncio.sleep(0.05)
+    h.core.spawn()
+    await h.rx_message.put((TAG_PROPOSE, bad))
+    # the proposal must NOT produce a vote
+    with __import__("pytest").raises(asyncio.TimeoutError):
+        await asyncio.wait_for(asyncio.shield(listen), timeout=0.6)
+    listen.cancel()
+    teardown(h)
