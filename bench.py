@@ -51,34 +51,31 @@ def make_qc_batch(n: int):
 
 
 def _stage(verifier, msgs, pks, sigs):
+    """(kernel_fn, device-staged arrays) via the production routing
+    point (verifier.stage picks XLA / Pallas / Pallas-split)."""
     import jax
     import jax.numpy as jnp
 
-    _, arrays = verifier.prepare(msgs, pks, sigs)
+    kernel, arrays, _ = verifier.stage(msgs, pks, sigs)
     staged = jax.device_put(tuple(jnp.asarray(a) for a in arrays))
     jax.block_until_ready(staged)
-    return staged
+    return kernel, staged
 
 
 def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     """(throughput sigs/s, {qc_size: {p50_ms, p99_ms}})."""
     import numpy as np
 
-    from hotstuff_tpu.tpu.ed25519 import (
-        BatchVerifier,
-        _verify_kernel,
-        _verify_kernel_pallas,
-    )
+    from hotstuff_tpu.tpu.ed25519 import BatchVerifier
 
     verifier = BatchVerifier(min_device_batch=0)  # measure the kernel
-    _kernel = _verify_kernel_pallas if verifier.use_pallas else _verify_kernel
     verifier.precompute(pks)  # epoch setup: committee keys decompressed once
 
     for _ in range(WARMUP):
         out = verifier.verify(msgs, pks, sigs)
         assert out.all(), "TPU verify returned invalid on a valid batch"
 
-    staged = _stage(verifier, msgs, pks, sigs)
+    _kernel, staged = _stage(verifier, msgs, pks, sigs)
 
     # throughput: FIFO dispatch stream, clock stopped by a full fetch of
     # the last result (the only sync the tunnel can't fake)
@@ -97,12 +94,14 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     #   estimates the co-located per-QC device time.
     latencies: dict = {}
     for qc_size in (16, 64, 256):
-        sub = _stage(verifier, msgs[:qc_size], pks[:qc_size], sigs[:qc_size])
-        np.asarray(_kernel(*sub))  # warm this shape
+        qc_kernel, sub = _stage(
+            verifier, msgs[:qc_size], pks[:qc_size], sigs[:qc_size]
+        )
+        np.asarray(qc_kernel(*sub))  # warm this shape
         times = []
         for _ in range(LAT_REPS):
             t0 = time.perf_counter()
-            ok = np.asarray(_kernel(*sub))
+            ok = np.asarray(qc_kernel(*sub))
             times.append(time.perf_counter() - t0)
             assert ok.all()
         times.sort()
@@ -110,7 +109,7 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
         for n in (8, 32):
             t0 = time.perf_counter()
             for _ in range(n):
-                out = _kernel(*sub)
+                out = qc_kernel(*sub)
             np.asarray(out)
             totals[n] = time.perf_counter() - t0
         latencies[str(qc_size)] = {

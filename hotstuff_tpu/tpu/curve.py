@@ -45,15 +45,15 @@ NWIN = 64  # ceil(256 / WINDOW) windows, MSB-first (top 3 bits always 0)
 Point = tuple  # (X, Y, Z, T) limb arrays
 
 
-def _base_table(window: int) -> np.ndarray:
-    """Constant table of [m]B for m in 0..2^window-1, extended affine
+def _base_table(window: int, base: "ref.Point" = ref.B_POINT) -> np.ndarray:
+    """Constant table of [m]P for m in 0..2^window-1, extended affine
     limbs.  Shape [2^window, 4, NLIMBS] (coords X, Y, Z=1, T)."""
     table = np.zeros((1 << window, 4, F.NLIMBS), np.int32)
     for m in range(1 << window):
         if m == 0:
             x, y = 0, 1
         else:
-            x, y = ref.point_affine(ref.point_mul(m, ref.B_POINT))
+            x, y = ref.point_affine(ref.point_mul(m, base))
         table[m, 0] = F.limbs_from_int(x)
         table[m, 1] = F.limbs_from_int(y)
         table[m, 2] = F.limbs_from_int(1)
@@ -67,6 +67,10 @@ def _base_table(window: int) -> np.ndarray:
 # measured ~8% off whole-kernel latency.
 B_WINDOW = 8
 B_TABLE8 = _base_table(B_WINDOW)
+# Table for 2^128*B — the split-scalar kernel (pallas_dsm) processes
+# each scalar as two 128-bit halves: [s]B = [s_hi](2^128 B) + [s_lo]B.
+B128_POINT = ref.point_mul(1 << 128, ref.B_POINT)
+B128_TABLE8 = _base_table(B_WINDOW, base=B128_POINT)
 
 
 def identity(shape_like) -> Point:

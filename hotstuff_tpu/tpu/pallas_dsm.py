@@ -55,6 +55,17 @@ _BTAB_T = (
 )  # [80, 256]; limb values < 2^13+608 are f32-exact
 _D2_COL = curve.D2_LIMBS.reshape(NL, 1)  # curve constant 2d, limb-major
 _SUBPAD_COL = F.SUB_PAD.reshape(NL, 1)
+# Doubled base table for the split-scalar kernel: entries 0..255 are
+# [m]B, entries 256..511 are [m](2^128 B); hi-half rows offset their
+# window byte by 256 to land in the second half.
+_BTAB2_T = (
+    np.concatenate(
+        [np.asarray(curve.B_TABLE8), np.asarray(curve.B128_TABLE8)], axis=0
+    )
+    .astype(np.float32)
+    .reshape(2 << curve.B_WINDOW, 4 * NL)
+    .T.copy()
+)  # [80, 512]
 
 
 class _Env:
@@ -189,9 +200,10 @@ def _tournament_select(entries, nibble):
 
 
 def _select_base_t(env, byte, bt):
-    """Constant-table select via one-hot MXU matmul: [80, 256] @
-    [256, Bt] -> [4, NL, Bt]."""
-    nent = 1 << curve.B_WINDOW
+    """Constant-table select via one-hot MXU matmul: [80, nent] @
+    [nent, Bt] -> [4, NL, Bt] (nent = 256, or 512 for the split kernel's
+    doubled table)."""
+    nent = env.btab.shape[1]
     onehot = (
         jax.lax.broadcasted_iota(jnp.int32, (nent, bt), 0) == byte
     ).astype(jnp.float32)
@@ -251,6 +263,133 @@ def _dsm_kernel(
     oy[:] = out[1]
     oz[:] = out[2]
     ot[:] = out[3]
+
+
+def _dsm_kernel_split(
+    wt, btab, d2, subpad, ax, ay, az, at, s_bytes, k_hi, k_lo, base_off,
+    ox, oy, oz, ot,
+):
+    """Split-scalar tile: rows [0 : Bt/2] are the 128-bit LO halves of
+    Bt/2 signatures, rows [Bt/2 : Bt] the HI halves ([s_hi](2^128 B) +
+    [k_hi](-2^128 A), with the A-multiples supplied per row and the
+    base-table window byte offset by base_off into the doubled constant
+    table).  The scan is 16 macro steps instead of 32; the halves are
+    recombined in-tile with one final addition, so the output batch is
+    Bt/2.  ~2x lower scan depth for any QC whose doubled row count fits
+    one tile (<= 128 votes at Bt = 256)."""
+    env = _Env(wt[:], btab[:], d2[:], subpad[:])
+    bt = ax.shape[-1]
+    a_point = jnp.stack([ax[:], ay[:], az[:], at[:]])
+
+    entries = [_identity_t(bt), a_point]
+    for _ in range(2, 1 << curve.WINDOW):
+        entries.append(_point_add_t(env, entries[-1], a_point))
+
+    nsteps = s_bytes.shape[0]
+    off = base_off[:]  # [1, Bt]
+
+    def step(i, acc, last_t):
+        sb = s_bytes[pl.ds(i, 1), :] + off
+        wh = k_hi[pl.ds(i, 1), :]
+        wl = k_lo[pl.ds(i, 1), :]
+        for j in range(curve.WINDOW):
+            acc = _point_double_t(env, acc, need_t=j == curve.WINDOW - 1)
+        acc = _point_add_t(
+            env, acc, _tournament_select(entries, wh), need_t=False
+        )
+        for j in range(curve.WINDOW):
+            acc = _point_double_t(env, acc, need_t=j == curve.WINDOW - 1)
+        acc = _point_add_t(env, acc, _tournament_select(entries, wl))
+        # only the FINAL step's base addition needs T (the recombining
+        # addition consumes it; intermediate T feeds doublings, which
+        # ignore it)
+        acc = _point_add_t(
+            env, acc, _select_base_t(env, sb, bt), need_t=last_t
+        )
+        return acc
+
+    acc = jax.lax.fori_loop(
+        0, nsteps - 1, lambda i, a: step(i, a, False), _identity_t(bt)
+    )
+    acc = step(nsteps - 1, acc, True)
+    half = bt // 2
+    lo = acc[:, :, :half]
+    hi = acc[:, :, half:]
+    out = _point_add_t(env, lo, hi, need_t=False)
+    ox[:] = out[0]
+    oy[:] = out[1]
+    oz[:] = out[2]
+    ot[:] = out[3]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def dual_scalar_mult_split(
+    s_win, k_win, a_point, base_off, *, interpret: bool = False
+):
+    """Split-scalar variant: operands are PER-HALF rows.
+
+    s_win, k_win: int32 [32, R] MSB-first 4-bit windows of the 128-bit
+    scalar halves; a_point: (X, Y, Z, T) coords [R, NL] of the negated
+    per-half A points; base_off: int32 [R], 0 for lo rows / 256 for hi.
+    R must be a multiple of BT, with each BT-row tile holding the lo
+    halves of BT/2 signatures followed by their hi halves (the caller
+    interleaves per tile).  Returns (X, Y, Z, T) with coords [R/2, NL];
+    T is NOT computed (zeros)."""
+    rows = s_win.shape[1]
+    if rows % BT:
+        raise ValueError(f"rows {rows} not a multiple of {BT}")
+    nwin = s_win.shape[0]
+    s_pairs = s_win.reshape(nwin // 2, 2, rows)
+    s_bytes = s_pairs[:, 0] * (1 << curve.WINDOW) + s_pairs[:, 1]
+    k_pairs = k_win.reshape(nwin // 2, 2, rows)
+
+    coords_t = [jnp.transpose(c) for c in a_point]  # [NL, rows]
+
+    grid = (rows // BT,)
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    limb_spec = pl.BlockSpec(
+        (NL, BT), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    win_spec = pl.BlockSpec(
+        (nwin // 2, BT), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    off_spec = pl.BlockSpec((1, BT), lambda i: (0, i), memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec(
+        (NL, BT // 2), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct((NL, rows // 2), jnp.int32)
+
+    ox, oy, oz, ot = pl.pallas_call(
+        _dsm_kernel_split,
+        grid=grid,
+        in_specs=[
+            const_spec(_WT.shape),
+            const_spec(_BTAB2_T.shape),
+            const_spec(_D2_COL.shape),
+            const_spec(_SUBPAD_COL.shape),
+        ]
+        + [limb_spec] * 4
+        + [win_spec] * 3
+        + [off_spec],
+        out_specs=[out_spec] * 4,
+        out_shape=[out_shape] * 4,
+        interpret=interpret,
+    )(
+        jnp.asarray(_WT),
+        jnp.asarray(_BTAB2_T),
+        jnp.asarray(_D2_COL),
+        jnp.asarray(_SUBPAD_COL),
+        *coords_t,
+        s_bytes,
+        k_pairs[:, 0],
+        k_pairs[:, 1],
+        base_off.reshape(1, rows),
+    )
+
+    return tuple(jnp.transpose(c) for c in (ox, oy, oz, ot))
 
 
 @partial(jax.jit, static_argnames=("interpret",))

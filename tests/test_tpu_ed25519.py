@@ -170,3 +170,35 @@ def test_pallas_dsm_parity_interpret():
     # uncomputed (compressed_equals never reads it)
     for xla, pal in list(zip(x_out, p_out))[:3]:
         assert (np.asarray(canon(xla)) == np.asarray(canon(pal))).all()
+
+
+def test_pallas_split_kernel_parity_interpret():
+    """The split-scalar Pallas kernel (16-step scan over 128-bit scalar
+    halves, in-kernel recombine) must agree with the standard verify
+    path, including rejection of a tampered signature."""
+    import jax.numpy as jnp
+
+    from hotstuff_tpu.tpu import pallas_dsm
+
+    n = 10
+    items = _sign_many(n, lambda i: b"split-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+    sigs[4] = sigs[4][:40] + b"\x01" + sigs[4][41:]  # tamper one
+
+    v = BatchVerifier(min_device_batch=0, use_pallas=False)
+    want = v.verify(msgs, pks, sigs)  # XLA path
+
+    valid_host, arrays = v.prepare_split(msgs, pks, sigs)
+    (ax, ay, az, at, s_win, k_win, base_off, r_y, r_sign) = arrays
+    p = pallas_dsm.dual_scalar_mult_split(
+        jnp.asarray(s_win),
+        jnp.asarray(k_win),
+        tuple(jnp.asarray(c) for c in (ax, ay, az, at)),
+        jnp.asarray(base_off),
+        interpret=True,
+    )
+    ok = np.asarray(
+        curve.compressed_equals(p, jnp.asarray(r_y), jnp.asarray(r_sign))
+    )[:n] & valid_host
+    assert ok.tolist() == want.tolist()
+    assert not ok[4] and ok[:4].all() and ok[5:].all()
