@@ -202,3 +202,27 @@ def test_pallas_split_kernel_parity_interpret():
     )[:n] & valid_host
     assert ok.tolist() == want.tolist()
     assert not ok[4] and ok[:4].all() and ok[5:].all()
+
+
+def test_stage_routing_thresholds():
+    """stage() routing contract: <= SPLIT_MAX signatures take the split
+    kernel when pallas is on; larger batches and non-pallas verifiers
+    take _run_kernel."""
+    from hotstuff_tpu.tpu import ed25519 as mod
+
+    items = _sign_many(3, lambda i: b"route-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+
+    v = BatchVerifier(min_device_batch=0, use_pallas=True)
+    kernel, arrays, valid = v.stage(msgs, pks, sigs)
+    assert kernel is mod._verify_kernel_pallas_split
+    assert valid.all() and len(arrays) == 9  # incl. base_off
+
+    v_plain = BatchVerifier(min_device_batch=0, use_pallas=False)
+    kernel, arrays, _ = v_plain.stage(msgs, pks, sigs)
+    assert kernel == v_plain._run_kernel and len(arrays) == 8
+
+    big = BatchVerifier(min_device_batch=0, use_pallas=True)
+    n = big.SPLIT_MAX + 1
+    kernel, _, _ = big.stage([msgs[0]] * n, [pks[0]] * n, [sigs[0]] * n)
+    assert kernel == big._run_kernel
