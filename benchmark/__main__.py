@@ -30,13 +30,17 @@ def task_local(args) -> int:
         timeout_delay=args.timeout_delay,
         verifier=args.verifier,
         transport=args.transport,
+        scheme=args.scheme,
     )
     parser = bench.run()
+    label = (
+        args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
+    )
     summary = parser.result(
-        faults=args.faults, nodes=args.nodes, verifier=args.verifier
+        faults=args.faults, nodes=args.nodes, verifier=label
     )
     print(summary)
-    _save_result(summary, args.faults, args.nodes, args.rate, args.verifier,
+    _save_result(summary, args.faults, args.nodes, args.rate, label,
                  ok=parser.has_window())
     return 0
 
@@ -143,6 +147,12 @@ def main(argv=None) -> int:
     p.add_argument("--timeout-delay", type=int, default=5_000)
     p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
     p.add_argument("--transport", choices=["asyncio", "native"], default="asyncio")
+    p.add_argument(
+        "--scheme",
+        choices=["ed25519", "bls"],
+        default="ed25519",
+        help="committee signature scheme (bls = aggregate QC verification)",
+    )
     p.set_defaults(fn=task_local)
 
     p = sub.add_parser("tpu")

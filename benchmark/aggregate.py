@@ -15,8 +15,11 @@ from statistics import mean, stdev
 
 from .utils import PathMaker
 
+# verifier labels may be hyphenated ("tpu-sharded", "bls-cpu"), so the
+# verifier group is [\w-]+? with the optional trailing run index kept
+# non-greedy-separable by anchoring it to a pure-digit group.
 RE_RESULT = re.compile(
-    r"bench-(\d+)-(\d+)-(\d+)-(\w+)(?:-\d+)?\.txt$"
+    r"bench-(\d+)-(\d+)-(\d+)-([\w-]+?)(?:-(\d+))?\.txt$"
 )
 RE_METRICS = {
     "consensus_tps": re.compile(r"Consensus TPS: ([\d.]+)"),

@@ -39,6 +39,7 @@ class LocalBench:
         verifier: str = "cpu",
         transport: str = "asyncio",
         base_port: int = BASE_PORT,
+        scheme: str = "ed25519",
     ):
         self.nodes = nodes
         self.rate = rate
@@ -49,6 +50,7 @@ class LocalBench:
         self.verifier = verifier
         self.transport = transport
         self.base_port = base_port
+        self.scheme = scheme
         self._procs: list[subprocess.Popen] = []
 
     # ---- setup/teardown ----------------------------------------------------
@@ -72,12 +74,14 @@ class LocalBench:
         self._procs.clear()
 
     def _config(self) -> None:
-        keys = [Secret.new() for _ in range(self.nodes)]
+        keys = [Secret.new(self.scheme) for _ in range(self.nodes)]
         committee = Committee.new(
             [
                 (secret.name, 1, ("127.0.0.1", self.base_port + i))
                 for i, secret in enumerate(keys)
-            ]
+            ],
+            scheme=self.scheme,
+            pops={s.name: s.pop for s in keys if s.pop is not None},
         )
         write_committee(committee, PathMaker.committee_file())
         write_parameters(

@@ -57,29 +57,77 @@ class Parameters:
         )
 
 
+class InvalidCommittee(ValueError):
+    """A committee file that must not be allowed to run (missing/bad
+    BLS proofs of possession)."""
+
+
 @dataclass
 class Authority:
     stake: int
     address: Address
+    # BLS proof of possession (48-byte G1, scheme="bls" only).  REQUIRED
+    # for BLS committees: aggregate (sum-of-public-keys) QC verification
+    # is forgeable by an adversarially chosen "rogue" key otherwise —
+    # pk_m = a·G2 − Σ pk_honest lets one member fabricate a QC carrying
+    # honest authorities' names.  A PoP proves knowledge of the secret,
+    # which rules the construction out.  Enforced at Consensus.spawn via
+    # ``Committee.verify_pops``.
+    pop: bytes | None = None
 
 
 @dataclass
 class Committee:
-    """The validator set: voting power and network address per authority."""
+    """The validator set: voting power and network address per authority.
+
+    ``scheme`` is the committee-wide signature scheme ("ed25519" default,
+    "bls" for the BLS12-381 aggregate-signature variant) — a committee
+    never mixes schemes; nodes dispatch signing/verification on it
+    (crypto/scheme.py)."""
 
     authorities: dict[PublicKey, Authority] = field(default_factory=dict)
     epoch: int = 1
+    scheme: str = "ed25519"
 
     @classmethod
     def new(
-        cls, info: list[tuple[PublicKey, int, Address]], epoch: int = 1
+        cls,
+        info: list[tuple[PublicKey, int, Address]],
+        epoch: int = 1,
+        scheme: str = "ed25519",
+        pops: dict[PublicKey, bytes] | None = None,
     ) -> "Committee":
+        pops = pops or {}
         return cls(
             authorities={
-                name: Authority(stake, address) for name, stake, address in info
+                name: Authority(stake, address, pop=pops.get(name))
+                for name, stake, address in info
             },
             epoch=epoch,
+            scheme=scheme,
         )
+
+    def verify_pops(self) -> None:
+        """BLS committees: require a valid proof of possession per
+        authority (see ``Authority.pop``); no-op for ed25519 (per-vote
+        signatures there already prove key possession).  Raises
+        ``InvalidCommittee``.  Cost: one pairing equality (~40 ms) per
+        member, paid once at spawn."""
+        if self.scheme != "bls":
+            return
+        from ..crypto.bls import BlsPublicKey, BlsSignature, verify_possession
+
+        for pk, auth in self.authorities.items():
+            if auth.pop is None:
+                raise InvalidCommittee(
+                    f"BLS committee member {pk} has no proof of possession"
+                )
+            pub = BlsPublicKey.from_bytes(pk.to_bytes())
+            proof = BlsSignature.from_bytes(auth.pop)
+            if pub is None or proof is None or not verify_possession(pub, proof):
+                raise InvalidCommittee(
+                    f"invalid BLS proof of possession for {pk}"
+                )
 
     def size(self) -> int:
         return len(self.authorities)
@@ -114,26 +162,42 @@ class Committee:
         return sorted(self.authorities.keys())
 
     def to_json(self) -> dict:
+        import base64
+
         return {
             "authorities": {
                 pk.encode_base64(): {
                     "stake": a.stake,
                     "address": format_address(a.address),
+                    **(
+                        {"pop": base64.b64encode(a.pop).decode()}
+                        if a.pop is not None
+                        else {}
+                    ),
                 }
                 for pk, a in self.authorities.items()
             },
             "epoch": self.epoch,
+            "scheme": self.scheme,
         }
 
     @classmethod
     def from_json(cls, data: dict) -> "Committee":
+        import base64
+
         return cls(
             authorities={
                 PublicKey.decode_base64(pk): Authority(
                     stake=int(entry["stake"]),
                     address=parse_address(entry["address"]),
+                    pop=(
+                        base64.b64decode(entry["pop"])
+                        if "pop" in entry
+                        else None
+                    ),
                 )
                 for pk, entry in data["authorities"].items()
             },
             epoch=int(data.get("epoch", 1)),
+            scheme=data.get("scheme", "ed25519"),
         )

@@ -100,6 +100,10 @@ class Consensus:
         self = cls()
         # NOTE: this log entry is used to compute performance.
         parameters.log()
+        # BLS committees: refuse to run without a valid proof of
+        # possession per member — sum-of-keys QC verification is
+        # rogue-key forgeable otherwise (see Authority.pop).
+        committee.verify_pops()
         if verifier is None:
             verifier = CpuVerifier()
 

@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 
 from ..crypto import Digest, PublicKey, Signature, sha512_trunc
 from ..crypto.service import VerifierBackend
-from ..utils.codec import Decoder, Encoder
+from ..utils.codec import CodecError, Decoder, Encoder
 from .config import Committee
 from .errors import (
     AuthorityReuse,
@@ -37,9 +37,36 @@ from .errors import (
 
 Round = int
 
+# Wire cap for length-prefixed key/signature fields (largest scheme:
+# BLS 96-byte public keys; Ed25519 is 32/64).  One committee uses one
+# scheme; the length prefix lets both coexist in the one wire format.
+_MAX_KEYSIG = 96
+
 
 def _round_le(r: Round) -> bytes:
     return struct.pack("<Q", r)
+
+
+def encode_pk(enc: Encoder, pk: PublicKey) -> None:
+    enc.var_bytes(pk.to_bytes())
+
+
+def decode_pk(dec: Decoder) -> PublicKey:
+    try:
+        return PublicKey(dec.var_bytes(_MAX_KEYSIG))
+    except ValueError as e:
+        raise CodecError(str(e)) from e
+
+
+def encode_sig(enc: Encoder, sig: Signature) -> None:
+    enc.var_bytes(sig.to_bytes())
+
+
+def decode_sig(dec: Decoder) -> Signature:
+    try:
+        return Signature(dec.var_bytes(_MAX_KEYSIG))
+    except ValueError as e:
+        raise CodecError(str(e)) from e
 
 
 def _check_certificate_weight(
@@ -104,17 +131,15 @@ class QC:
     def encode(self, enc: Encoder) -> None:
         enc.raw(self.hash.to_bytes()).u64(self.round).u32(len(self.votes))
         for pk, sig in self.votes:
-            enc.raw(pk.to_bytes()).raw(sig.to_bytes())
+            encode_pk(enc, pk)
+            encode_sig(enc, sig)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "QC":
         h = Digest(dec.raw(Digest.SIZE))
         rnd = dec.u64()
         n = dec.u32()
-        votes = [
-            (PublicKey(dec.raw(PublicKey.SIZE)), Signature(dec.raw(Signature.SIZE)))
-            for _ in range(n)
-        ]
+        votes = [(decode_pk(dec), decode_sig(dec)) for _ in range(n)]
         return cls(hash=h, round=rnd, votes=votes)
 
     def __repr__(self) -> str:
@@ -154,19 +179,16 @@ class TC:
     def encode(self, enc: Encoder) -> None:
         enc.u64(self.round).u32(len(self.votes))
         for pk, sig, hq in self.votes:
-            enc.raw(pk.to_bytes()).raw(sig.to_bytes()).u64(hq)
+            encode_pk(enc, pk)
+            encode_sig(enc, sig)
+            enc.u64(hq)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "TC":
         rnd = dec.u64()
         n = dec.u32()
         votes = [
-            (
-                PublicKey(dec.raw(PublicKey.SIZE)),
-                Signature(dec.raw(Signature.SIZE)),
-                dec.u64(),
-            )
-            for _ in range(n)
+            (decode_pk(dec), decode_sig(dec), dec.u64()) for _ in range(n)
         ]
         return cls(round=rnd, votes=votes)
 
@@ -249,21 +271,22 @@ class Block:
         enc.flag(self.tc is not None)
         if self.tc is not None:
             self.tc.encode(enc)
-        enc.raw(self.author.to_bytes()).u64(self.round)
+        encode_pk(enc, self.author)
+        enc.u64(self.round)
         enc.u32(len(self.payloads))
         for p in self.payloads:
             enc.raw(p.to_bytes())
-        enc.raw(self.signature.to_bytes())
+        encode_sig(enc, self.signature)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Block":
         qc = QC.decode(dec)
         tc = TC.decode(dec) if dec.flag() else None
-        author = PublicKey(dec.raw(PublicKey.SIZE))
+        author = decode_pk(dec)
         rnd = dec.u64()
         n = dec.u32()
         payloads = tuple(Digest(dec.raw(Digest.SIZE)) for _ in range(n))
-        sig = Signature(dec.raw(Signature.SIZE))
+        sig = decode_sig(dec)
         return cls(
             qc=qc, tc=tc, author=author, round=rnd, payloads=payloads, signature=sig
         )
@@ -324,15 +347,16 @@ class Vote:
 
     def encode(self, enc: Encoder) -> None:
         enc.raw(self.hash.to_bytes()).u64(self.round)
-        enc.raw(self.author.to_bytes()).raw(self.signature.to_bytes())
+        encode_pk(enc, self.author)
+        encode_sig(enc, self.signature)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Vote":
         return cls(
             hash=Digest(dec.raw(Digest.SIZE)),
             round=dec.u64(),
-            author=PublicKey(dec.raw(PublicKey.SIZE)),
-            signature=Signature(dec.raw(Signature.SIZE)),
+            author=decode_pk(dec),
+            signature=decode_sig(dec),
         )
 
     def __repr__(self) -> str:
@@ -362,15 +386,16 @@ class Timeout:
     def encode(self, enc: Encoder) -> None:
         self.high_qc.encode(enc)
         enc.u64(self.round)
-        enc.raw(self.author.to_bytes()).raw(self.signature.to_bytes())
+        encode_pk(enc, self.author)
+        encode_sig(enc, self.signature)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Timeout":
         return cls(
             high_qc=QC.decode(dec),
             round=dec.u64(),
-            author=PublicKey(dec.raw(PublicKey.SIZE)),
-            signature=Signature(dec.raw(Signature.SIZE)),
+            author=decode_pk(dec),
+            signature=decode_sig(dec),
         )
 
     def __repr__(self) -> str:

@@ -10,7 +10,7 @@ from __future__ import annotations
 from ..crypto import Digest, PublicKey
 from ..utils.codec import CodecError, Decoder, Encoder
 from .errors import SerializationError
-from .messages import TC, Block, Timeout, Vote
+from .messages import TC, Block, Timeout, Vote, decode_pk, encode_pk
 
 TAG_PROPOSE = 0
 TAG_VOTE = 1
@@ -47,13 +47,9 @@ def encode_tc(tc: TC) -> bytes:
 
 
 def encode_sync_request(missing: Digest, origin: PublicKey) -> bytes:
-    return (
-        Encoder()
-        .u8(TAG_SYNC_REQUEST)
-        .raw(missing.to_bytes())
-        .raw(origin.to_bytes())
-        .finish()
-    )
+    enc = Encoder().u8(TAG_SYNC_REQUEST).raw(missing.to_bytes())
+    encode_pk(enc, origin)
+    return enc.finish()
 
 
 def encode_producer(payload: Digest) -> bytes:
@@ -78,7 +74,7 @@ def decode_message(data: bytes):
         elif tag == TAG_TC:
             out = TC.decode(dec)
         elif tag == TAG_SYNC_REQUEST:
-            out = (Digest(dec.raw(Digest.SIZE)), PublicKey(dec.raw(PublicKey.SIZE)))
+            out = (Digest(dec.raw(Digest.SIZE)), decode_pk(dec))
         elif tag == TAG_PRODUCER:
             out = Digest(dec.raw(Digest.SIZE))
         else:

@@ -114,17 +114,12 @@ def keygen(seed: bytes | None = None) -> tuple[BlsPublicKey, BlsSecretKey]:
 
 
 def aggregate_signatures(sigs: list[BlsSignature]) -> BlsSignature:
-    acc = G1Point.identity()
-    for s in sigs:
-        acc = acc + s.point
-    return BlsSignature(acc)
+    # Jacobian accumulation: no per-addition field inversion.
+    return BlsSignature(G1Point.sum([s.point for s in sigs]))
 
 
 def aggregate_public_keys(pks: list[BlsPublicKey]) -> BlsPublicKey:
-    acc = G2Point.identity()
-    for pk in pks:
-        acc = acc + pk.point
-    return BlsPublicKey(acc)
+    return BlsPublicKey(G2Point.sum([pk.point for pk in pks]))
 
 
 def verify_aggregate(

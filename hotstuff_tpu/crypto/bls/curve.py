@@ -3,9 +3,19 @@
 G1: y^2 = x^3 + 4         over Fq,  order R, cofactor H1.
 G2: y^2 = x^3 + 4(u + 1)  over Fq2, order R, cofactor H2.
 
-Affine coordinates with Python big ints — clarity over speed; this is
-the CPU reference backend (the hot path for consensus is Ed25519 on the
-TPU; BLS is the threshold variant, BASELINE config 5).
+The public API is affine (points compare and serialize by affine
+coordinates, matching the wire formats), but all scalar multiplication
+and multi-point accumulation run in Jacobian coordinates internally —
+one field inversion per *operation* instead of one per *point addition*
+(the round-1 affine ladder cost ~500 modular inversions per scalar
+multiply, ~700 ms; Jacobian is ~1-3 ms).  The same generic ladder
+serves both fields: the coordinate ops are passed in as closures.
+
+Round-1 bug fixed here: ``mul`` reduces its scalar mod R, so the
+serialization subgroup check ``pt.mul(R)`` was a no-op (mul(0) — every
+on-curve point passed).  Subgroup and cofactor multiplications now use
+the unreduced ``_mul_raw``, and the check is pinned by a test with an
+on-curve point outside the r-torsion (tests/test_bls.py).
 """
 
 from __future__ import annotations
@@ -30,8 +40,124 @@ G2_Y = (
 )
 
 
+# -- generic Jacobian ladder -------------------------------------------------
+#
+# A point is (X, Y, Z); Z "zero" means the identity.  The element ops are
+# injected per field: (mul, sqr, red, inv, is_zero, one, zero).
+
+
+class _Ops:
+    __slots__ = ("mul", "sqr", "red", "inv", "is_zero", "one")
+
+    def __init__(self, mul, sqr, red, inv, is_zero, one):
+        self.mul, self.sqr, self.red = mul, sqr, red
+        self.inv, self.is_zero, self.one = inv, is_zero, one
+
+
+_FQ_OPS = _Ops(
+    mul=lambda a, b: a * b % P,
+    sqr=lambda a: a * a % P,
+    red=lambda a: a % P,
+    inv=fq_inv,
+    is_zero=lambda a: a % P == 0,
+    one=1,
+)
+
+_FQ2_OPS = _Ops(
+    mul=lambda a, b: a * b,
+    sqr=lambda a: a.square(),
+    red=lambda a: a,
+    inv=lambda a: a.inverse(),
+    is_zero=lambda a: a.is_zero(),
+    one=Fq2.ONE,
+)
+
+
+def _jac_double(pt, o: _Ops):
+    X1, Y1, Z1 = pt
+    if o.is_zero(Z1) or o.is_zero(Y1):
+        return pt if o.is_zero(Z1) else (X1, Y1, Z1 - Z1)  # 2-torsion → ∞
+    A = o.sqr(X1)
+    B = o.sqr(Y1)
+    C = o.sqr(B)
+    t = o.sqr(X1 + B) - A - C
+    D = o.red(t + t)
+    E = o.red(A + A + A)
+    F = o.sqr(E)
+    X3 = o.red(F - D - D)
+    Y3 = o.red(o.mul(E, D - X3) - (C + C + C + C + C + C + C + C))
+    Z3 = o.mul(Y1 + Y1, Z1)
+    return (X3, Y3, Z3)
+
+
+def _jac_add(p1, p2, o: _Ops):
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    if o.is_zero(Z1):
+        return p2
+    if o.is_zero(Z2):
+        return p1
+    Z1Z1 = o.sqr(Z1)
+    Z2Z2 = o.sqr(Z2)
+    U1 = o.mul(X1, Z2Z2)
+    U2 = o.mul(X2, Z1Z1)
+    S1 = o.mul(o.mul(Y1, Z2), Z2Z2)
+    S2 = o.mul(o.mul(Y2, Z1), Z1Z1)
+    H = o.red(U2 - U1)
+    rr = o.red(S2 - S1)
+    if o.is_zero(H):
+        if o.is_zero(rr):
+            return _jac_double(p1, o)
+        return (o.one, o.one, U1 - U1)  # P + (−P) = ∞ (zero Z)
+    I = o.sqr(H + H)
+    J = o.mul(H, I)
+    rr = rr + rr
+    V = o.mul(U1, I)
+    X3 = o.red(o.sqr(rr) - J - V - V)
+    S1J = o.mul(S1, J)
+    Y3 = o.red(o.mul(rr, V - X3) - S1J - S1J)
+    Z3 = o.mul(o.red(o.sqr(Z1 + Z2) - Z1Z1 - Z2Z2), H)
+    return (X3, Y3, Z3)
+
+
+def _jac_mul(affine_xy, k: int, o: _Ops):
+    """k·P for affine P, k >= 0 unreduced; returns a Jacobian triple."""
+    x, y = affine_xy
+    inf = (o.one, o.one, x - x)  # zero Z
+    if k == 0:
+        return inf
+    base = (x, y, o.one)
+    acc = inf
+    for bit in bin(k)[2:]:
+        acc = _jac_double(acc, o)
+        if bit == "1":
+            acc = _jac_add(acc, base, o)
+    return acc
+
+
+def _jac_sum(points_affine, o: _Ops):
+    """Σ points (affine list) as a Jacobian triple — one tree-free
+    left-fold; each step is a full Jacobian add (no inversions)."""
+    if not points_affine:
+        return (o.one, o.one, o.one - o.one)
+    acc = (points_affine[0][0], points_affine[0][1], o.one)
+    for x, y in points_affine[1:]:
+        acc = _jac_add(acc, (x, y, o.one), o)
+    return acc
+
+
+def _jac_to_affine(pt, o: _Ops):
+    """(x, y) or None for the identity."""
+    X, Y, Z = pt
+    if o.is_zero(Z):
+        return None
+    zi = o.inv(o.red(Z))
+    zi2 = o.sqr(zi)
+    return (o.mul(X, zi2), o.mul(o.mul(Y, zi), zi2))
+
+
 class G1Point:
-    """Affine G1 point; None coordinates = identity."""
+    """Affine G1 point; ``inf`` = identity."""
 
     __slots__ = ("x", "y", "inf")
 
@@ -76,7 +202,6 @@ class G1Point:
         if self.x == o.x:
             if (self.y + o.y) % P == 0:
                 return G1Point.identity()
-            # doubling
             lam = (3 * self.x * self.x) * fq_inv(2 * self.y) % P
         else:
             lam = (o.y - self.y) * fq_inv(o.x - self.x) % P
@@ -84,16 +209,34 @@ class G1Point:
         y3 = (lam * (self.x - x3) - self.y) % P
         return G1Point(x3, y3)
 
+    def _from_jac(self, jac) -> "G1Point":
+        aff = _jac_to_affine(jac, _FQ_OPS)
+        return G1Point.identity() if aff is None else G1Point(aff[0], aff[1])
+
+    def _mul_raw(self, k: int) -> "G1Point":
+        """k·P with the scalar taken as-is (cofactor clearing, subgroup
+        checks — where reducing mod R would be wrong)."""
+        if self.inf or k == 0:
+            return G1Point.identity()
+        return self._from_jac(_jac_mul((self.x, self.y), k, _FQ_OPS))
+
     def mul(self, k: int) -> "G1Point":
-        k %= R
-        result = G1Point.identity()
-        add = self
-        while k > 0:
-            if k & 1:
-                result = result + add
-            add = add + add
-            k >>= 1
-        return result
+        return self._mul_raw(k % R)
+
+    def mul_by_cofactor(self) -> "G1Point":
+        return self._mul_raw(H1)
+
+    def in_subgroup(self) -> bool:
+        return self._mul_raw(R).inf
+
+    @classmethod
+    def sum(cls, points: list["G1Point"]) -> "G1Point":
+        """Σ points without per-addition inversions (aggregation path)."""
+        affs = [(q.x, q.y) for q in points if not q.inf]
+        if not affs:
+            return cls.identity()
+        aff = _jac_to_affine(_jac_sum(affs, _FQ_OPS), _FQ_OPS)
+        return cls.identity() if aff is None else cls(aff[0], aff[1])
 
     # -- serialization (zcash/ietf compressed format, 48 bytes) -------------
 
@@ -124,8 +267,7 @@ class G1Point:
         if (y > (P - 1) // 2) != sign:
             y = P - y
         pt = cls(x, y)
-        # subgroup check
-        if not pt.mul(R).inf:
+        if not pt.in_subgroup():
             return None
         return pt
 
@@ -183,16 +325,28 @@ class G2Point:
         y3 = lam * (self.x - x3) - self.y
         return G2Point(x3, y3)
 
+    def _from_jac(self, jac) -> "G2Point":
+        aff = _jac_to_affine(jac, _FQ2_OPS)
+        return G2Point.identity() if aff is None else G2Point(aff[0], aff[1])
+
+    def _mul_raw(self, k: int) -> "G2Point":
+        if self.inf or k == 0:
+            return G2Point.identity()
+        return self._from_jac(_jac_mul((self.x, self.y), k, _FQ2_OPS))
+
     def mul(self, k: int) -> "G2Point":
-        k %= R
-        result = G2Point.identity()
-        add = self
-        while k > 0:
-            if k & 1:
-                result = result + add
-            add = add + add
-            k >>= 1
-        return result
+        return self._mul_raw(k % R)
+
+    def in_subgroup(self) -> bool:
+        return self._mul_raw(R).inf
+
+    @classmethod
+    def sum(cls, points: list["G2Point"]) -> "G2Point":
+        affs = [(q.x, q.y) for q in points if not q.inf]
+        if not affs:
+            return cls.identity()
+        aff = _jac_to_affine(_jac_sum(affs, _FQ2_OPS), _FQ2_OPS)
+        return cls.identity() if aff is None else cls(aff[0], aff[1])
 
     # -- serialization (compressed, 96 bytes) --------------------------------
 
@@ -232,7 +386,7 @@ class G2Point:
         if great != sign:
             y = -y
         pt = cls(x, y)
-        if not pt.mul(R).inf:
+        if not pt.in_subgroup():
             return None
         return pt
 
@@ -261,21 +415,3 @@ def hash_to_g1(message: bytes, dst: bytes = b"HOTSTUFF_TPU_BLS_G1") -> G1Point:
                 y = P - y
             return G1Point(x, y).mul_by_cofactor()
         counter += 1
-
-
-def _mul_any(pt: G1Point, k: int) -> G1Point:
-    result = G1Point.identity()
-    add = pt
-    while k > 0:
-        if k & 1:
-            result = result + add
-        add = add + add
-        k >>= 1
-    return result
-
-
-def _mul_by_cofactor(self: G1Point) -> G1Point:
-    return _mul_any(self, H1)
-
-
-G1Point.mul_by_cofactor = _mul_by_cofactor  # type: ignore[attr-defined]

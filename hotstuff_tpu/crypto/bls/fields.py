@@ -211,7 +211,42 @@ class Fq12:
         return Fq12(c0, c1)
 
     def square(self) -> "Fq12":
-        return self * self
+        # complex squaring: (c0 + c1 w)² = (c0² + v·c1²) + 2c0c1·w with
+        # c0² + v·c1² = (c0 + c1)(c0 + v·c1) − t − v·t, t = c0c1
+        # — 2 Fq6 multiplies instead of 3.
+        t = self.c0 * self.c1
+        m = (self.c0 + self.c1) * (self.c0 + self.c1.mul_by_nonresidue())
+        return Fq12(m - t - t.mul_by_nonresidue(), t + t)
+
+    def cyclotomic_square(self) -> "Fq12":
+        """Granger-Scott squaring — valid ONLY for elements of the
+        cyclotomic subgroup (f^(p⁴−p²+1) = 1, i.e. anything after the
+        easy part of the final exponentiation).  Fq12 as Fq4[z]/(z³−y)
+        with Fq4 components (c0.c0, c1.c1), (c1.c0, c0.c2),
+        (c0.c1, c1.c2); ~3x cheaper than ``square`` — the exponentiation
+        chain of the hard part runs almost entirely on this.
+        Pinned against ``square`` on cyclotomic elements in tests."""
+        z0, z4, z3 = self.c0.c0, self.c0.c1, self.c0.c2
+        z2, z1, z5 = self.c1.c0, self.c1.c1, self.c1.c2
+
+        def fq4_square(a0: Fq2, a1: Fq2) -> tuple[Fq2, Fq2]:
+            # (a0 + a1 y)² with y² = u+1
+            t = a0 * a1
+            sq = (a0 + a1) * (a0 + a1.mul_by_nonresidue())
+            return sq - t - t.mul_by_nonresidue(), t + t
+
+        t0, t1 = fq4_square(z0, z1)
+        t2, t3 = fq4_square(z2, z3)
+        t4, t5 = fq4_square(z4, z5)
+        # z_i' = 3·t − (±)2·z with the Granger-Scott sign pattern
+        z0 = t0 + (t0 - z0) + (t0 - z0)
+        z1 = t1 + (t1 + z1) + (t1 + z1)
+        nr_t5 = t5.mul_by_nonresidue()
+        z2 = nr_t5 + (nr_t5 + z2) + (nr_t5 + z2)
+        z3 = t4 + (t4 - z3) + (t4 - z3)
+        z4 = t2 + (t2 - z4) + (t2 - z4)
+        z5 = t3 + (t3 + z5) + (t3 + z5)
+        return Fq12(Fq6(z0, z4, z3), Fq6(z2, z1, z5))
 
     def conjugate(self) -> "Fq12":
         return Fq12(self.c0, -self.c1)

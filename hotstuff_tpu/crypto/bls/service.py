@@ -15,8 +15,6 @@ crypto/src/lib.rs:232-257; BASELINE config 5's threshold variant uses
 
 from __future__ import annotations
 
-import asyncio
-
 from . import (
     BlsPublicKey,
     BlsSecretKey,
@@ -87,42 +85,82 @@ class BlsVerifier:
         return aggregate_public_keys(pks).verify(msg, agg_sig)
 
     def verify_many(self, digests, pks, sigs) -> list[bool]:
+        """Distinct-message batch (the TC-verify shape): one multi-pairing
+        with random 128-bit weights sharing a single final exponentiation
+        — Π e(rᵢ·H(mᵢ), pkᵢ) · e(−Σ rᵢ·sigᵢ, G2) == 1.  The random
+        weights make cross-entry cancellation infeasible (standard
+        small-exponents batching), so a passing product implies every
+        entry verifies; on failure, fall back per-item to report WHICH
+        entries are invalid.  Cost: n+1 Miller loops + 1 final exp
+        (~13 ms/entry) vs n full pairing equalities (~40 ms/entry) —
+        this is the view-change-storm path (TC.verify, BASELINE
+        config 4), which runs on the event loop while round timers are
+        already firing."""
+        import secrets
+
+        from .curve import G1Point, G2Point, hash_to_g1
+        from .fields import Fq12
+        from .pairing import final_exponentiation, miller_loop
+
+        n = len(digests)
+        if n == 0:
+            return []
+        entries = []
+        for d, p, s in zip(digests, pks, sigs):
+            pub = self._pk(p if isinstance(p, bytes) else p.to_bytes())
+            sig = BlsSignature.from_bytes(
+                s if isinstance(s, bytes) else s.to_bytes()
+            )
+            msg = d if isinstance(d, bytes) else d.to_bytes()
+            if pub is None or sig is None or pub.point.inf or sig.point.inf:
+                entries = None  # malformed entry: no batch shortcut
+                break
+            entries.append((msg, pub.point, sig.point))
+        if entries is not None and n > 1:
+            weights = [secrets.randbits(128) | 1 for _ in range(n)]
+            agg = G1Point.sum(
+                [sig_pt._mul_raw(r) for (_, _, sig_pt), r in zip(entries, weights)]
+            )
+            f = Fq12.ONE
+            for (msg, pk_pt, _), r in zip(entries, weights):
+                f = f * miller_loop(hash_to_g1(msg)._mul_raw(r), pk_pt)
+            f = f * miller_loop(-agg, G2Point.generator())
+            if final_exponentiation(f) == Fq12.ONE:
+                return [True] * n
         return [
             self.verify_one(d, p, s) for d, p, s in zip(digests, pks, sigs)
         ]
 
 
-class BlsSignatureService:
-    """Actor-shaped signing service (reference crypto/src/lib.rs:232-257):
-    callers await ``request_signature(digest)``; one task owns the key."""
+class BlsSigningService:
+    """The BLS signing service behind the SignatureService API surface
+    (reference crypto/src/lib.rs:232-257).  Signing is inline — the
+    single-threaded loop already serializes access to the key, the same
+    argument as the Ed25519 service — ~6 ms per sign (hash-to-G1 + one
+    G1 scalar multiply).  Returns the scheme-agnostic consensus
+    ``Signature`` wrapper (48-byte compressed G1) so votes/blocks carry
+    BLS material through the identical protocol types."""
 
-    def __init__(self, secret: BlsSecretKey):
-        self._secret = secret
-        self._queue: asyncio.Queue = asyncio.Queue()
-        self._task: asyncio.Task | None = None
+    def __init__(self, secret: BlsSecretKey | bytes):
+        if isinstance(secret, (bytes, bytearray)):
+            secret = BlsSecretKey(int.from_bytes(bytes(secret), "big"))
+        self._sk: BlsSecretKey | None = secret
+        self._closed = False
 
-    def _ensure_started(self) -> None:
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(
-                self._run(), name="bls-signature-service"
-            )
+    async def request_signature(self, digest) -> "Signature":
+        return self.sign_sync(digest)
 
-    async def _run(self) -> None:
-        while True:
-            digest, fut = await self._queue.get()
-            if not fut.done():
-                fut.set_result(self._secret.sign(digest))
+    def sign_sync(self, digest) -> "Signature":
+        from ..signature import Signature
 
-    async def request_signature(self, digest: bytes) -> BlsSignature:
-        self._ensure_started()
-        fut = asyncio.get_running_loop().create_future()
-        await self._queue.put((digest, fut))
-        return await fut
+        if self._closed or self._sk is None:
+            raise RuntimeError("BlsSigningService is shut down")
+        msg = digest if isinstance(digest, bytes) else digest.to_bytes()
+        return Signature(self._sk.sign(msg).to_bytes())
 
     def shutdown(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+        self._closed = True
+        self._sk = None
 
 
-__all__ = ["BlsVerifier", "BlsSignatureService", "keygen"]
+__all__ = ["BlsVerifier", "BlsSigningService", "keygen"]

@@ -29,10 +29,18 @@ PUBLIC_KEY_SIZE = 32
 SECRET_KEY_SIZE = 64  # ed25519 seed (32) || public key (32)
 
 
+BLS_PUBLIC_KEY_SIZE = 96  # compressed G2 (crypto/bls)
+
+
 class PublicKey(FixedBytes):
-    """A 32-byte ed25519 public key, base64-encoded for configs/wire."""
+    """An authority identity key, base64-encoded for configs/wire.
+
+    32 bytes under the default Ed25519 scheme, 96 (compressed G2) under
+    the BLS12-381 scheme (``crypto/scheme.py``); a committee never mixes
+    schemes, and pk fields are length-prefixed on the wire."""
 
     SIZE = PUBLIC_KEY_SIZE
+    SIZES = frozenset({PUBLIC_KEY_SIZE, BLS_PUBLIC_KEY_SIZE})
     __slots__ = ()
 
 

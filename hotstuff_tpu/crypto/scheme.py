@@ -1,0 +1,177 @@
+"""Crypto scheme registry: Ed25519 (default) and BLS12-381.
+
+The reference hard-codes ed25519-dalek behind its ``SignatureService``
+boundary (crypto/src/lib.rs:232-257).  This framework makes the scheme a
+committee-level property so a BLS-signed committee (BASELINE config 5 —
+constant-cost QC verification via signature aggregation, TPU G1 sum) is
+selectable end-to-end from the node CLI: ``keys --scheme bls`` writes a
+BLS keypair file, the committee file records the scheme, and ``Node.new``
+dispatches here for the signing service and verifier backend.
+
+A scheme bundles:
+- key/signature byte formats (PublicKey 32 vs 96, Signature 64 vs 48 —
+  protocol wire fields are length-prefixed, so both coexist);
+- deterministic + OS keygen;
+- the signing-service factory (actor holding the secret key);
+- the verifier-backend factory (cpu / device variants).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+from .keys import PublicKey, SecretKey, generate_keypair, generate_production_keypair
+from .service import CpuVerifier, SignatureService, VerifierBackend
+
+SCHEMES = ("ed25519", "bls")
+DEFAULT_SCHEME = "ed25519"
+
+
+class UnknownScheme(ValueError):
+    def __init__(self, name: str):
+        super().__init__(
+            f"unknown crypto scheme '{name}' (expected one of {SCHEMES})"
+        )
+
+
+class OpaqueSecret:
+    """Scheme-agnostic secret bytes with the SecretKey wipe contract
+    (best-effort zeroing; accessors raise after wipe)."""
+
+    __slots__ = ("_data", "_wiped")
+
+    def __init__(self, data: bytes):
+        self._data = bytearray(data)
+        self._wiped = False
+
+    def to_bytes(self) -> bytes:
+        if self._wiped:
+            raise RuntimeError("secret has been wiped")
+        return bytes(self._data)
+
+    def encode_base64(self) -> str:
+        import base64
+
+        return base64.b64encode(self.to_bytes()).decode()
+
+    @classmethod
+    def decode_base64(cls, s: str) -> "OpaqueSecret":
+        import base64
+
+        return cls(base64.b64decode(s))
+
+    def wipe(self) -> None:
+        for i in range(len(self._data)):
+            self._data[i] = 0
+        self._wiped = True
+
+    @property
+    def wiped(self) -> bool:
+        return self._wiped
+
+    def __repr__(self) -> str:  # never print key material
+        return "OpaqueSecret(<redacted>)"
+
+
+def bls_keygen(seed: bytes | None = None, index: int = 0) -> tuple[PublicKey, bytes]:
+    """(96-byte G2 public key, 32-byte big-endian scalar secret).
+
+    Deterministic derivation mirrors the Ed25519 fixture convention
+    (keys.py): scalar_i = SHA-512("bls-keygen" ‖ seed ‖ u64_le(i)) mod
+    (R−1) + 1."""
+    from .bls.fields import R as BLS_R
+
+    if seed is None:
+        material = os.urandom(64)
+    else:
+        material = hashlib.sha512(
+            b"bls-keygen" + seed + struct.pack("<Q", index)
+        ).digest()
+    scalar = (int.from_bytes(material, "big") % (BLS_R - 1)) + 1
+    from .bls import BlsSecretKey
+
+    sk = BlsSecretKey(scalar)
+    pk = PublicKey(sk.public_key().to_bytes())
+    return pk, scalar.to_bytes(32, "big")
+
+
+def bls_pop(secret_bytes: bytes) -> bytes:
+    """48-byte proof of possession for a BLS secret — REQUIRED committee
+    material (``consensus.config.Authority.pop``): sum-of-keys QC
+    verification is rogue-key forgeable without it."""
+    from .bls import BlsSecretKey, prove_possession
+
+    sk = BlsSecretKey(int.from_bytes(secret_bytes, "big"))
+    return prove_possession(sk).to_bytes()
+
+
+def check_scheme(name: str) -> str:
+    if name not in SCHEMES:
+        raise UnknownScheme(name)
+    return name
+
+
+def keygen_production(scheme: str) -> tuple[PublicKey, OpaqueSecret | SecretKey]:
+    """OS-RNG keypair for the scheme; the secret supports wipe()/base64."""
+    check_scheme(scheme)
+    if scheme == "ed25519":
+        return generate_production_keypair()
+    pk, secret = bls_keygen()
+    return pk, OpaqueSecret(secret)
+
+
+def keygen_deterministic(
+    scheme: str, seed: bytes, index: int = 0
+) -> tuple[PublicKey, OpaqueSecret | SecretKey]:
+    check_scheme(scheme)
+    if scheme == "ed25519":
+        return generate_keypair(seed, index)
+    pk, secret = bls_keygen(seed, index)
+    return pk, OpaqueSecret(secret)
+
+
+def read_secret(scheme: str, b64: str) -> OpaqueSecret | SecretKey:
+    """Decode a key-file secret for the scheme (ed25519 keeps the typed
+    64-byte SecretKey; BLS secrets are opaque 32-byte scalars)."""
+    check_scheme(scheme)
+    if scheme == "ed25519":
+        return SecretKey.decode_base64(b64)
+    return OpaqueSecret.decode_base64(b64)
+
+
+def make_signing_service(scheme: str, secret):
+    check_scheme(scheme)
+    if scheme == "ed25519":
+        return SignatureService(secret)
+    from .bls.service import BlsSigningService
+
+    return BlsSigningService(secret.to_bytes())
+
+
+def make_cpu_verifier(scheme: str) -> VerifierBackend:
+    check_scheme(scheme)
+    if scheme == "ed25519":
+        return CpuVerifier()
+    from .bls.service import BlsVerifier
+
+    return BlsVerifier()
+
+
+def make_device_verifier(scheme: str, kind: str) -> VerifierBackend:
+    """Device-backed verifier: the Ed25519 batch kernel (with the
+    lazy-import hybrid handled by the caller, node/node.py) or the BLS
+    verifier with its G1 aggregation on device."""
+    check_scheme(scheme)
+    if scheme == "bls":
+        from .bls.service import BlsVerifier
+
+        # 'tpu' and 'tpu-sharded' both map to the device G1 aggregator
+        # (single-device tree reduction; cross-device combine is the
+        # documented follow-up in docs/BLS_TPU_DESIGN.md).
+        return BlsVerifier(aggregator="tpu")
+    raise ValueError(
+        "ed25519 device verifiers are constructed by node.make_verifier "
+        "(lazy-import hybrid)"
+    )

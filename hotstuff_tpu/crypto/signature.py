@@ -40,10 +40,17 @@ def _parsed_pk(pk_bytes: bytes) -> Ed25519PublicKey:
     return Ed25519PublicKey.from_public_bytes(pk_bytes)
 
 
+BLS_SIGNATURE_SIZE = 48  # compressed G1 (crypto/bls)
+
+
 class Signature(FixedBytes):
-    """A 64-byte ed25519 signature (R || s)."""
+    """A signature over a digest: 64 bytes (R || s) under Ed25519, 48
+    (compressed G1) under the BLS12-381 scheme.  The ed25519-specific
+    class methods below are only reached through the Ed25519 scheme's
+    signing service / verifier (``crypto/scheme.py``)."""
 
     SIZE = SIGNATURE_SIZE
+    SIZES = frozenset({SIGNATURE_SIZE, BLS_SIGNATURE_SIZE})
     __slots__ = ()
 
     @classmethod

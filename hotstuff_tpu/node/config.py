@@ -11,7 +11,8 @@ import json
 import os
 
 from ..consensus import Committee, Parameters
-from ..crypto import PublicKey, SecretKey, generate_production_keypair
+from ..crypto import PublicKey
+from ..crypto.scheme import keygen_production, read_secret
 
 
 class ConfigError(Exception):
@@ -36,33 +37,62 @@ def _write_json(path: str, data: dict) -> None:
 
 
 class Secret:
-    """A node's identity: {name, secret} as base64 JSON
-    (reference node/src/config.rs:52-68)."""
+    """A node's identity: {name, secret, scheme[, pop]} as base64 JSON
+    (reference node/src/config.rs:52-68; ``scheme`` is this framework's
+    addition — "ed25519" default, "bls" for BLS12-381 committees).
 
-    def __init__(self, name: PublicKey, secret: SecretKey):
+    For BLS keys the file also records the proof of possession: it is
+    public committee material (``Authority.pop``) that the operator
+    pastes into the committee file next to the public key — publishing
+    a BLS key without it is useless, since ``Consensus.spawn`` refuses
+    PoP-less BLS committees (rogue-key defence)."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        secret,
+        scheme: str = "ed25519",
+        pop: bytes | None = None,
+    ):
         self.name = name
-        self.secret = secret
+        self.secret = secret  # SecretKey (ed25519) or OpaqueSecret (bls)
+        self.scheme = scheme
+        self.pop = pop
 
     @classmethod
-    def new(cls) -> "Secret":
-        return cls(*generate_production_keypair())
+    def new(cls, scheme: str = "ed25519") -> "Secret":
+        name, secret = keygen_production(scheme)
+        pop = None
+        if scheme == "bls":
+            from ..crypto.scheme import bls_pop
+
+            pop = bls_pop(secret.to_bytes())
+        return cls(name, secret, scheme, pop)
 
     def write(self, path: str) -> None:
-        _write_json(
-            path,
-            {
-                "name": self.name.encode_base64(),
-                "secret": self.secret.encode_base64(),
-            },
-        )
+        import base64
+
+        data = {
+            "name": self.name.encode_base64(),
+            "secret": self.secret.encode_base64(),
+            "scheme": self.scheme,
+        }
+        if self.pop is not None:
+            data["pop"] = base64.b64encode(self.pop).decode()
+        _write_json(path, data)
         os.chmod(path, 0o600)
 
     @classmethod
     def read(cls, path: str) -> "Secret":
+        import base64
+
         data = _read_json(path)
+        scheme = data.get("scheme", "ed25519")
         return cls(
             PublicKey.decode_base64(data["name"]),
-            SecretKey.decode_base64(data["secret"]),
+            read_secret(scheme, data["secret"]),
+            scheme,
+            base64.b64decode(data["pop"]) if "pop" in data else None,
         )
 
 

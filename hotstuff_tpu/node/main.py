@@ -56,16 +56,18 @@ async def _run_node(args) -> None:
     await node.analyze_block()
 
 
-async def _deploy_testbed(nodes: int, base_port: int) -> None:
+async def _deploy_testbed(nodes: int, base_port: int, scheme: str) -> None:
     """In-process local testbed (reference main.rs:102-148): n fresh
     keypairs, committee.json + node_i.json on disk, every node spawned as
     a task in this process, commit channels drained."""
-    keys = [Secret.new() for _ in range(nodes)]
+    keys = [Secret.new(scheme) for _ in range(nodes)]
     committee = Committee.new(
         [
             (secret.name, 1, ("127.0.0.1", base_port + i))
             for i, secret in enumerate(keys)
-        ]
+        ],
+        scheme=scheme,
+        pops={s.name: s.pop for s in keys if s.pop is not None},
     )
     write_committee(committee, ".committee.json")
     write_parameters(Parameters(), ".parameters.json")
@@ -96,6 +98,13 @@ def main(argv=None) -> int:
 
     p_keys = sub.add_parser("keys", help="generate a new keypair file")
     p_keys.add_argument("--filename", required=True)
+    p_keys.add_argument(
+        "--scheme",
+        choices=["ed25519", "bls"],
+        default="ed25519",
+        help="signature scheme (the committee file records the same "
+        "scheme; BLS gives constant-cost aggregate QC verification)",
+    )
 
     p_run = sub.add_parser("run", help="run a node")
     p_run.add_argument("--keys", required=True)
@@ -119,12 +128,15 @@ def main(argv=None) -> int:
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
     p_dep.add_argument("--base-port", type=int, default=25_200)
+    p_dep.add_argument(
+        "--scheme", choices=["ed25519", "bls"], default="ed25519"
+    )
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
 
     if args.command == "keys":
-        Secret.new().write(args.filename)
+        Secret.new(args.scheme).write(args.filename)
         return 0
     if args.command == "run":
         # sanity-check the committee file before booting
@@ -132,7 +144,7 @@ def main(argv=None) -> int:
         asyncio.run(_run_node(args))
         return 0
     if args.command == "deploy":
-        asyncio.run(_deploy_testbed(args.nodes, args.base_port))
+        asyncio.run(_deploy_testbed(args.nodes, args.base_port, args.scheme))
         return 0
     return 1
 

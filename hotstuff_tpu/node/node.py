@@ -17,10 +17,14 @@ import asyncio
 import logging
 
 from ..consensus import Consensus, Parameters
-from ..crypto import SignatureService
+from ..crypto.scheme import (
+    make_cpu_verifier,
+    make_device_verifier,
+    make_signing_service,
+)
 from ..crypto.service import CpuVerifier, VerifierBackend
 from ..store import Store
-from .config import Secret, read_committee, read_parameters
+from .config import ConfigError, Secret, read_committee, read_parameters
 
 log = logging.getLogger(__name__)
 
@@ -82,10 +86,14 @@ class LazyDeviceVerifier:
         return self._materialize().verify_many(digests, pks, sigs)
 
 
-def make_verifier(kind: str) -> VerifierBackend:
+def make_verifier(kind: str, scheme: str = "ed25519") -> VerifierBackend:
     if kind == "cpu":
-        return CpuVerifier()
+        return make_cpu_verifier(scheme)
     if kind in ("tpu", "tpu-sharded"):
+        if scheme == "bls":
+            # BLS device path: G1 vote-signature aggregation on device
+            # (hotstuff_tpu/tpu/bls.py), host pairing equality per QC.
+            return make_device_verifier(scheme, kind)
         return LazyDeviceVerifier(kind)
     raise ValueError(f"unknown verifier backend '{kind}'")
 
@@ -112,13 +120,18 @@ class Node:
         self = cls()
         committee = read_committee(committee_file)
         secret = Secret.read(key_file)
+        if secret.scheme != committee.scheme:
+            raise ConfigError(
+                f"key file scheme '{secret.scheme}' does not match the "
+                f"committee scheme '{committee.scheme}'"
+            )
         parameters = (
             read_parameters(parameters_file) if parameters_file else Parameters()
         )
 
         self.store = Store(store_path)
-        signature_service = SignatureService(secret.secret)
-        verifier = make_verifier(verifier_backend)
+        signature_service = make_signing_service(secret.scheme, secret.secret)
+        verifier = make_verifier(verifier_backend, committee.scheme)
         if hasattr(verifier, "precompute"):
             # warm the TPU backend's committee point cache (epoch setup)
             verifier.precompute(

@@ -11,17 +11,24 @@ import base64
 
 
 class FixedBytes:
-    """Base for 32/64-byte value types. Subclasses set ``SIZE``."""
+    """Base for fixed-size byte value types. Subclasses set ``SIZE`` (the
+    canonical/default size) and may widen ``SIZES`` to the set of sizes
+    valid for the type — e.g. a public key is 32 bytes under Ed25519 but
+    96 under the BLS12-381 scheme; one committee only ever mixes one
+    scheme, and the wire format length-prefixes these fields."""
 
     SIZE = 0
+    SIZES: frozenset[int] | None = None  # None → exactly {SIZE}
     __slots__ = ("data",)
 
     def __init__(self, data: bytes | None = None):
         if data is None:
             data = b"\x00" * self.SIZE
-        if len(data) != self.SIZE:
+        sizes = self.SIZES if self.SIZES is not None else {self.SIZE}
+        if len(data) not in sizes:
             raise ValueError(
-                f"{type(self).__name__} must be {self.SIZE} bytes, got {len(data)}"
+                f"{type(self).__name__} must be one of {sorted(sizes)} bytes, "
+                f"got {len(data)}"
             )
         object.__setattr__(self, "data", bytes(data))
 
@@ -30,7 +37,7 @@ class FixedBytes:
 
     @property
     def size(self) -> int:
-        return self.SIZE
+        return len(self.data)
 
     def encode_base64(self) -> str:
         return base64.b64encode(self.data).decode()
@@ -60,7 +67,7 @@ class FixedBytes:
         return hash((type(self).__name__, self.data))
 
     def __bool__(self) -> bool:
-        return self.data != b"\x00" * self.SIZE
+        return self.data != b"\x00" * len(self.data)
 
     def __repr__(self) -> str:
         return self.encode_base64()

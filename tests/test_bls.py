@@ -27,13 +27,15 @@ from hotstuff_tpu.crypto.bls import (
 from hotstuff_tpu.crypto.bls.curve import G1Point, G2Point, hash_to_g1
 from hotstuff_tpu.crypto.bls.fields import P, R
 from hotstuff_tpu.crypto.bls.pairing import pairing, pairings_equal
-from hotstuff_tpu.crypto.bls.service import BlsSignatureService, BlsVerifier
+from hotstuff_tpu.crypto.bls.service import BlsSigningService, BlsVerifier
 
 
 def test_curve_constants():
     g1, g2 = G1Point.generator(), G2Point.generator()
     assert g1.is_on_curve() and g2.is_on_curve()
-    assert g1.mul(R).inf and g2.mul(R).inf  # prime-order subgroup
+    # prime-order subgroup — via the unreduced ladder (mul() reduces mod
+    # R, so mul(R) would be the trivial mul(0))
+    assert g1.in_subgroup() and g2.in_subgroup()
     assert not g1.mul(R - 1).inf
     # group laws
     assert g1 + G1Point.identity() == g1
@@ -136,15 +138,32 @@ def test_verifier_backend_adapter():
         [msg] * 4, [pk for pk, _ in votes], [s for _, s in votes]
     )
     assert oks == [True] * 4
+    # distinct messages (the TC shape): batched multi-pairing fast path…
+    msgs = [bytes([i]) * 32 for i in range(4)]
+    dsigs = [sk.sign(m).to_bytes() for (_, sk), m in zip(pairs, msgs)]
+    assert v.verify_many(msgs, [pk for pk, _ in votes], dsigs) == [True] * 4
+    # …and the per-item fallback pinpoints the invalid entry
+    bad = list(dsigs)
+    bad[2] = dsigs[1]
+    assert v.verify_many(msgs, [pk for pk, _ in votes], bad) == [
+        True,
+        True,
+        False,
+        True,
+    ]
 
 
-def test_bls_signature_service_actor():
+def test_bls_signing_service():
     async def run():
         pk, sk = keygen(b"svc-seed")
-        svc = BlsSignatureService(sk)
+        svc = BlsSigningService(sk)
         sig = await svc.request_signature(b"actor digest")
-        assert pk.verify(b"actor digest", sig)
+        # returns the scheme-agnostic 48-byte consensus Signature wrapper
+        decoded = BlsSignature.from_bytes(sig.to_bytes())
+        assert decoded is not None and pk.verify(b"actor digest", decoded)
         svc.shutdown()
+        with pytest.raises(RuntimeError):
+            svc.sign_sync(b"after shutdown")
 
     asyncio.run(run())
 
@@ -153,5 +172,89 @@ def test_hash_to_g1_deterministic_and_in_subgroup():
     h1 = hash_to_g1(b"same input")
     h2 = hash_to_g1(b"same input")
     assert h1 == h2
-    assert h1.is_on_curve() and h1.mul(R).inf
+    assert h1.is_on_curve() and h1.in_subgroup()
     assert hash_to_g1(b"different") != h1
+
+
+# -- round-2 rewrite pins: Jacobian ladder, sparse Miller loop, GS chain ----
+
+
+def _affine_mul_g1(pt: G1Point, k: int) -> G1Point:
+    acc, add = G1Point.identity(), pt
+    while k:
+        if k & 1:
+            acc = acc + add
+        add = add + add
+        k >>= 1
+    return acc
+
+
+def test_jacobian_mul_matches_affine_ladder():
+    g = G1Point.generator()
+    for k in [0, 1, 2, 3, 7, 0xDEADBEEF, R - 1, R, R + 5]:
+        assert g.mul(k) == _affine_mul_g1(g, k % R)
+    g2 = G2Point.generator()
+    acc = G2Point.identity()
+    for _ in range(17):
+        acc = acc + g2
+    assert g2.mul(17) == acc
+
+
+def test_point_sum_matches_serial_addition():
+    g = G1Point.generator()
+    pts = [g.mul(i + 1) for i in range(9)]
+    serial = G1Point.identity()
+    for p in pts:
+        serial = serial + p
+    assert G1Point.sum(pts) == serial
+    assert G1Point.sum([]).inf
+    assert G1Point.sum([G1Point.identity()]).inf
+    g2 = G2Point.generator()
+    assert G2Point.sum([g2.mul(2), g2.mul(3)]) == g2.mul(5)
+
+
+def test_fast_pairing_matches_textbook_oracle():
+    """The production pairing is the textbook ate pairing cubed (the
+    BLS12 hard-part chain computes 3·(p⁴−p²+1)/r exactly)."""
+    from hotstuff_tpu.crypto.bls.pairing import pairing_textbook
+
+    g1, g2 = G1Point.generator(), G2Point.generator()
+    p, q = g1.mul(0xA5A5), g2.mul(0x5A5A)
+    assert pairing(p, q) == pairing_textbook(p, q).pow(3)
+
+
+def test_subgroup_check_rejects_non_subgroup_point():
+    """G1 curve order is R·H1: an on-curve point from hash-and-check
+    WITHOUT cofactor clearing is (overwhelmingly) outside the r-torsion.
+    Round-1 bug pinned here: mul() reduces k mod R, so the old
+    ``pt.mul(R).inf`` subgroup check was a no-op that accepted these."""
+    import hashlib
+
+    counter = 0
+    while True:
+        h = hashlib.sha256(b"raw-point" + counter.to_bytes(4, "big")).digest()
+        x = int.from_bytes(h + h[:16], "big") % P
+        y2 = (x**3 + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P == y2:
+            raw = G1Point(x, y)
+            break
+        counter += 1
+    assert raw.is_on_curve()
+    assert not raw.in_subgroup()
+    assert G1Point.from_bytes(raw.to_bytes()) is None
+
+
+def test_cyclotomic_square_matches_generic_square():
+    """Granger-Scott squaring agrees with the generic square on
+    cyclotomic-subgroup elements (where alone it is defined)."""
+    from hotstuff_tpu.crypto.bls.fields import Fq12
+    from hotstuff_tpu.crypto.bls.pairing import miller_loop
+
+    g1, g2 = G1Point.generator(), G2Point.generator()
+    f = miller_loop(g1.mul(3), g2.mul(5))
+    t = f.conjugate() * f.inverse()
+    g = t.frobenius(2) * t  # easy part → cyclotomic subgroup
+    assert g.cyclotomic_square() == g * g
+    gg = g * g * g
+    assert gg.cyclotomic_square() == gg * gg
