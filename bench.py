@@ -120,6 +120,48 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     return tput, latencies
 
 
+def make_tc_batch(n: int):
+    """n committee signatures over n DISTINCT timeout digests — the TC /
+    view-change-storm shape (BASELINE config 4; reference verifies these
+    sequentially, messages.rs:305-311)."""
+    from hotstuff_tpu.consensus.messages import timeout_digest
+    from hotstuff_tpu.crypto import Signature, generate_keypair
+
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        pk, sk = generate_keypair(b"\x44" * 32, i)
+        d = timeout_digest(10, i)  # one DISTINCT digest per entry
+        msgs.append(d.to_bytes())
+        pks.append(pk.to_bytes())
+        sigs.append(Signature.new(d, sk).to_bytes())
+    return msgs, pks, sigs
+
+
+def bench_tc(verifier) -> dict:
+    """TC-verify latency at the 256-committee storm quorum (171 distinct
+    digests): p50/p99 of dispatch + full fetch, same methodology as the
+    QC latencies."""
+    import numpy as np
+
+    n = 2 * 256 // 3 + 1  # 171
+    msgs, pks, sigs = make_tc_batch(n)
+    verifier.precompute(pks)
+    kernel, staged = _stage(verifier, msgs, pks, sigs)
+    np.asarray(kernel(*staged))  # warm the padded shape
+    times = []
+    for _ in range(LAT_REPS):
+        t0 = time.perf_counter()
+        ok = np.asarray(kernel(*staged))
+        times.append(time.perf_counter() - t0)
+        assert ok.all()
+    times.sort()
+    return {
+        "quorum": n,
+        "rig_p50_ms": round(times[len(times) // 2] * 1e3, 3),
+        "rig_p99_ms": round(times[-1] * 1e3, 3),
+    }
+
+
 def bench_cpu(msgs, pks, sigs) -> float:
     """CPU baseline throughput (sigs/s) over the same batches — the
     framework's own cpu backend (OpenSSL per-signature verify)."""
@@ -144,6 +186,10 @@ def main() -> int:
     tpu_tput, qc_latency = bench_tpu(msgs, pks, sigs)
     cpu_tput = bench_cpu(msgs, pks, sigs)
 
+    from hotstuff_tpu.tpu.ed25519 import BatchVerifier
+
+    tc_latency = bench_tc(BatchVerifier(min_device_batch=0))
+
     print(
         json.dumps(
             {
@@ -152,6 +198,7 @@ def main() -> int:
                 "unit": "sigs/s",
                 "vs_baseline": round(tpu_tput / cpu_tput, 3),
                 "qc_verify_ms": qc_latency,
+                "tc_verify_ms": tc_latency,
             }
         )
     )

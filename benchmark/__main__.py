@@ -31,11 +31,14 @@ def task_local(args) -> int:
         verifier=args.verifier,
         transport=args.transport,
         scheme=args.scheme,
+        in_process=args.in_process,
     )
     parser = bench.run()
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
     )
+    if args.in_process:
+        label += "-1proc"
     summary = parser.result(
         faults=args.faults, nodes=args.nodes, verifier=label
     )
@@ -110,6 +113,29 @@ def task_remote_bench(args) -> int:
     return 0
 
 
+def task_storm(args) -> int:
+    """View-change-storm micro-bench (BASELINE config 4): timeout flood,
+    TC verify, and committee-scale QC verify per backend."""
+    import os
+
+    from .storm import format_report, run_storm
+
+    results = run_storm(
+        nodes=args.nodes, device=args.device, bls=not args.no_bls
+    )
+    report = format_report(args.nodes, results)
+    print(report)
+    os.makedirs(PathMaker.results_path(), exist_ok=True)
+    backends = "-".join(results)
+    path = os.path.join(
+        PathMaker.results_path(), f"storm-{args.nodes}-{backends}.txt"
+    )
+    with open(path, "a") as f:
+        f.write(report + "\n")
+    Print.info(f"Result appended to {path}")
+    return 0
+
+
 def task_logs(args) -> int:
     """Re-parse an existing logs directory and print the SUMMARY
     (reference fabfile.py `logs` task)."""
@@ -153,6 +179,12 @@ def main(argv=None) -> int:
         default="ed25519",
         help="committee signature scheme (bls = aggregate QC verification)",
     )
+    p.add_argument(
+        "--in-process",
+        action="store_true",
+        help="co-locate the whole committee in one node process "
+        "(run-many; removes OS scheduling noise on few-core hosts)",
+    )
     p.set_defaults(fn=task_local)
 
     p = sub.add_parser("tpu")
@@ -162,6 +194,14 @@ def main(argv=None) -> int:
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
     p.set_defaults(fn=task_tpu)
+
+    p = sub.add_parser("storm")
+    p.add_argument("--nodes", type=int, default=256)
+    p.add_argument(
+        "--device", action="store_true", help="also run the TPU backend"
+    )
+    p.add_argument("--no-bls", action="store_true")
+    p.set_defaults(fn=task_storm)
 
     p = sub.add_parser("logs")
     p.add_argument("--dir", default=PathMaker.logs_path())

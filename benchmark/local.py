@@ -40,6 +40,7 @@ class LocalBench:
         transport: str = "asyncio",
         base_port: int = BASE_PORT,
         scheme: str = "ed25519",
+        in_process: bool = False,
     ):
         self.nodes = nodes
         self.rate = rate
@@ -51,6 +52,12 @@ class LocalBench:
         self.transport = transport
         self.base_port = base_port
         self.scheme = scheme
+        # in_process=True: the whole committee co-locates in ONE node
+        # process (`run-many`, the reference's in-process testbed shape,
+        # main.rs:102-148).  On a host with fewer cores than nodes the
+        # per-process harness measures the OS scheduler, not the
+        # protocol; this mode shares one asyncio loop instead.
+        self.in_process = in_process
         self._procs: list[subprocess.Popen] = []
 
     # ---- setup/teardown ----------------------------------------------------
@@ -134,20 +141,23 @@ class LocalBench:
         try:
             # Boot the committee (skip `faults` nodes — crash-fault
             # injection, reference local.py:75-76).
-            for i in range(self.nodes - self.faults):
+            if self.in_process:
                 self._spawn(
                     [
                         py,
                         "-m",
                         "hotstuff_tpu.node",
                         "-vv",
-                        "run",
+                        "run-many",
                         "--keys",
-                        PathMaker.key_file(i),
+                        ",".join(
+                            PathMaker.key_file(i)
+                            for i in range(self.nodes - self.faults)
+                        ),
                         "--committee",
                         PathMaker.committee_file(),
-                        "--store",
-                        PathMaker.db_path(i),
+                        "--store-prefix",
+                        os.path.join(PathMaker.base_path(), ".db_"),
                         "--parameters",
                         PathMaker.parameters_file(),
                         "--verifier",
@@ -155,8 +165,32 @@ class LocalBench:
                         "--transport",
                         self.transport,
                     ],
-                    PathMaker.node_log_file(i),
+                    PathMaker.node_log_file(0),
                 )
+            else:
+                for i in range(self.nodes - self.faults):
+                    self._spawn(
+                        [
+                            py,
+                            "-m",
+                            "hotstuff_tpu.node",
+                            "-vv",
+                            "run",
+                            "--keys",
+                            PathMaker.key_file(i),
+                            "--committee",
+                            PathMaker.committee_file(),
+                            "--store",
+                            PathMaker.db_path(i),
+                            "--parameters",
+                            PathMaker.parameters_file(),
+                            "--verifier",
+                            self.verifier,
+                            "--transport",
+                            self.transport,
+                        ],
+                        PathMaker.node_log_file(i),
+                    )
 
             # Launch the producer-path client.
             self._spawn(

@@ -187,6 +187,12 @@ class Core:
         self.timer = Timer(timeout_delay_ms)
         self.aggregator = Aggregator(committee, verifier, self_key=name)
         self.network = network if network is not None else SimpleSender()
+        # Memo of QC cache-keys that already verified against this
+        # committee (messages.QC.verify): under a view-change storm all
+        # n timeouts carry the SAME high_qc — without the memo the most
+        # expensive check in the protocol runs n times per storm.
+        # Bounded: cleared when full (worst case = one re-verification).
+        self._verified_qcs: set[bytes] = set()
         self.state_changed = False
         self._task: asyncio.Task | None = None
         # per-node logger so multi-node (in-process) runs are attributable
@@ -353,6 +359,11 @@ class Core:
             if self.name == self.leader_elector.get_leader(self.round):
                 await self._generate_proposal(None)
 
+    def _qc_cache(self) -> set:
+        if len(self._verified_qcs) > 4_096:
+            self._verified_qcs.clear()
+        return self._verified_qcs
+
     async def _handle_timeout(self, timeout: Timeout) -> None:
         self.log.debug("Processing %r", timeout)
         if timeout.round < self.round:
@@ -361,7 +372,7 @@ class Core:
         # single signature is checked FIRST (cheap), so a spoofed timeout
         # cannot force the expensive embedded-QC batch verify — and the
         # TCMaker can then emit TCs from pre-verified entries.
-        timeout.verify(self.committee, self.verifier)
+        timeout.verify(self.committee, self.verifier, qc_cache=self._qc_cache())
         self._process_qc(timeout.high_qc)
 
         tc = self.aggregator.add_timeout(timeout, self.round)
@@ -451,7 +462,7 @@ class Core:
         expected = self.leader_elector.get_leader(block.round)
         if block.author != expected:
             raise WrongLeader(digest, block.author, block.round)
-        block.verify(self.committee, self.verifier)
+        block.verify(self.committee, self.verifier, qc_cache=self._qc_cache())
         self._process_qc(block.qc)
         if block.tc is not None:
             self._advance_round(block.tc.round)

@@ -108,7 +108,34 @@ class QC:
     def digest(self) -> Digest:
         return Digest(sha512_trunc(self.hash.to_bytes() + _round_le(self.round)))
 
-    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+    def _cache_key(self) -> bytes:
+        """Identity of this certificate's full contents (hash, round and
+        every vote) — two QCs with the same key are byte-identical, so a
+        successful verification of one covers the other."""
+        return sha512_trunc(
+            self.hash.to_bytes()
+            + _round_le(self.round)
+            + b"".join(pk.data + sig.data for pk, sig in self.votes)
+        )
+
+    def verify(
+        self,
+        committee: Committee,
+        verifier: VerifierBackend,
+        cache: set | None = None,
+    ) -> None:
+        """``cache`` (per-core, optional) memoizes certificates that
+        already verified against THIS committee: under a view-change
+        storm every one of n timeouts carries the same high_qc, and
+        without the memo the node re-runs the identical batch
+        verification n times (n x the most expensive check in the
+        protocol).  Only successes are cached; the set is bounded by the
+        owner (core.py)."""
+        key = None
+        if cache is not None:
+            key = self._cache_key()
+            if key in cache:
+                return
         _check_certificate_weight(
             [pk for pk, _ in self.votes], committee, QCRequiresQuorum
         )
@@ -116,6 +143,8 @@ class QC:
         # kernel (reference messages.rs:195 → crypto verify_batch).
         if not verifier.verify_shared_msg(self.digest(), self.votes):
             raise InvalidSignature(f"bad signature in QC for {self.hash}")
+        if cache is not None:
+            cache.add(key)
 
     # equality on (hash, round) only, like the reference (messages.rs:213-217)
     def __eq__(self, other) -> bool:
@@ -254,7 +283,12 @@ class Block:
             self._digest = d
         return d
 
-    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+    def verify(
+        self,
+        committee: Committee,
+        verifier: VerifierBackend,
+        qc_cache: set | None = None,
+    ) -> None:
         if committee.stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
         if len(self.payloads) > MAX_BLOCK_PAYLOADS:
@@ -262,7 +296,7 @@ class Block:
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad author signature on block {self}")
         if not self.qc.is_genesis():
-            self.qc.verify(committee, verifier)
+            self.qc.verify(committee, verifier, cache=qc_cache)
         if self.tc is not None:
             self.tc.verify(committee, verifier)
 
@@ -375,13 +409,18 @@ class Timeout:
     def digest(self) -> Digest:
         return timeout_digest(self.round, self.high_qc.round)
 
-    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+    def verify(
+        self,
+        committee: Committee,
+        verifier: VerifierBackend,
+        qc_cache: set | None = None,
+    ) -> None:
         if committee.stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad signature on timeout {self}")
         if not self.high_qc.is_genesis():
-            self.high_qc.verify(committee, verifier)
+            self.high_qc.verify(committee, verifier, cache=qc_cache)
 
     def encode(self, enc: Encoder) -> None:
         self.high_qc.encode(enc)

@@ -249,7 +249,13 @@ class G1Point:
         return bytes(out)
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "G1Point | None":
+    def from_bytes(
+        cls, data: bytes, subgroup_check: bool = True
+    ) -> "G1Point | None":
+        """``subgroup_check=False`` skips the r-torsion ladder (~2 ms) —
+        ONLY for points whose membership is established elsewhere, e.g.
+        vote signatures that are summed and checked once per aggregate
+        (``BlsVerifier.verify_shared_msg``)."""
         if len(data) != 48 or not data[0] & 0x80:
             return None
         if data[0] & 0x40:  # infinity
@@ -267,7 +273,7 @@ class G1Point:
         if (y > (P - 1) // 2) != sign:
             y = P - y
         pt = cls(x, y)
-        if not pt.in_subgroup():
+        if subgroup_check and not pt.in_subgroup():
             return None
         return pt
 

@@ -20,7 +20,6 @@ from . import (
     BlsSecretKey,
     BlsSignature,
     aggregate_public_keys,
-    aggregate_signatures,
     keygen,
 )
 
@@ -62,27 +61,41 @@ class BlsVerifier:
         return pub is not None and s is not None and pub.verify(msg, s)
 
     def verify_shared_msg(self, digest, votes) -> bool:
-        """One pairing equality for the whole vote set (aggregation)."""
+        """One pairing equality for the whole vote set (aggregation).
+
+        Per-signature decode skips the r-torsion ladder; the SUM is
+        subgroup-checked once instead (matching the TPU aggregator's
+        r-ladder-on-the-aggregate design).  Sound: honest signatures
+        carry no cofactor component, so any attack using per-vote
+        cofactor components that cancel in the sum is equivalent to one
+        using clean signatures — and a non-cancelling component makes
+        the aggregate fail the single check."""
+        from .curve import G1Point
+
         msg = digest if isinstance(digest, bytes) else digest.to_bytes()
-        pks, sigs = [], []
+        pks, sig_points = [], []
         for pk, sig in votes:
             pub = self._pk(pk if isinstance(pk, bytes) else pk.to_bytes())
-            s = BlsSignature.from_bytes(
-                sig if isinstance(sig, bytes) else sig.to_bytes()
+            s = G1Point.from_bytes(
+                sig if isinstance(sig, bytes) else sig.to_bytes(),
+                subgroup_check=False,
             )
             if pub is None or s is None:
                 return False
             pks.append(pub)
-            sigs.append(s)
+            sig_points.append(s)
         if not pks:
             return False
         if self._tpu_agg is not None:
-            agg_sig = BlsSignature(
-                self._tpu_agg.aggregate([s.point for s in sigs])
-            )
+            agg = self._tpu_agg.aggregate(sig_points)
         else:
-            agg_sig = aggregate_signatures(sigs)
-        return aggregate_public_keys(pks).verify(msg, agg_sig)
+            agg = G1Point.sum(sig_points)
+        # ONE subgroup check on the aggregate (the device kernel's
+        # in-kernel r-ladder is still future work, so the host checks
+        # its result too — ~2 ms once per QC)
+        if not agg.in_subgroup():
+            return False
+        return aggregate_public_keys(pks).verify(msg, BlsSignature(agg))
 
     def verify_many(self, digests, pks, sigs) -> list[bool]:
         """Distinct-message batch (the TC-verify shape): one multi-pairing

@@ -44,37 +44,30 @@ class PublicKey(FixedBytes):
     __slots__ = ()
 
 
-class SecretKey:
-    """64 bytes: ed25519 seed || derived public key.
+class WipeableSecret:
+    """Secret bytes with a best-effort wipe contract.
 
     Python cannot guarantee memory zeroing the way the reference's ``Drop``
     impl does (``crypto/src/lib.rs:160-168``); ``wipe()`` is the best-effort
-    equivalent and is called by ``SignatureService`` teardown. Every
-    accessor raises after ``wipe()`` so a zeroed key can never be silently
-    used or serialized.
-    """
+    equivalent and is called by signing-service teardown. Every accessor
+    raises after ``wipe()`` so a zeroed key can never be silently used or
+    serialized.  Subclasses set ``SIZE`` (None = any length — opaque
+    scheme-specific secrets, crypto/scheme.py)."""
 
+    SIZE: int | None = None
     __slots__ = ("_data", "_wiped")
 
     def __init__(self, data: bytes):
-        if len(data) != SECRET_KEY_SIZE:
-            raise ValueError(f"SecretKey must be {SECRET_KEY_SIZE} bytes")
+        if self.SIZE is not None and len(data) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} must be {self.SIZE} bytes"
+            )
         self._data = bytearray(data)
         self._wiped = False
 
     def _check_live(self) -> None:
         if self._wiped:
-            raise RuntimeError("SecretKey has been wiped")
-
-    @property
-    def seed(self) -> bytes:
-        self._check_live()
-        return bytes(self._data[:32])
-
-    @property
-    def public_bytes(self) -> bytes:
-        self._check_live()
-        return bytes(self._data[32:])
+            raise RuntimeError(f"{type(self).__name__} has been wiped")
 
     def to_bytes(self) -> bytes:
         self._check_live()
@@ -84,7 +77,7 @@ class SecretKey:
         return base64.b64encode(self.to_bytes()).decode()
 
     @classmethod
-    def decode_base64(cls, s: str) -> "SecretKey":
+    def decode_base64(cls, s: str):
         return cls(base64.b64decode(s))
 
     def wipe(self) -> None:
@@ -97,7 +90,24 @@ class SecretKey:
         return self._wiped
 
     def __repr__(self) -> str:  # never print key material
-        return "SecretKey(<redacted>)"
+        return f"{type(self).__name__}(<redacted>)"
+
+
+class SecretKey(WipeableSecret):
+    """64 bytes: ed25519 seed || derived public key."""
+
+    SIZE = SECRET_KEY_SIZE
+    __slots__ = ()
+
+    @property
+    def seed(self) -> bytes:
+        self._check_live()
+        return bytes(self._data[:32])
+
+    @property
+    def public_bytes(self) -> bytes:
+        self._check_live()
+        return bytes(self._data[32:])
 
 
 def _keypair_from_seed(seed32: bytes) -> tuple[PublicKey, SecretKey]:

@@ -22,7 +22,13 @@ import hashlib
 import os
 import struct
 
-from .keys import PublicKey, SecretKey, generate_keypair, generate_production_keypair
+from .keys import (
+    PublicKey,
+    SecretKey,
+    WipeableSecret,
+    generate_keypair,
+    generate_production_keypair,
+)
 from .service import CpuVerifier, SignatureService, VerifierBackend
 
 SCHEMES = ("ed25519", "bls")
@@ -36,43 +42,11 @@ class UnknownScheme(ValueError):
         )
 
 
-class OpaqueSecret:
-    """Scheme-agnostic secret bytes with the SecretKey wipe contract
-    (best-effort zeroing; accessors raise after wipe)."""
+class OpaqueSecret(WipeableSecret):
+    """Scheme-agnostic secret bytes (BLS scalar, etc.) — any length,
+    same wipe contract as SecretKey."""
 
-    __slots__ = ("_data", "_wiped")
-
-    def __init__(self, data: bytes):
-        self._data = bytearray(data)
-        self._wiped = False
-
-    def to_bytes(self) -> bytes:
-        if self._wiped:
-            raise RuntimeError("secret has been wiped")
-        return bytes(self._data)
-
-    def encode_base64(self) -> str:
-        import base64
-
-        return base64.b64encode(self.to_bytes()).decode()
-
-    @classmethod
-    def decode_base64(cls, s: str) -> "OpaqueSecret":
-        import base64
-
-        return cls(base64.b64decode(s))
-
-    def wipe(self) -> None:
-        for i in range(len(self._data)):
-            self._data[i] = 0
-        self._wiped = True
-
-    @property
-    def wiped(self) -> bool:
-        return self._wiped
-
-    def __repr__(self) -> str:  # never print key material
-        return "OpaqueSecret(<redacted>)"
+    __slots__ = ()
 
 
 def bls_keygen(seed: bytes | None = None, index: int = 0) -> tuple[PublicKey, bytes]:

@@ -56,6 +56,28 @@ async def _run_node(args) -> None:
     await node.analyze_block()
 
 
+async def _run_many(args) -> None:
+    """Several nodes co-located in ONE process from existing config
+    files — the reference's in-process testbed shape (main.rs:102-148)
+    driven by the harness's key/committee files.  On a host with fewer
+    cores than nodes this removes cross-process scheduling from the
+    measured path: every actor shares one asyncio loop."""
+    nodes = []
+    for i, key_file in enumerate(args.keys.split(",")):
+        nodes.append(
+            await Node.new(
+                committee_file=args.committee,
+                key_file=key_file,
+                store_path=f"{args.store_prefix}{i}",
+                parameters_file=args.parameters,
+                verifier_backend=args.verifier,
+                transport=args.transport,
+                bind_host="127.0.0.1",
+            )
+        )
+    await asyncio.gather(*(n.analyze_block() for n in nodes))
+
+
 async def _deploy_testbed(nodes: int, base_port: int, scheme: str) -> None:
     """In-process local testbed (reference main.rs:102-148): n fresh
     keypairs, committee.json + node_i.json on disk, every node spawned as
@@ -125,6 +147,21 @@ def main(argv=None) -> int:
         help="signature verification backend",
     )
 
+    p_many = sub.add_parser(
+        "run-many",
+        help="run several nodes in one process from existing config files",
+    )
+    p_many.add_argument("--keys", required=True, help="comma-separated key files")
+    p_many.add_argument("--committee", required=True)
+    p_many.add_argument("--store-prefix", required=True)
+    p_many.add_argument("--parameters", default=None)
+    p_many.add_argument(
+        "--transport", choices=["asyncio", "native"], default="asyncio"
+    )
+    p_many.add_argument(
+        "--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu"
+    )
+
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
     p_dep.add_argument("--base-port", type=int, default=25_200)
@@ -142,6 +179,10 @@ def main(argv=None) -> int:
         # sanity-check the committee file before booting
         read_committee(args.committee)
         asyncio.run(_run_node(args))
+        return 0
+    if args.command == "run-many":
+        read_committee(args.committee)
+        asyncio.run(_run_many(args))
         return 0
     if args.command == "deploy":
         asyncio.run(_deploy_testbed(args.nodes, args.base_port, args.scheme))

@@ -186,3 +186,31 @@ def test_vote_verify():
     vote.signature = Signature(b"\x03" * 64)
     with pytest.raises(InvalidSignature):
         vote.verify(COMMITTEE, VERIFIER)
+
+
+def test_qc_verify_cache_skips_repeat_batches():
+    """The per-core verified-QC memo: a view-change storm delivers the
+    same high_qc inside every one of n timeouts; with a cache the
+    expensive batch verification runs once, and tampered copies (new
+    cache key) still verify from scratch."""
+    block = chain(2)[-1]
+    qc = qc_for_block(block)
+
+    class CountingVerifier(CpuVerifier):
+        calls = 0
+
+        def verify_shared_msg(self, d, votes):
+            CountingVerifier.calls += 1
+            return super().verify_shared_msg(d, votes)
+
+    v = CountingVerifier()
+    cache: set = set()
+    for _ in range(5):
+        qc.verify(COMMITTEE, v, cache=cache)
+    assert CountingVerifier.calls == 1
+    # a tampered QC (different votes → different key) re-verifies
+    bad = QC(
+        hash=qc.hash, round=qc.round, votes=qc.votes[:2] + [qc.votes[0]]
+    )
+    with pytest.raises(AuthorityReuse):
+        bad.verify(COMMITTEE, v, cache=cache)
