@@ -154,10 +154,16 @@ def task_aggregate(_args) -> int:
 
 
 def task_plot(_args) -> int:
-    from .plot import plot_latency_vs_throughput, plot_tps_vs_committee
+    from .plot import (
+        plot_latency_vs_throughput,
+        plot_robustness,
+        plot_tps_vs_committee,
+    )
 
-    Print.info(f"Wrote {plot_latency_vs_throughput()}")
-    Print.info(f"Wrote {plot_tps_vs_committee()}")
+    groups = aggregate()  # parse the results dir once for all plots
+    Print.info(f"Wrote {plot_latency_vs_throughput(groups)}")
+    Print.info(f"Wrote {plot_tps_vs_committee(groups)}")
+    Print.info(f"Wrote {plot_robustness(groups)}")
     return 0
 
 

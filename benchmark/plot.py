@@ -21,6 +21,23 @@ def _plt():
     return plt
 
 
+def _label(nodes: int, faults: int, verifier: str) -> str:
+    return f"{nodes} nodes ({verifier})" + (
+        f", {faults} faults" if faults else ""
+    )
+
+
+def _series_by_config(groups: dict, value_fn) -> dict[tuple, list]:
+    """{(nodes, faults, verifier): [value_fn(rate, metrics), ...]} over
+    the aggregated result groups — the shared group-by of every plot."""
+    series: dict[tuple, list] = {}
+    for (faults, nodes, rate, verifier), metric in sorted(groups.items()):
+        series.setdefault((nodes, faults, verifier), []).append(
+            value_fn(rate, metric)
+        )
+    return series
+
+
 def plot_latency_vs_throughput(
     groups: dict | None = None, out_path: str | None = None
 ) -> str:
@@ -32,15 +49,14 @@ def plot_latency_vs_throughput(
         PathMaker.plot_path(), "latency-vs-throughput.png"
     )
 
-    series: dict[tuple, list] = {}
-    for (faults, nodes, rate, verifier), metric in sorted(groups.items()):
-        series.setdefault((nodes, faults, verifier), []).append(
-            (
-                metric.get("consensus_tps", 0.0),
-                metric.get("consensus_latency_ms", 0.0),
-                metric.get("consensus_latency_ms_stdev", 0.0),
-            )
-        )
+    series = _series_by_config(
+        groups,
+        lambda rate, metric: (
+            metric.get("consensus_tps", 0.0),
+            metric.get("consensus_latency_ms", 0.0),
+            metric.get("consensus_latency_ms_stdev", 0.0),
+        ),
+    )
 
     fig, ax = plt.subplots(figsize=(7, 4.5))
     for (nodes, faults, verifier), points in sorted(series.items()):
@@ -48,13 +64,57 @@ def plot_latency_vs_throughput(
         xs = [p[0] for p in points]
         ys = [p[1] for p in points]
         es = [p[2] for p in points]
-        label = f"{nodes} nodes ({verifier})" + (
-            f", {faults} faults" if faults else ""
+        ax.errorbar(
+            xs, ys, yerr=es, marker="o", capsize=3,
+            label=_label(nodes, faults, verifier),
         )
-        ax.errorbar(xs, ys, yerr=es, marker="o", capsize=3, label=label)
     ax.set_xlabel("Throughput (payloads/s)")
     ax.set_ylabel("Consensus latency (ms)")
     ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=150)
+    plt.close(fig)
+    return out_path
+
+
+def plot_robustness(
+    groups: dict | None = None, out_path: str | None = None
+) -> str:
+    """Achieved TPS vs input rate, one line per (nodes, faults,
+    verifier) — the reference's robustness plot (benchmark/plot.py:
+    tps-vs-input-rate): throughput should track the input rate until
+    saturation and degrade gracefully under crash faults, not
+    collapse."""
+    plt = _plt()
+    groups = groups if groups is not None else aggregate()
+    os.makedirs(PathMaker.plot_path(), exist_ok=True)
+    out_path = out_path or os.path.join(
+        PathMaker.plot_path(), "robustness.png"
+    )
+
+    series = _series_by_config(
+        groups,
+        lambda rate, metric: (rate, metric.get("consensus_tps", 0.0)),
+    )
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for (nodes, faults, verifier), points in sorted(series.items()):
+        if len(points) < 2:
+            continue  # a single rate is not a robustness series
+        points.sort()
+        ax.plot(
+            [p[0] for p in points],
+            [p[1] for p in points],
+            marker="o",
+            label=_label(nodes, faults, verifier),
+        )
+    lims = ax.get_xlim()
+    ax.plot(lims, lims, linestyle=":", color="gray", label="ideal (tps = rate)")
+    ax.set_xlim(lims)
+    ax.set_xlabel("Input rate (payloads/s)")
+    ax.set_ylabel("Consensus TPS (payloads/s)")
+    ax.legend(fontsize=8)
     ax.grid(True, alpha=0.3)
     fig.tight_layout()
     fig.savefig(out_path, dpi=150)

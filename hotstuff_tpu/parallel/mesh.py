@@ -78,6 +78,9 @@ def make_sharded_verify(mesh: Mesh, pallas: bool = False):
         mesh=mesh,
         in_specs=_IN_SPECS,
         out_specs=P(DP_AXIS),
+        # pallas_call's out_shape carries no varying-mesh-axes metadata,
+        # so the vma consistency check cannot apply to the pallas branch
+        check_vma=not pallas,
     )
     return jax.jit(fn)
 

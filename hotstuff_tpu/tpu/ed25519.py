@@ -296,8 +296,14 @@ class BatchVerifier:
         return np.asarray(ok)[:n] & valid_host
 
     # Split-path threshold: batches of <= SPLIT_MAX signatures double to
-    # <= one pallas tile of half-scalar rows (pallas_dsm.BT = 256), so
-    # the 16-step split kernel applies — ~2x lower scan depth.
+    # <= one pallas tile of half-scalar rows, so the 16-step split
+    # kernel applies — ~2x lower scan depth.  The 512-row wide tile
+    # (pallas_dsm.SPLIT_BT) would raise this to 256 and cover the
+    # BASELINE's largest committee in one scan, and its parity is
+    # pinned (interpret-mode test, opt-in) — but its Mosaic compile did
+    # not complete within ~58 minutes on this toolchain (aborted; the
+    # round-1 attempt also exceeded 25 minutes), so production routing
+    # stays at 128 until the compile is tractable.
     SPLIT_MAX = 128
 
     def stage(self, messages, pubkeys, signatures):
@@ -327,12 +333,14 @@ class BatchVerifier:
         of a tile's signatures, then their hi halves), with the hi rows'
         base-table byte offset by 256 into the doubled table.  Returns
         (host_validity[n], kernel_arrays) for _verify_kernel_pallas_split."""
-        from .pallas_dsm import BT
+        from .pallas_dsm import LANE_TILE, split_half_tile
 
-        half_tile = BT // 2
         n = len(messages)
         valid_host = np.ones(n, bool)
-        n_pad = ((n + half_tile - 1) // half_tile) * half_tile
+        n_pad = ((n + LANE_TILE - 1) // LANE_TILE) * LANE_TILE
+        # interleave unit must match the tile the kernel picks for this
+        # row count (pallas_dsm.split_half_tile — single source of truth)
+        half_tile = split_half_tile(n_pad)
 
         a_lo = [np.zeros((n_pad, F.NLIMBS), np.int32) for _ in range(4)]
         a_hi = [np.zeros((n_pad, F.NLIMBS), np.int32) for _ in range(4)]

@@ -43,6 +43,21 @@ NL = F.NLIMBS  # 20
 NCOLS = 2 * NL - 1  # 39
 LANE_TILE = 128  # minimum batch tile (lane width)
 BT = 256  # batch tile: [20, 256] int32 = 3x2 vregs per coord
+# Wide tile for the split kernel ONLY: a 256-signature QC doubles to 512
+# half-scalar rows; one 512-lane tile runs them in a single 16-step scan
+# instead of two sequential 256-row grid tiles (which would cost the
+# same wall time as the unsplit 32-step kernel).  The Mosaic compile of
+# this shape is slow (tens of minutes) but one-time now that the
+# persistent compilation cache actually engages (see tpu/__init__.py).
+SPLIT_BT = 512
+
+
+def split_half_tile(n_pad: int) -> int:
+    """Interleave unit for ``prepare_split``: lo/hi halves are laid out
+    per KERNEL tile, so the unit must match the tile the kernel will
+    pick for ``rows = 2*n_pad`` — 256 (tile 512) when it divides evenly,
+    else 128 (tile 256).  Single source of truth for both sides."""
+    return SPLIT_BT // 2 if n_pad % (SPLIT_BT // 2) == 0 else BT // 2
 
 _HIGH = jax.lax.Precision.HIGHEST
 
@@ -331,13 +346,18 @@ def dual_scalar_mult_split(
     s_win, k_win: int32 [32, R] MSB-first 4-bit windows of the 128-bit
     scalar halves; a_point: (X, Y, Z, T) coords [R, NL] of the negated
     per-half A points; base_off: int32 [R], 0 for lo rows / 256 for hi.
-    R must be a multiple of BT, with each BT-row tile holding the lo
-    halves of BT/2 signatures followed by their hi halves (the caller
-    interleaves per tile).  Returns (X, Y, Z, T) with coords [R/2, NL];
-    T is NOT computed (zeros)."""
+    R must be a multiple of BT.  The kernel tile is
+    ``2 * split_half_tile(R // 2)`` (512 when R divides evenly, else
+    256) and each TILE-row block must hold the lo halves of tile/2
+    signatures followed by their hi halves — interleave with
+    ``split_half_tile`` as the unit, exactly as ``prepare_split`` does;
+    a fixed 128-unit interleave at R = 512 would silently pair wrong
+    lo/hi halves.  Returns (X, Y, Z, T) with coords [R/2, NL]; T is NOT
+    computed (zeros)."""
     rows = s_win.shape[1]
     if rows % BT:
         raise ValueError(f"rows {rows} not a multiple of {BT}")
+    tile = 2 * split_half_tile(rows // 2)
     nwin = s_win.shape[0]
     s_pairs = s_win.reshape(nwin // 2, 2, rows)
     s_bytes = s_pairs[:, 0] * (1 << curve.WINDOW) + s_pairs[:, 1]
@@ -345,20 +365,20 @@ def dual_scalar_mult_split(
 
     coords_t = [jnp.transpose(c) for c in a_point]  # [NL, rows]
 
-    grid = (rows // BT,)
+    grid = (rows // tile,)
 
     def const_spec(shape):
         return pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
 
     limb_spec = pl.BlockSpec(
-        (NL, BT), lambda i: (0, i), memory_space=pltpu.VMEM
+        (NL, tile), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     win_spec = pl.BlockSpec(
-        (nwin // 2, BT), lambda i: (0, i), memory_space=pltpu.VMEM
+        (nwin // 2, tile), lambda i: (0, i), memory_space=pltpu.VMEM
     )
-    off_spec = pl.BlockSpec((1, BT), lambda i: (0, i), memory_space=pltpu.VMEM)
+    off_spec = pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
     out_spec = pl.BlockSpec(
-        (NL, BT // 2), lambda i: (0, i), memory_space=pltpu.VMEM
+        (NL, tile // 2), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     out_shape = jax.ShapeDtypeStruct((NL, rows // 2), jnp.int32)
 

@@ -107,3 +107,32 @@ def test_created_line_contract_matches_proposer_emitter():
     )
     parser = LogParser([line0 + "\n"], [])
     assert parser.block_payloads["EMPTY=="] == ()
+
+
+def test_plots_render_from_synthetic_groups(tmp_path):
+    """All three plots (latency-vs-throughput, tps-vs-committee,
+    robustness — reference Ploter parity) render from aggregated
+    groups without a display."""
+    import pytest
+
+    pytest.importorskip("matplotlib")
+    from benchmark.plot import (
+        plot_latency_vs_throughput,
+        plot_robustness,
+        plot_tps_vs_committee,
+    )
+
+    groups = {
+        (0, 4, 1000, "cpu"): {"consensus_tps": 950.0, "consensus_latency_ms": 20.0},
+        (0, 4, 5000, "cpu"): {"consensus_tps": 4600.0, "consensus_latency_ms": 40.0},
+        (0, 8, 1000, "tpu"): {"consensus_tps": 900.0, "consensus_latency_ms": 55.0},
+        (1, 4, 1000, "cpu"): {"consensus_tps": 70.0, "consensus_latency_ms": 30.0},
+        (1, 4, 5000, "cpu"): {"consensus_tps": 300.0, "consensus_latency_ms": 90.0},
+    }
+    for fn, name in (
+        (plot_latency_vs_throughput, "lat.png"),
+        (plot_tps_vs_committee, "tps.png"),
+        (plot_robustness, "rob.png"),
+    ):
+        out = fn(groups, str(tmp_path / name))
+        assert (tmp_path / name).exists() and (tmp_path / name).stat().st_size > 0

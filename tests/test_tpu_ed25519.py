@@ -226,3 +226,75 @@ def test_stage_routing_thresholds():
     n = big.SPLIT_MAX + 1
     kernel, _, _ = big.stage([msgs[0]] * n, [pks[0]] * n, [sigs[0]] * n)
     assert kernel == big._run_kernel
+
+
+def test_pallas_split_wide_tile_parity_interpret():
+    """The 512-row split tile (one 16-step scan for up to 256-signature
+    batches): 140 signatures pad to 256 -> 512 rows -> the SPLIT_BT
+    tile.  Parity with the XLA path, including a tampered signature.
+
+    Interpret-mode at this width costs several CPU-minutes, so the test
+    is opt-in (HOTSTUFF_WIDE_TILE_TEST=1); fast structural coverage of
+    the tile-selection/interleave contract is in
+    test_prepare_split_wide_tile_layout, and the kernel itself is
+    validated on hardware (results/ + BENCH)."""
+    import os
+
+    import pytest
+
+    if not os.environ.get("HOTSTUFF_WIDE_TILE_TEST"):
+        pytest.skip("opt-in: interpret mode needs minutes at 512 lanes")
+    import jax.numpy as jnp
+
+    from hotstuff_tpu.tpu import pallas_dsm
+
+    n = 140
+    items = _sign_many(n, lambda i: b"wide-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+    sigs[77] = sigs[77][:40] + b"\x01" + sigs[77][41:]  # tamper one
+
+    v = BatchVerifier(min_device_batch=0, use_pallas=False)
+    want = v.verify(msgs, pks, sigs)  # XLA path
+
+    valid_host, arrays = v.prepare_split(msgs, pks, sigs)
+    (ax, ay, az, at, s_win, k_win, base_off, r_y, r_sign) = arrays
+    assert s_win.shape[1] == 512  # wide tile engaged
+    p = pallas_dsm.dual_scalar_mult_split(
+        jnp.asarray(s_win),
+        jnp.asarray(k_win),
+        tuple(jnp.asarray(c) for c in (ax, ay, az, at)),
+        jnp.asarray(base_off),
+        interpret=True,
+    )
+    ok = np.asarray(
+        curve.compressed_equals(p, jnp.asarray(r_y), jnp.asarray(r_sign))
+    )[:n] & valid_host
+    assert ok.tolist() == want.tolist()
+    assert not ok[77] and ok[:77].all() and ok[78:].all()
+
+
+def test_prepare_split_wide_tile_layout():
+    """Host-side contract of the wide split tile: 140 signatures pad to
+    256 and interleave with half-tile 256 (one 512-row kernel tile —
+    lo rows 0..255, hi rows 256..511), and the tile choice matches
+    pallas_dsm.split_half_tile for every pad size."""
+    from hotstuff_tpu.tpu.pallas_dsm import BT, SPLIT_BT, split_half_tile
+
+    assert split_half_tile(128) == BT // 2
+    assert split_half_tile(256) == SPLIT_BT // 2
+    assert split_half_tile(384) == BT // 2
+    assert split_half_tile(512) == SPLIT_BT // 2
+
+    n = 140
+    items = _sign_many(n, lambda i: b"layout-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+    v = BatchVerifier(min_device_batch=0, use_pallas=False)
+    valid_host, arrays = v.prepare_split(msgs, pks, sigs)
+    (ax, ay, az, at, s_win, k_win, base_off, r_y, r_sign) = arrays
+    assert valid_host.all()
+    assert s_win.shape == (32, 512) and base_off.shape == (512,)
+    # lo half rows carry base offset 0, hi half rows 256
+    assert (base_off[:256] == 0).all() and (base_off[256:] == 256).all()
+    # row i and row 256+i belong to the same signature: the hi half of a
+    # zero-padded row is the identity A-point, real rows are not
+    assert (ay[256 + n :, 0] == 1).all()  # identity pads in the hi half
