@@ -42,6 +42,16 @@ class BlsVerifier:
 
             self._tpu_agg = TpuG1Aggregator()
             self.name = "bls-tpu"
+        elif aggregator == "tpu-sharded":
+            # batch sharded over every visible device: per-device tree
+            # reduction + one all_gather of the partial points
+            from ...parallel.mesh import default_mesh
+            from ...tpu.bls import TpuG1Aggregator
+
+            self._tpu_agg = TpuG1Aggregator(mesh=default_mesh())
+            self.name = "bls-tpu-sharded"
+        elif aggregator != "cpu":
+            raise ValueError(f"unknown BLS aggregator '{aggregator}'")
 
     def _pk(self, pk_bytes: bytes) -> BlsPublicKey | None:
         if pk_bytes not in self._pk_cache:

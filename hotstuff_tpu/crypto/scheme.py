@@ -141,10 +141,11 @@ def make_device_verifier(scheme: str, kind: str) -> VerifierBackend:
     if scheme == "bls":
         from .bls.service import BlsVerifier
 
-        # 'tpu' and 'tpu-sharded' both map to the device G1 aggregator
-        # (single-device tree reduction; cross-device combine is the
-        # documented follow-up in docs/BLS_TPU_DESIGN.md).
-        return BlsVerifier(aggregator="tpu")
+        # 'tpu': single-device G1 tree reduction; 'tpu-sharded': batch
+        # sharded over the mesh with an all_gather partial-point combine
+        # (docs/BLS_TPU_DESIGN.md step 4).  BlsVerifier rejects anything
+        # else.
+        return BlsVerifier(aggregator=kind)
     raise ValueError(
         "ed25519 device verifiers are constructed by node.make_verifier "
         "(lazy-import hybrid)"

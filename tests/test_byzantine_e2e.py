@@ -1,0 +1,147 @@
+"""Byzantine-member end-to-end: liveness under active attack.
+
+The reference's test suite only covers crash faults ("don't boot f
+nodes" — SURVEY.md §4 lists the absence of Byzantine-behavior tests as
+a gap).  Here one committee slot is held by an ACTIVE adversary that
+floods the three honest nodes with:
+
+- votes carrying garbage signatures under its OWN identity for random
+  block digests (the per-round digest-cell exhaustion attack from
+  round 1's ADVICE — unauthenticated aggregation state);
+- spoofed votes naming HONEST authorities with garbage signatures (the
+  vote-suppression race the aggregator's eviction/replacement logic
+  defends against);
+- timeouts with garbage signatures (eager-verify path);
+- structurally malformed frames (decode error handling).
+
+Quorum is 3 of 4, so liveness requires ALL THREE honest nodes' votes to
+keep landing while the flood runs: if any spoofed garbage suppresses an
+honest vote for a full round, rounds stall into view changes and the
+20 s commit deadline fails.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from hotstuff_tpu.consensus import Consensus, Parameters, Vote
+from hotstuff_tpu.consensus.wire import encode_timeout, encode_vote
+from hotstuff_tpu.consensus.messages import QC, Timeout
+from hotstuff_tpu.crypto import Digest, Signature, SignatureService
+from hotstuff_tpu.network import SimpleSender
+from hotstuff_tpu.store import Store
+
+from .common import async_test, committee, fresh_base_port, keys
+
+
+async def _byzantine_flood(com, my_pk, honest_pks, stop: asyncio.Event):
+    """The adversary loop: one burst of garbage per 25 ms."""
+    sender = SimpleSender()
+    addresses = [addr for _, addr in com.broadcast_addresses(my_pk)]
+    rnd = 1
+    try:
+        while not stop.is_set():
+            # (a) own-identity garbage votes for random digests
+            for _ in range(3):
+                v = Vote(
+                    hash=Digest.random(),
+                    round=rnd,
+                    author=my_pk,
+                    signature=Signature(os.urandom(64)),
+                )
+                await sender.broadcast(addresses, encode_vote(v))
+            # (b) spoofed votes naming honest authorities
+            for pk in honest_pks:
+                v = Vote(
+                    hash=Digest.random(),
+                    round=rnd,
+                    author=pk,
+                    signature=Signature(os.urandom(64)),
+                )
+                await sender.broadcast(addresses, encode_vote(v))
+            # (c) garbage timeouts
+            t = Timeout(
+                high_qc=QC.genesis(),
+                round=rnd,
+                author=my_pk,
+                signature=Signature(os.urandom(64)),
+            )
+            await sender.broadcast(addresses, encode_timeout(t))
+            # (d) malformed frames
+            await sender.broadcast(addresses, os.urandom(48))
+            rnd += 1
+            await asyncio.sleep(0.025)
+    finally:
+        sender.close()
+
+
+@async_test
+async def test_honest_quorum_commits_under_byzantine_flood(tmp_path):
+    base = fresh_base_port()
+    com = committee(base)
+    fixture = keys()
+    byz_index = 3  # the slot that never runs a real node
+    honest = [i for i in range(4) if i != byz_index]
+
+    nodes = []
+    for i in honest:
+        name, secret = fixture[i]
+        store = Store(str(tmp_path / f"db_{i}"))
+        commit_q: asyncio.Queue = asyncio.Queue()
+        stack = await Consensus.spawn(
+            name,
+            com,
+            Parameters(timeout_delay=2_000, sync_retry_delay=5_000),
+            SignatureService(secret),
+            store,
+            commit_q,
+            bind_host="127.0.0.1",
+        )
+        nodes.append((stack, commit_q, store))
+
+    stop = asyncio.Event()
+    flood = asyncio.ensure_future(
+        _byzantine_flood(
+            com,
+            fixture[byz_index][0],
+            [fixture[i][0] for i in honest],
+            stop,
+        )
+    )
+
+    async def feed():
+        while True:
+            digest = Digest.random()
+            for stack, _, _ in nodes:
+                await stack.tx_producer.put(digest)
+            await asyncio.sleep(0.03)
+
+    feeder = asyncio.ensure_future(feed())
+    try:
+        chains = []
+        for _, commit_q, _ in nodes:
+            committed = []
+            while len(committed) < 2:
+                b = await asyncio.wait_for(commit_q.get(), timeout=30.0)
+                if b.round > 0:
+                    committed.append(b)
+            chains.append(committed)
+        # consistent prefixes across the honest quorum
+        digests = [[b.digest() for b in chain] for chain in chains]
+        common_len = min(len(d) for d in digests)
+        for d in digests[1:]:
+            assert d[:common_len] == digests[0][:common_len]
+        # and no honest node ever committed a block authored by the
+        # adversary (it never made a valid proposal)
+        byz_pk = fixture[byz_index][0]
+        for chain in chains:
+            assert all(b.author != byz_pk for b in chain)
+    finally:
+        stop.set()
+        feeder.cancel()
+        flood.cancel()
+        for stack, _, _ in nodes:
+            await stack.shutdown()
+        for _, _, store in nodes:
+            store.close()
