@@ -245,7 +245,7 @@ def make_sharded_g1_aggregate(mesh):
     power-of-two per-device slice; the driver pads with identities."""
     from jax.sharding import PartitionSpec as P
 
-    axis = "dp"
+    from ..parallel.mesh import DP_AXIS as axis
 
     def local(xs, ys, zs):
         part = _tree_reduce((xs, ys, zs))  # [1, NLIMBS] per device
@@ -292,6 +292,23 @@ class TpuG1Aggregator:
 
     def __init__(self, mesh=None):
         self.mesh = mesh
+        if mesh is not None:
+            # fail at construction (node boot), not inside the first
+            # QC verify: slices must be equal powers of two per device,
+            # and the shard axis name is part of the kernel contract
+            d = int(mesh.devices.size)
+            if d & (d - 1):
+                raise ValueError(
+                    f"sharded G1 aggregation needs a power-of-two mesh, "
+                    f"got {d} devices"
+                )
+            from ..parallel.mesh import DP_AXIS
+
+            if tuple(mesh.axis_names) != (DP_AXIS,):
+                raise ValueError(
+                    f"sharded G1 aggregation needs a 1-D ('{DP_AXIS}',) "
+                    f"mesh, got axes {tuple(mesh.axis_names)}"
+                )
         self._sharded = (
             None if mesh is None else make_sharded_g1_aggregate(mesh)
         )
@@ -302,15 +319,9 @@ class TpuG1Aggregator:
             1 << (n - 1).bit_length(),
         )
         if self.mesh is not None:
-            # equal power-of-two slices per device; requires a
-            # power-of-two mesh (doubling a power of two can never
-            # become divisible by an odd factor — guard, don't loop)
+            # equal power-of-two slices per device (mesh size validated
+            # as a power of two in __init__, so this terminates)
             d = int(self.mesh.devices.size)
-            if d & (d - 1):
-                raise ValueError(
-                    f"sharded G1 aggregation needs a power-of-two mesh, "
-                    f"got {d} devices"
-                )
             while padded % d or (padded // d) & (padded // d - 1):
                 padded *= 2
         return padded

@@ -145,3 +145,154 @@ async def test_honest_quorum_commits_under_byzantine_flood(tmp_path):
             await stack.shutdown()
         for _, _, store in nodes:
             store.close()
+
+
+@async_test
+async def test_safety_under_equivocating_leader(tmp_path):
+    """The canonical BFT attack: when the Byzantine member's turn to
+    lead comes, it assembles a real QC from the round's votes (which
+    honest voters address to it, the next leader), then proposes TWO
+    conflicting valid blocks — block A to two honest nodes (plus its
+    own vote for A, so A can reach quorum) and block B to the third.
+    Safety demand: the honest nodes never commit divergent chains —
+    whatever happens to the minority branch, committed prefixes agree.
+    """
+    from hotstuff_tpu.consensus.messages import Block
+    from hotstuff_tpu.consensus.wire import (
+        TAG_PROPOSE,
+        TAG_VOTE,
+        decode_message,
+        encode_propose,
+    )
+    from hotstuff_tpu.network import Receiver
+
+    base = fresh_base_port()
+    com = committee(base)
+    fixture = keys()
+    byz_index = 3
+    byz_pk, byz_sk = fixture[byz_index]
+    honest = [i for i in range(4) if i != byz_index]
+
+    nodes = []
+    for i in honest:
+        name, secret = fixture[i]
+        store = Store(str(tmp_path / f"db_{i}"))
+        commit_q: asyncio.Queue = asyncio.Queue()
+        stack = await Consensus.spawn(
+            name,
+            com,
+            Parameters(timeout_delay=1_500, sync_retry_delay=5_000),
+            SignatureService(secret),
+            store,
+            commit_q,
+            bind_host="127.0.0.1",
+        )
+        nodes.append((stack, commit_q, store))
+
+    # --- the adversary: listens on its committee slot, collects votes
+    # addressed to it (it IS the next leader for rounds r-1 where it
+    # leads r), and equivocates ONCE when it can form a QC.
+    sender = SimpleSender()
+    equivocated = asyncio.Event()
+    votes_by_digest: dict = {}
+    sorted_keys = com.sorted_keys()
+
+    class ByzHandler:
+        async def dispatch(self, writer, frame: bytes) -> None:
+            try:
+                tag, payload = decode_message(frame)
+            except Exception:
+                return
+            if tag == TAG_PROPOSE:
+                try:
+                    await writer.send(b"Ack")
+                except Exception:
+                    pass
+                return
+            if tag != TAG_VOTE or equivocated.is_set():
+                return
+            vote = payload
+            votes_by_digest.setdefault(
+                (vote.hash, vote.round), []
+            ).append(vote)
+            bucket = votes_by_digest[(vote.hash, vote.round)]
+            # the round the adversary leads next
+            lead_round = vote.round + 1
+            if sorted_keys[lead_round % 4] != byz_pk:
+                return
+            authors = {v.author for v in bucket}
+            if len(authors) < 3:
+                return
+            equivocated.set()
+            qc = QC(
+                hash=vote.hash,
+                round=vote.round,
+                votes=[(v.author, v.signature) for v in bucket[:3]],
+            )
+            block_a = Block(
+                qc=qc, author=byz_pk, round=lead_round,
+                payloads=(Digest.of(b"equivocation A"),),
+            )
+            block_a.signature = Signature.new(block_a.digest(), byz_sk)
+            block_b = Block(
+                qc=qc, author=byz_pk, round=lead_round,
+                payloads=(Digest.of(b"equivocation B"),),
+            )
+            block_b.signature = Signature.new(block_b.digest(), byz_sk)
+            addr = {pk: a for pk, a in com.broadcast_addresses(byz_pk)}
+            # A -> honest[0], honest[1]; B -> honest[2]
+            for i in (0, 1):
+                await sender.send(
+                    addr[fixture[honest[i]][0]], encode_propose(block_a)
+                )
+            await sender.send(
+                addr[fixture[honest[2]][0]], encode_propose(block_b)
+            )
+            # vote for A, addressed to the NEXT round's leader
+            my_vote = Vote.for_block(block_a, byz_pk)
+            my_vote.signature = Signature.new(my_vote.digest(), byz_sk)
+            nxt = sorted_keys[(lead_round + 1) % 4]
+            await sender.send(addr[nxt], encode_vote(my_vote))
+
+    receiver = Receiver("127.0.0.1", base + byz_index, ByzHandler())
+    await receiver.spawn()
+
+    async def feed():
+        while True:
+            digest = Digest.random()
+            for stack, _, _ in nodes:
+                await stack.tx_producer.put(digest)
+            await asyncio.sleep(0.03)
+
+    feeder = asyncio.ensure_future(feed())
+    try:
+        chains = []
+        for _, commit_q, _ in nodes:
+            committed = []
+            while len(committed) < 4:
+                b = await asyncio.wait_for(commit_q.get(), timeout=40.0)
+                if b.round > 0:
+                    committed.append(b)
+            chains.append(committed)
+        assert equivocated.is_set(), "the adversary never got to equivocate"
+        # SAFETY: committed prefixes agree across the honest committee
+        digests = [[b.digest() for b in chain] for chain in chains]
+        common_len = min(len(d) for d in digests)
+        for d in digests[1:]:
+            assert d[:common_len] == digests[0][:common_len]
+        # at most ONE of the two equivocating payloads may ever commit
+        committed_payloads = {
+            p for chain in chains for b in chain for p in b.payloads
+        }
+        assert not (
+            Digest.of(b"equivocation A") in committed_payloads
+            and Digest.of(b"equivocation B") in committed_payloads
+        )
+    finally:
+        feeder.cancel()
+        await receiver.shutdown()
+        sender.close()
+        for stack, _, _ in nodes:
+            await stack.shutdown()
+        for _, _, store in nodes:
+            store.close()
