@@ -52,6 +52,7 @@ def task_tpu(args) -> int:
     """Committee sweep with the TPU crypto backend, co-located on this
     host (one TPU VM)."""
     sizes = [int(s) for s in args.sizes.split(",")]
+    label = "tpu-1proc" if args.in_process else "tpu"
     for nodes in sizes:
         bench = LocalBench(
             nodes=nodes,
@@ -60,13 +61,14 @@ def task_tpu(args) -> int:
             faults=args.faults,
             timeout_delay=args.timeout_delay,
             verifier="tpu",
+            in_process=args.in_process,
         )
         parser = bench.run()
         summary = parser.result(
-            faults=args.faults, nodes=nodes, verifier="tpu"
+            faults=args.faults, nodes=nodes, verifier=label
         )
         print(summary)
-        _save_result(summary, args.faults, nodes, args.rate, "tpu",
+        _save_result(summary, args.faults, nodes, args.rate, label,
                      ok=parser.has_window())
     return 0
 
@@ -199,6 +201,11 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=20.0)
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
+    p.add_argument(
+        "--in-process",
+        action="store_true",
+        help="co-locate each committee in one process (see `local`)",
+    )
     p.set_defaults(fn=task_tpu)
 
     p = sub.add_parser("storm")
