@@ -37,6 +37,14 @@ class BlsVerifier:
     def __init__(self, aggregator: str = "cpu"):
         self._pk_cache: dict[bytes, BlsPublicKey | None] = {}
         self._tpu_agg = None
+        # Native pairing (C++ port of this package, ~8x): used for
+        # per-signature checks when the library is present/healthy
+        try:
+            from . import native as _native
+
+            self._native_verify = _native.verify_one
+        except ImportError:
+            self._native_verify = None
         if aggregator == "tpu":
             from ...tpu.bls import TpuG1Aggregator
 
@@ -66,6 +74,8 @@ class BlsVerifier:
         pk_b = pk if isinstance(pk, bytes) else pk.to_bytes()
         sig_b = sig if isinstance(sig, bytes) else sig.to_bytes()
         msg = digest if isinstance(digest, bytes) else digest.to_bytes()
+        if self._native_verify is not None:
+            return self._native_verify(msg, pk_b, sig_b)
         pub = self._pk(pk_b)
         s = BlsSignature.from_bytes(sig_b)
         return pub is not None and s is not None and pub.verify(msg, s)
@@ -100,12 +110,23 @@ class BlsVerifier:
             agg = self._tpu_agg.aggregate(sig_points)
         else:
             agg = G1Point.sum(sig_points)
+        agg_pk = aggregate_public_keys(pks)
+        if self._native_verify is not None:
+            # the native verifier subgroup-checks the aggregate SIGNATURE
+            # itself; the aggregate PK is a sum of individually
+            # subgroup-checked cached keys, so its ladder is skipped
+            return self._native_verify(
+                msg,
+                agg_pk.to_bytes(),
+                BlsSignature(agg).to_bytes(),
+                check_pk_subgroup=False,
+            )
         # ONE subgroup check on the aggregate (the device kernel's
         # in-kernel r-ladder is still future work, so the host checks
         # its result too — ~2 ms once per QC)
         if not agg.in_subgroup():
             return False
-        return aggregate_public_keys(pks).verify(msg, BlsSignature(agg))
+        return agg_pk.verify(msg, BlsSignature(agg))
 
     def verify_many(self, digests, pks, sigs) -> list[bool]:
         """Distinct-message batch (the TC-verify shape): one multi-pairing
@@ -128,6 +149,14 @@ class BlsVerifier:
         n = len(digests)
         if n == 0:
             return []
+        if self._native_verify is not None:
+            # per-item native verification beats the pure-Python
+            # random-weight multi-pairing (~6 ms vs ~27 ms per entry)
+            # and reports exact per-item validity with no fallback pass
+            return [
+                self.verify_one(d, p, s)
+                for d, p, s in zip(digests, pks, sigs)
+            ]
         entries = []
         for d, p, s in zip(digests, pks, sigs):
             pub = self._pk(p if isinstance(p, bytes) else p.to_bytes())
