@@ -36,23 +36,31 @@ def _native_dir() -> str:
 
 
 def _build_locked(path: str) -> None:
-    """Build the library under an exclusive lock: a co-located committee
-    booting on a clean checkout must not race N compilers onto the same
-    output file (one process would dlopen a half-written .so)."""
+    """Run ``make`` under an exclusive lock.  Always invoked — make's
+    dependency tracking makes it a no-op when the library is current and
+    REBUILDS a stale one (a .so from an older commit would load fine but
+    miss newer symbols, silently disabling all native acceleration).
+    The lock keeps a co-located committee booting on a clean checkout
+    from racing N compilers onto the same output file (one process
+    would dlopen a half-written .so)."""
     import fcntl
 
     build_dir = os.path.dirname(path)
     os.makedirs(build_dir, exist_ok=True)
     with open(os.path.join(build_dir, ".bls_build_lock"), "w") as lf:
         fcntl.flock(lf, fcntl.LOCK_EX)
-        if os.path.exists(path):  # a peer built it while we waited
-            return
-        subprocess.run(
-            ["make", "-C", _native_dir()],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        try:
+            subprocess.run(
+                ["make", "-C", _native_dir()],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            # no toolchain: an existing up-to-date library may still
+            # work — symbol resolution below decides
+            if not os.path.exists(path):
+                raise
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -60,8 +68,7 @@ def _load_lib() -> ctypes.CDLL:
         raise ImportError("native BLS disabled via HOTSTUFF_BLS_NATIVE=0")
     path = os.path.join(_native_dir(), "build", _LIB_NAME)
     try:
-        if not os.path.exists(path):
-            _build_locked(path)
+        _build_locked(path)
         lib = ctypes.CDLL(path)
         lib.hs_bls_verify_one_ex.restype = ctypes.c_int
         lib.hs_bls_verify_one_ex.argtypes = [
@@ -72,6 +79,12 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_int,
         ]
         lib.hs_bls_selftest.restype = ctypes.c_int
+        lib.hs_bls_aggregate_sigs.restype = ctypes.c_int
+        lib.hs_bls_aggregate_sigs.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+        ]
         if lib.hs_bls_selftest() != 1:
             raise ImportError(f"{_LIB_NAME} failed its bilinearity selftest")
         return lib
@@ -102,3 +115,16 @@ def verify_one(
             message, len(message), pk96, sig48, 1 if check_pk_subgroup else 0
         )
     )
+
+
+def aggregate_sigs(sigs48: list[bytes]) -> bytes | None:
+    """Sum compressed G1 signatures natively (on-curve checked; the
+    aggregate's subgroup membership is checked by verify_one).  None on
+    malformed input."""
+    if any(len(s) != 48 for s in sigs48):
+        return None
+    buf = b"".join(sigs48)
+    out = ctypes.create_string_buffer(48)
+    if not _lib.hs_bls_aggregate_sigs(buf, len(sigs48), out):
+        return None
+    return out.raw

@@ -1271,8 +1271,50 @@ inline G2 g2_generator() {
 
 }  // namespace
 
+namespace {
+
+// canonical compressed encodings (zcash format, matching curve.py)
+inline void g1_to_bytes(uint8_t out[48], const G1 &p) {
+  if (p.inf) {
+    std::memset(out, 0, 48);
+    out[0] = 0xc0;
+    return;
+  }
+  uint64_t raw[L];
+  fp_from_mont(raw, p.x);
+  for (int i = 0; i < L; i++)
+    for (int j = 0; j < 8; j++)
+      out[(L - 1 - i) * 8 + j] = (uint8_t)(raw[i] >> (8 * (7 - j)));
+  out[0] |= 0x80;
+  if (fp_canon_gt_half(p.y)) out[0] |= 0x20;
+}
+
+}  // namespace
+
 // ----------------------------------------------------------------- C API
 extern "C" {
+
+// Sum n compressed G1 signatures (48 B each, contiguous) into out48.
+// Decompression checks on-curve only — callers subgroup-check the
+// AGGREGATE (hs_bls_verify_one_ex does).  Returns 1 ok / 0 malformed.
+int hs_bls_aggregate_sigs(const uint8_t *sigs, size_t n, uint8_t *out48) {
+  G1Jac acc = {fp_one(), fp_one(), fp_zero()};
+  for (size_t i = 0; i < n; i++) {
+    G1 p;
+    if (!g1_from_bytes(p, sigs + 48 * i, /*subgroup=*/false)) return 0;
+    if (p.inf) continue;
+    G1Jac pj = g1_to_jac(p);
+    g1_jac_add(acc, acc, pj);
+  }
+  G1 aff = g1_from_jac(acc);
+  g1_to_bytes(out48, aff);
+  return 1;
+}
+
+// NOTE: a native G2 public-key aggregate was tried and REMOVED — it
+// lost to summing the verifier's cached decoded Python points, because
+// the native path must re-run the expensive Fq2 sqrt per key that the
+// cache pays once per epoch (docs/ROUND2.md records the experiment).
 
 // verify sig48 (compressed G1) by pk96 (compressed G2) over msg with the
 // framework's hash-to-curve + DST.  Returns 1 valid / 0 invalid.

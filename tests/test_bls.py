@@ -347,3 +347,23 @@ def test_bls_verifier_uses_native_and_agrees_with_python():
     want = [True] * 4
     assert v_native.verify_many(msgs, [p for p, _ in votes], dsigs) == want
     assert v_py.verify_many(msgs, [p for p, _ in votes], dsigs) == want
+
+
+def test_native_aggregation_matches_python():
+    """Native G1/G2 aggregate functions agree with the Python sums,
+    including identity entries and malformed rejection."""
+    native = _native_or_skip()
+    from hotstuff_tpu.crypto.bls.curve import G1Point
+
+    msg = b"native aggregation"
+    pairs = [keygen(bytes([160 + i])) for i in range(5)]
+    sigs = [sk.sign(msg) for _, sk in pairs]
+    want_sig = aggregate_signatures(sigs).point.to_bytes()
+    got_sig = native.aggregate_sigs([s.to_bytes() for s in sigs])
+    assert got_sig == want_sig
+    # identity entries are skipped like the Python sum
+    with_inf = [s.to_bytes() for s in sigs] + [G1Point.identity().to_bytes()]
+    assert native.aggregate_sigs(with_inf) == want_sig
+    # malformed rejection
+    assert native.aggregate_sigs([b"\x00" * 48]) is None
+    assert native.aggregate_sigs([b"short"]) is None
