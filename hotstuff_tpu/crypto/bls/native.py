@@ -85,6 +85,15 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_size_t,
             ctypes.c_char_p,
         ]
+        lib.hs_bls_verify_batch.restype = ctypes.c_int
+        lib.hs_bls_verify_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
         if lib.hs_bls_selftest() != 1:
             raise ImportError(f"{_LIB_NAME} failed its bilinearity selftest")
         return lib
@@ -128,3 +137,38 @@ def aggregate_sigs(sigs48: list[bytes]) -> bytes | None:
     if not _lib.hs_bls_aggregate_sigs(buf, len(sigs48), out):
         return None
     return out.raw
+
+
+def verify_batch(
+    digests32: list[bytes],
+    pks96: list[bytes],
+    sigs48: list[bytes],
+    check_pk_subgroup: bool = True,
+) -> bool:
+    """Random-weight batched verification over DISTINCT 32-byte digests
+    (the TC shape): n+1 Miller loops sharing one final exponentiation.
+    True = every entry valid; False = at least one invalid (re-check per
+    item to pinpoint).  Weights are generated here — their secrecy /
+    unpredictability is what makes cross-entry cancellation infeasible."""
+    import secrets
+
+    n = len(digests32)
+    if n == 0 or len(pks96) != n or len(sigs48) != n:
+        return False  # a short list would read past the joined buffers
+    if any(len(d) != 32 for d in digests32):
+        return False
+    if any(len(p) != 96 for p in pks96) or any(len(s) != 48 for s in sigs48):
+        return False
+    weights = b"".join(
+        (secrets.randbits(128) | 1).to_bytes(16, "little") for _ in range(n)
+    )
+    return bool(
+        _lib.hs_bls_verify_batch(
+            b"".join(digests32),
+            b"".join(pks96),
+            b"".join(sigs48),
+            n,
+            weights,
+            1 if check_pk_subgroup else 0,
+        )
+    )

@@ -21,6 +21,9 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
 
 #include "bls_constants.h"
 
@@ -1259,6 +1262,47 @@ inline void hash_to_g1(G1 &out, const uint8_t *msg, size_t msg_len,
   }
 }
 
+// Decompressed-pk cache: committee keys repeat across every verify
+// call, and G2 decompression costs an Fq2 sqrt (~0.3 ms) plus an
+// optional subgroup ladder.  Keyed by the raw 96 compressed bytes;
+// entries are stored SUBGROUP-CHECKED so a hit satisfies the strictest
+// caller.  Bounded; cleared when full (worst case = re-decompression).
+struct PkCacheEntry {
+  G2 point;
+  bool on_curve;
+  bool in_subgroup;
+};
+
+inline bool g2_from_bytes_cached(G2 &out, const uint8_t *data,
+                                 bool subgroup) {
+  static std::unordered_map<std::string, PkCacheEntry> cache;
+  static std::mutex mu;
+  std::string key(reinterpret_cast<const char *>(data), 96);
+  {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = cache.find(key);
+    if (it != cache.end()) {
+      const PkCacheEntry &e = it->second;
+      if (!e.on_curve) return false;
+      if (subgroup && !e.in_subgroup) return false;
+      out = e.point;
+      return true;
+    }
+  }
+  G2 p;
+  bool on_curve = g2_from_bytes(p, data, /*subgroup=*/false);
+  bool in_sub = on_curve && g2_in_subgroup(p);
+  {
+    std::lock_guard<std::mutex> g(mu);
+    if (cache.size() > 8192) cache.clear();
+    cache.emplace(std::move(key), PkCacheEntry{p, on_curve, in_sub});
+  }
+  if (!on_curve) return false;
+  if (subgroup && !in_sub) return false;
+  out = p;
+  return true;
+}
+
 inline G2 g2_generator() {
   G2 g;
   fp_set(g.x.c0, BLS_G2X0_M);
@@ -1316,6 +1360,67 @@ int hs_bls_aggregate_sigs(const uint8_t *sigs, size_t n, uint8_t *out48) {
 // the native path must re-run the expensive Fq2 sqrt per key that the
 // cache pays once per epoch (docs/ROUND2.md records the experiment).
 
+// Batched distinct-message verification (the TC / view-change-storm
+// shape) by the random-weight small-exponents technique:
+//   e(Σ rᵢ·sigᵢ, G2) == Π e(rᵢ·H(mᵢ), pkᵢ)
+// — n+1 Miller loops sharing ONE final exponentiation instead of n
+// full pairing equalities.  msgs32: n contiguous 32-byte digests;
+// weights16: n contiguous 16-byte little-endian nonzero random weights
+// (HOST-generated — they are what makes cross-entry cancellation
+// infeasible); check_pk_subgroup = 0 only for keys the caller already
+// validated (committee cache).  Every signature is individually
+// subgroup-checked (see the in-loop comment).  Returns 1 = every entry valid; 0 = at
+// least one invalid/malformed (caller re-checks per item to pinpoint).
+int hs_bls_verify_batch(const uint8_t *msgs32, const uint8_t *pks96,
+                        const uint8_t *sigs48, size_t n,
+                        const uint8_t *weights16, int check_pk_subgroup) {
+  if (n == 0) return 0;
+  static const uint8_t DST[] = "HOTSTUFF_TPU_BLS_G1";
+  G1Jac sig_acc = {fp_one(), fp_one(), fp_zero()};
+  Fp12 f = fp12_one();
+  for (size_t i = 0; i < n; i++) {
+    G2 pk;
+    if (!g2_from_bytes_cached(pk, pks96 + 96 * i, check_pk_subgroup != 0))
+      return 0;
+    if (pk.inf) return 0;
+    G1 sig;
+    // per-signature subgroup check: the G1 cofactor has SMALL factors
+    // (3, 11, ...), so a small-order component T on one signature
+    // survives the weighted-aggregate ladder whenever the random
+    // weight is divisible by ord(T) (probability 1/3 for order 3) —
+    // an aggregate-only check is NOT sound here, unlike the
+    // shared-message path where failures fall back to per-item checks
+    if (!g1_from_bytes(sig, sigs48 + 48 * i, /*subgroup=*/true)) return 0;
+    if (sig.inf) return 0;
+    uint64_t w[2];
+    w[0] = w[1] = 0;
+    for (int b = 0; b < 8; b++) {
+      w[0] |= (uint64_t)weights16[16 * i + b] << (8 * b);
+      w[1] |= (uint64_t)weights16[16 * i + 8 + b] << (8 * b);
+    }
+    if ((w[0] | w[1]) == 0) return 0;  // zero weight defeats the check
+    G1Jac wsig;
+    g1_jac_mul(wsig, sig, w, 2);
+    g1_jac_add(sig_acc, sig_acc, wsig);
+    G1 hm;
+    hash_to_g1(hm, msgs32 + 32 * i, 32, DST, sizeof(DST) - 1);
+    G1Jac whm_j;
+    g1_jac_mul(whm_j, hm, w, 2);
+    G1 whm = g1_from_jac(whm_j);
+    Fp12 fi;
+    miller_loop(fi, whm, pk);
+    fp12_mul(f, f, fi);
+  }
+  G1 agg = g1_from_jac(sig_acc);
+  if (agg.inf) return 0;  // subgroup membership: per-signature above
+  fp_neg(agg.y, agg.y);
+  Fp12 fs, out;
+  miller_loop(fs, agg, g2_generator());
+  fp12_mul(f, f, fs);
+  final_exponentiation(out, f);
+  return fp12_eq(out, fp12_one()) ? 1 : 0;
+}
+
 // verify sig48 (compressed G1) by pk96 (compressed G2) over msg with the
 // framework's hash-to-curve + DST.  Returns 1 valid / 0 invalid.
 // check_pk_subgroup = 0 skips the pk r-torsion ladder — ONLY for keys
@@ -1325,7 +1430,14 @@ int hs_bls_verify_one_ex(const uint8_t *msg, size_t msg_len,
                          const uint8_t *pk96, const uint8_t *sig48,
                          int check_pk_subgroup) {
   G2 pk;
-  if (!g2_from_bytes(pk, pk96, /*subgroup=*/check_pk_subgroup != 0)) return 0;
+  // check_pk_subgroup==0 callers pass per-QC AGGREGATE keys: always a
+  // cache miss (pure pollution) and the miss path runs the very ladder
+  // the flag skips — bypass the cache for them
+  if (check_pk_subgroup != 0) {
+    if (!g2_from_bytes_cached(pk, pk96, true)) return 0;
+  } else {
+    if (!g2_from_bytes(pk, pk96, /*subgroup=*/false)) return 0;
+  }
   if (pk.inf) return 0;
   G1 sig;
   if (!g1_from_bytes(sig, sig48, /*subgroup=*/true)) return 0;

@@ -367,3 +367,50 @@ def test_native_aggregation_matches_python():
     # malformed rejection
     assert native.aggregate_sigs([b"\x00" * 48]) is None
     assert native.aggregate_sigs([b"short"]) is None
+
+
+def test_native_batch_rejects_small_order_component():
+    """Soundness regression for the batched verifier: the G1 cofactor
+    has SMALL factors (3, 11, ...), so sig* = sig + T with ord(T) = 3
+    would survive a weighted-AGGREGATE-only subgroup check whenever the
+    random weight is divisible by 3 — the batch must subgroup-check
+    each signature individually (review finding, fixed in
+    native/bls_pairing.cpp)."""
+    native = _native_or_skip()
+    import hashlib
+
+    from hotstuff_tpu.crypto.bls.curve import H1, G1Point
+
+    assert H1 % 3 == 0  # the attack's premise
+    # an order-dividing-H1 point: clear the r-part of any curve point
+    counter = 0
+    small = None
+    while small is None:
+        h = hashlib.sha256(b"small-order" + bytes([counter])).digest()
+        x = int.from_bytes(h + h[:16], "big") % P
+        y2 = (x**3 + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P == y2:
+            t = G1Point(x, y)._mul_raw(R)  # order divides H1
+            if not t.inf:
+                order3 = t._mul_raw(H1 // 3)
+                small = order3 if not order3.inf else t
+        counter += 1
+
+    n = 4
+    pairs = [keygen(bytes([170 + i])) for i in range(n)]
+    msgs = [bytes([i]) * 32 for i in range(n)]
+    sigs = [sk.sign(m).to_bytes() for (_, sk), m in zip(pairs, msgs)]
+    pks = [pk.to_bytes() for pk, _ in pairs]
+    evil = (G1Point.from_bytes(sigs[0]) + small).to_bytes()
+    tampered = [evil] + sigs[1:]
+    # with prob 1/3 per trial a weighted-aggregate-only check would pass;
+    # 8 trials make a regression fail with prob (2/3)^8 < 5%... inverted:
+    # ANY accepting trial is the bug
+    for _ in range(8):
+        assert not native.verify_batch(msgs, pks, tampered)
+    # equal-length contract (out-of-bounds regression)
+    assert not native.verify_batch(msgs, pks[:-1], sigs)
+    assert not native.verify_batch(msgs, pks, sigs[:-1])
+    # and the untampered set still verifies
+    assert native.verify_batch(msgs, pks, sigs)
