@@ -34,17 +34,60 @@ BURST_INTERVAL = 1.0 / PRECISION
 
 
 class _NodeConn:
-    """One persistent framed connection; ACK frames are drained."""
+    """One persistent framed connection; ACK frames are drained.
+
+    A node dying MID-RUN must not kill the client (it feeds the whole
+    committee — aborting on one peer's death starves every survivor of
+    payloads and stalls consensus; found by the SIGKILL-rejoin e2e).
+    Failures mark the connection dead; a background loop reconnects, so
+    a restarted node starts receiving payloads again."""
 
     def __init__(self, address):
         self.address = address
         self.writer: asyncio.StreamWriter | None = None
         self._sink: asyncio.Task | None = None
+        self.alive = False
 
     async def connect(self) -> None:
         reader, self.writer = await asyncio.open_connection(*self.address)
         set_nodelay(self.writer)
         self._sink = asyncio.ensure_future(self._drain(reader))
+        self.alive = True
+
+    def send_frame(self, message: bytes) -> None:
+        if not self.alive:
+            return
+        try:
+            write_frame(self.writer, message)
+        except (ConnectionError, OSError):
+            self.mark_dead()
+
+    async def drain(self, timeout: float = 1.0) -> None:
+        if not self.alive:
+            return
+        try:
+            # a black-holed peer (partition, frozen process — no RST)
+            # buffers writes silently until the transport's high-water
+            # mark, then drain() would block for the full TCP timeout,
+            # starving every LIVE peer of payloads — bound it
+            await asyncio.wait_for(self.writer.drain(), timeout)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            self.mark_dead()
+
+    def mark_dead(self) -> None:
+        if self.alive:
+            log.warning("Node %s:%d unreachable; dropping until it returns",
+                        *self.address)
+        self.alive = False
+        self.close()
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.cancel()
+            self._sink = None
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
 
     @staticmethod
     async def _drain(reader: asyncio.StreamReader) -> None:
@@ -53,12 +96,6 @@ class _NodeConn:
                 await read_frame(reader)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
-
-    def close(self) -> None:
-        if self._sink is not None:
-            self._sink.cancel()
-        if self.writer is not None:
-            self.writer.close()
 
 
 async def wait_for_nodes(
@@ -133,7 +170,33 @@ async def run_client(
 
     conns = [_NodeConn(a) for a in live]
     for c in conns:
-        await c.connect()
+        try:
+            await asyncio.wait_for(c.connect(), 2.0)
+        except (OSError, asyncio.TimeoutError):
+            # died between the port probe and here — the reconnector
+            # keeps trying; one peer must never kill the whole client
+            log.warning("Node %s:%d refused the connection; will retry",
+                        *c.address)
+
+    async def try_reconnect(c: _NodeConn) -> None:
+        try:
+            # bounded: a SYN-black-holing peer must not stall the
+            # reconnection of OTHER dead peers for the OS connect timeout
+            await asyncio.wait_for(c.connect(), 1.5)
+            log.info("Reconnected to %s:%d", *c.address)
+        except (OSError, asyncio.TimeoutError):
+            pass
+
+    async def reconnector() -> None:
+        """Bring dead peers back (a restarted node must start receiving
+        payloads again, or it can never propose when it leads)."""
+        while True:
+            await asyncio.sleep(2.0)
+            dead = [c for c in conns if not c.alive]
+            if dead:
+                await asyncio.gather(*(try_reconnect(c) for c in dead))
+
+    reconnect_task = asyncio.ensure_future(reconnector())
 
     burst = max(1, rate // PRECISION)
     log.info("Start sending transactions")
@@ -144,13 +207,16 @@ async def run_client(
     start = loop.time()
     sent = 0
     counter = 0
+    was_all_dead = False
     try:
         while loop.time() - start < duration:
             slot_start = loop.time()
             # write the whole burst per connection without per-frame
             # drain syncs — one drain per (conn, burst) keeps the client
             # from becoming the bottleneck at large committees (each
-            # drain is an await even when the buffer has room)
+            # drain is an await even when the buffer has room).  Send
+            # errors mark THAT connection dead (handled inside
+            # _NodeConn); the burst continues to the rest.
             for i in range(burst):
                 digest = Digest.random()
                 if i == 0:
@@ -158,10 +224,14 @@ async def run_client(
                     log.info("Sending sample payload %s", digest)
                 message = encode_producer(digest)
                 for c in conns:
-                    write_frame(c.writer, message)
+                    c.send_frame(message)
                 sent += 1
             for c in conns:
-                await c.writer.drain()
+                await c.drain()
+            all_dead = not any(c.alive for c in conns)
+            if all_dead and not was_all_dead:
+                log.warning("Every node unreachable; waiting to reconnect")
+            was_all_dead = all_dead
             counter += 1
             elapsed = loop.time() - slot_start
             if elapsed > BURST_INTERVAL:
@@ -169,9 +239,8 @@ async def run_client(
                 log.warning("Transaction rate too high for this client")
             else:
                 await asyncio.sleep(BURST_INTERVAL - elapsed)
-    except (ConnectionError, OSError) as e:
-        log.error("Failed to send transaction: %s", e)
     finally:
+        reconnect_task.cancel()
         for c in conns:
             c.close()
     return sent
