@@ -54,6 +54,15 @@ def aggregate(results_dir: str | None = None) -> dict[tuple, dict[str, float]]:
     return out
 
 
+def _fmt(metric: dict[str, float], key: str, suffix: str = "") -> str:
+    """An absent metric prints n/a — a 0 fallback would read as a (great)
+    measurement (e.g. every run in a file reporting e2e latency 'n/a')."""
+    value = metric.get(key)
+    if value is None:
+        return "n/a"
+    return f"{value:.0f}{suffix}"
+
+
 def print_summary(groups: dict[tuple, dict[str, float]]) -> None:
     header = (
         f"{'faults':>6} {'nodes':>6} {'rate':>8} {'verifier':>10} "
@@ -64,8 +73,8 @@ def print_summary(groups: dict[tuple, dict[str, float]]) -> None:
     for (faults, nodes, rate, verifier), metric in sorted(groups.items()):
         print(
             f"{faults:>6} {nodes:>6} {rate:>8} {verifier:>10} "
-            f"{metric.get('consensus_tps', 0):>9.0f} "
-            f"{metric.get('consensus_latency_ms', 0):>8.0f}m "
-            f"{metric.get('e2e_tps', 0):>9.0f} "
-            f"{metric.get('e2e_latency_ms', 0):>8.0f}m"
+            f"{_fmt(metric, 'consensus_tps'):>9} "
+            f"{_fmt(metric, 'consensus_latency_ms', 'm'):>9} "
+            f"{_fmt(metric, 'e2e_tps'):>9} "
+            f"{_fmt(metric, 'e2e_latency_ms', 'm'):>9}"
         )

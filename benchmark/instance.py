@@ -35,7 +35,7 @@ class TpuVmManager:
 
     def __init__(self, settings: Settings, runner=None):
         self.settings = settings
-        self.run = runner if runner is not None else _default_runner
+        self._runner = runner if runner is not None else _default_runner
 
     def _name(self, i: int) -> str:
         return f"{self.settings.testbed}-{i}"
@@ -52,7 +52,7 @@ class TpuVmManager:
         s = self.settings
         for i in range(s.instances):
             Print.info(f"Creating {self._name(i)} ({s.accelerator_type})")
-            self.run(
+            self._runner(
                 self._base()
                 + [
                     "create",
@@ -66,7 +66,7 @@ class TpuVmManager:
     def terminate_instances(self) -> None:
         for i in range(self.settings.instances):
             Print.info(f"Deleting {self._name(i)}")
-            self.run(
+            self._runner(
                 self._base()
                 + [
                     "delete",
@@ -78,21 +78,21 @@ class TpuVmManager:
 
     def start_instances(self) -> None:
         for i in range(self.settings.instances):
-            self.run(
+            self._runner(
                 self._base()
                 + ["start", self._name(i), f"--zone={self.settings.zone}"]
             )
 
     def stop_instances(self) -> None:
         for i in range(self.settings.instances):
-            self.run(
+            self._runner(
                 self._base()
                 + ["stop", self._name(i), f"--zone={self.settings.zone}"]
             )
 
     def hosts(self) -> list[dict]:
         """[{name, internal_ip, external_ip, state}] for the testbed."""
-        out = self.run(
+        out = self._runner(
             self._base()
             + [
                 "list",

@@ -159,20 +159,31 @@ class LogParser:
         duration = max(end - self.client_start, 1e-9)
         return self.committed_payloads() / duration, duration
 
-    def end_to_end_latency(self) -> float:
-        """Mean sample-payload send -> containing-block commit latency (s)."""
+    def end_to_end_latency(self) -> float | None:
+        """Mean sample-payload send -> containing-block commit latency (s).
+        None when no sample payload landed in the window — reporting 0 ms
+        for "no data" would read as a (great) measurement."""
         lat = []
         for payload, sent in self.samples.items():
             block = self.payload_to_block.get(payload)
             if block is not None and block in self.commits:
                 lat.append(self.commits[block] - sent)
-        return mean(lat) if lat else 0.0
+        return mean(lat) if lat else None
 
     def result(
         self, faults: int = 0, nodes: int | None = None, verifier: str = "cpu"
     ) -> str:
         c_tps, c_dur = self.consensus_throughput()
         e_tps, _ = self.end_to_end_throughput()
+        e2e_lat = self.end_to_end_latency()
+        e2e_lat_txt = (
+            f"{round(e2e_lat * 1000)} ms" if e2e_lat is not None
+            else "n/a (no sample payload committed in the window)"
+        )
+        c_lat_txt = (
+            f"{round(self.consensus_latency() * 1000)} ms" if self.commits
+            else "n/a (no commits)"
+        )
         return (
             "\n"
             "-----------------------------------------\n"
@@ -188,9 +199,9 @@ class LogParser:
             "\n"
             " + RESULTS:\n"
             f" Consensus TPS: {round(c_tps)} payloads/s\n"
-            f" Consensus latency: {round(self.consensus_latency() * 1000)} ms\n"
+            f" Consensus latency: {c_lat_txt}\n"
             f" End-to-end TPS: {round(e_tps)} payloads/s\n"
-            f" End-to-end latency: {round(self.end_to_end_latency() * 1000)} ms\n"
+            f" End-to-end latency: {e2e_lat_txt}\n"
             f" Committed blocks: {len(self.commits)}\n"
             f" View-change timeouts: {self.timeouts}\n"
             f" Client rate warnings: {self.rate_warnings}\n"

@@ -29,14 +29,17 @@ from .utils import BenchError, PathMaker, Print, save_result
 class RemoteBench:
     def __init__(self, settings: Settings, runner=None):
         self.settings = settings
-        self.run = runner if runner is not None else _default_runner
-        self.manager = TpuVmManager(settings, runner=self.run)
+        # NOTE: this attribute must not be called ``run`` — an instance
+        # attribute named ``run`` would shadow the public ``run()`` sweep
+        # method below and break ``python -m benchmark remote``.
+        self._runner = runner if runner is not None else _default_runner
+        self.manager = TpuVmManager(settings, runner=self._runner)
 
     # ---- transport ---------------------------------------------------------
 
     def _ssh(self, name: str, command: str, timeout: int = 600) -> str:
         s = self.settings
-        return self.run(
+        return self._runner(
             list(s.ssh_command)
             + [name, f"--zone={s.zone}", f"--command={command}"],
             timeout,
@@ -44,14 +47,14 @@ class RemoteBench:
 
     def _upload(self, name: str, local: str, remote: str) -> None:
         s = self.settings
-        self.run(
+        self._runner(
             list(s.scp_command)
             + [local, f"{name}:{remote}", f"--zone={s.zone}"]
         )
 
     def _download(self, name: str, remote: str, local: str) -> None:
         s = self.settings
-        self.run(
+        self._runner(
             list(s.scp_command)
             + [f"{name}:{remote}", local, f"--zone={s.zone}"]
         )

@@ -30,7 +30,14 @@ from .helper import Helper
 from .leader import LeaderElector
 from .proposer import Proposer
 from .synchronizer import Synchronizer
-from .wire import ACK, TAG_PRODUCER, TAG_PROPOSE, TAG_SYNC_REQUEST, decode_message
+from .wire import (
+    ACK,
+    SCHEME_WIRE_SIZES,
+    TAG_PRODUCER,
+    TAG_PROPOSE,
+    TAG_SYNC_REQUEST,
+    decode_message,
+)
 
 log = logging.getLogger(__name__)
 
@@ -43,14 +50,19 @@ class ConsensusReceiverHandler:
         tx_consensus: asyncio.Queue,
         tx_helper: asyncio.Queue,
         tx_producer: asyncio.Queue,
+        scheme: str | None = None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
         self.tx_producer = tx_producer
+        # fail at construction (node boot), not per-message in dispatch
+        if scheme is not None and scheme not in SCHEME_WIRE_SIZES:
+            raise ValueError(f"unknown committee scheme '{scheme}'")
+        self.scheme = scheme
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         try:
-            tag, payload = decode_message(message)
+            tag, payload = decode_message(message, scheme=self.scheme)
         except SerializationError as e:
             log.warning("Dropping malformed message: %s", e)
             return
@@ -140,7 +152,10 @@ class Consensus:
         self.receiver = receiver_cls(
             bind_host,
             address[1],
-            ConsensusReceiverHandler(tx_consensus, tx_helper, tx_producer),
+            ConsensusReceiverHandler(
+                tx_consensus, tx_helper, tx_producer,
+                scheme=committee.scheme,
+            ),
         )
         await self.receiver.spawn()
         log.info(

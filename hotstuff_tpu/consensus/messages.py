@@ -52,8 +52,14 @@ def encode_pk(enc: Encoder, pk: PublicKey) -> None:
 
 
 def decode_pk(dec: Decoder) -> PublicKey:
+    data = dec.var_bytes(_MAX_KEYSIG)
+    if dec.pk_size is not None and len(data) != dec.pk_size:
+        raise CodecError(
+            f"public key must be {dec.pk_size} bytes under the "
+            f"committee scheme, got {len(data)}"
+        )
     try:
-        return PublicKey(dec.var_bytes(_MAX_KEYSIG))
+        return PublicKey(data)
     except ValueError as e:
         raise CodecError(str(e)) from e
 
@@ -63,8 +69,14 @@ def encode_sig(enc: Encoder, sig: Signature) -> None:
 
 
 def decode_sig(dec: Decoder) -> Signature:
+    data = dec.var_bytes(_MAX_KEYSIG)
+    if dec.sig_size is not None and len(data) != dec.sig_size:
+        raise CodecError(
+            f"signature must be {dec.sig_size} bytes under the "
+            f"committee scheme, got {len(data)}"
+        )
     try:
-        return Signature(dec.var_bytes(_MAX_KEYSIG))
+        return Signature(data)
     except ValueError as e:
         raise CodecError(str(e)) from e
 
@@ -111,12 +123,27 @@ class QC:
     def _cache_key(self) -> bytes:
         """Identity of this certificate's full contents (hash, round and
         every vote) — two QCs with the same key are byte-identical, so a
-        successful verification of one covers the other."""
-        return sha512_trunc(
-            self.hash.to_bytes()
-            + _round_le(self.round)
-            + b"".join(pk.data + sig.data for pk, sig in self.votes)
-        )
+        successful verification of one covers the other.
+
+        The hashed material must be INJECTIVE in the vote list, not just
+        the concatenated bytes: pk/sig accept multiple wire sizes (32/96
+        and 64/48 for ed25519/BLS), so an unframed concatenation lets a
+        different partitioning of the same byte stream (e.g. two 96+48
+        votes vs three 32+64 chunks, both 288 bytes) collide with a
+        verified QC's key and skip verification for a crafted
+        certificate.  Hence the vote count and a u32 length prefix per
+        field."""
+        parts = [
+            self.hash.to_bytes(),
+            _round_le(self.round),
+            len(self.votes).to_bytes(4, "little"),
+        ]
+        for pk, sig in self.votes:
+            parts.append(len(pk.data).to_bytes(4, "little"))
+            parts.append(pk.data)
+            parts.append(len(sig.data).to_bytes(4, "little"))
+            parts.append(sig.data)
+        return sha512_trunc(b"".join(parts))
 
     def verify(
         self,

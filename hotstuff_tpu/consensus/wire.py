@@ -21,6 +21,12 @@ TAG_PRODUCER = 5
 
 ACK = b"Ack"
 
+# Committee-scheme wire sizes for key/signature fields: (pk, sig) bytes.
+# One committee never mixes schemes, so the network decode path narrows
+# the accepted sizes to its own scheme (ADVICE r2: don't rely on later
+# stake/crypto checks to reject the other scheme's material).
+SCHEME_WIRE_SIZES = {"ed25519": (32, 64), "bls": (96, 48)}
+
 
 def encode_propose(block: Block) -> bytes:
     enc = Encoder().u8(TAG_PROPOSE)
@@ -56,14 +62,26 @@ def encode_producer(payload: Digest) -> bytes:
     return Encoder().u8(TAG_PRODUCER).raw(payload.to_bytes()).finish()
 
 
-def decode_message(data: bytes):
+def decode_message(data: bytes, scheme: str | None = None):
     """bytes -> (tag, payload). Raises SerializationError on malformed input.
 
     Payload by tag: Propose -> Block, Vote -> Vote, Timeout -> Timeout,
     TC -> TC, SyncRequest -> (Digest, PublicKey), Producer -> Digest.
+
+    ``scheme`` (the committee's signature scheme) narrows accepted
+    key/signature wire sizes to that scheme's; None accepts the union.
+    An unknown scheme is a caller bug — raised as ValueError at once,
+    never per-message from inside the codec error path.
     """
+    sizes = None
+    if scheme is not None:
+        sizes = SCHEME_WIRE_SIZES.get(scheme)
+        if sizes is None:
+            raise ValueError(f"unknown committee scheme '{scheme}'")
     try:
         dec = Decoder(data)
+        if sizes is not None:
+            dec.pk_size, dec.sig_size = sizes
         tag = dec.u8()
         if tag == TAG_PROPOSE:
             out = Block.decode(dec)

@@ -48,10 +48,39 @@ class _NodeConn:
         self._sink: asyncio.Task | None = None
         self.alive = False
 
+    @staticmethod
+    def _reap_orphaned_open(task: "asyncio.Task") -> None:
+        """Close a connection whose open completed but whose result was
+        dropped by cancellation (no owner will ever see it)."""
+        if task.cancelled() or task.exception() is not None:
+            return
+        _, writer = task.result()
+        writer.close()
+
     async def connect(self) -> None:
-        reader, self.writer = await asyncio.open_connection(*self.address)
-        set_nodelay(self.writer)
-        self._sink = asyncio.ensure_future(self._drain(reader))
+        # Cancellation-safe: the caller wraps this in wait_for.  The
+        # leak window is the cancel landing AT the await when the open
+        # has already completed — the task machinery drops the completed
+        # (reader, writer) result, so nothing in this frame ever sees
+        # the established transport.  Run the open as its own task and,
+        # on cancellation, attach a reaper that closes the transport if
+        # the open (has) succeeded; assign self.* only once fully set up.
+        open_task = asyncio.ensure_future(
+            asyncio.open_connection(*self.address)
+        )
+        try:
+            reader, writer = await open_task
+        except asyncio.CancelledError:
+            open_task.add_done_callback(self._reap_orphaned_open)
+            raise
+        try:
+            set_nodelay(writer)
+            sink = asyncio.ensure_future(self._drain(reader))
+        except BaseException:
+            writer.close()
+            raise
+        self.writer = writer
+        self._sink = sink
         self.alive = True
 
     def send_frame(self, message: bytes) -> None:

@@ -55,11 +55,19 @@ class Encoder:
 
 
 class Decoder:
-    __slots__ = ("_data", "_pos")
+    # pk_size/sig_size: optional wire-size expectation for key/signature
+    # fields, set by the entry point that knows the committee's scheme
+    # (wire.decode_message).  None = accept any size the value type
+    # allows (trusted/loopback decode paths).  Narrowing this at decode
+    # time keeps an ed25519 committee from parsing 96-byte BLS keys off
+    # the wire at all (hostile-input surface, ADVICE r2).
+    __slots__ = ("_data", "_pos", "pk_size", "sig_size")
 
     def __init__(self, data: bytes):
         self._data = data
         self._pos = 0
+        self.pk_size: int | None = None
+        self.sig_size: int | None = None
 
     def _take(self, n: int) -> bytes:
         if self._pos + n > len(self._data):

@@ -214,3 +214,64 @@ def test_qc_verify_cache_skips_repeat_batches():
     )
     with pytest.raises(AuthorityReuse):
         bad.verify(COMMITTEE, v, cache=cache)
+
+
+def test_qc_cache_key_is_injective_in_vote_framing():
+    """ADVICE r2: an unframed concatenation of variable-size pk/sig bytes
+    lets a different partitioning of the same byte stream collide with a
+    verified QC's cache key.  The key must separate vote boundaries: two
+    96+48-byte (BLS-shaped) votes and three 32+64-byte (ed25519-shaped)
+    chunks of the SAME 288-byte stream must hash differently."""
+    from hotstuff_tpu.crypto import PublicKey
+
+    stream = bytes(range(256)) + bytes(32)  # 288 deterministic bytes
+    as_bls = QC(
+        hash=Digest(b"\x01" * 32),
+        round=7,
+        votes=[
+            (PublicKey(stream[0:96]), Signature(stream[96:144])),
+            (PublicKey(stream[144:240]), Signature(stream[240:288])),
+        ],
+    )
+    as_ed = QC(
+        hash=Digest(b"\x01" * 32),
+        round=7,
+        votes=[
+            (PublicKey(stream[0:32]), Signature(stream[32:96])),
+            (PublicKey(stream[96:128]), Signature(stream[128:192])),
+            (PublicKey(stream[192:224]), Signature(stream[224:288])),
+        ],
+    )
+    assert b"".join(pk.data + sig.data for pk, sig in as_bls.votes) == \
+           b"".join(pk.data + sig.data for pk, sig in as_ed.votes)
+    assert as_bls._cache_key() != as_ed._cache_key()
+
+
+def test_decode_narrows_keysig_sizes_to_committee_scheme():
+    """ADVICE r2: an ed25519 committee must reject BLS-sized (96/48)
+    key/signature material at decode time, and vice versa, instead of
+    relying on later stake/crypto checks."""
+    from hotstuff_tpu.consensus.errors import SerializationError
+    from hotstuff_tpu.crypto import PublicKey
+
+    block = chain(1)[0]
+    pk, sk = keys()[0]
+    vote = signed_vote(block, pk, sk)  # ed25519-sized: 32/64
+    data = encode_vote(vote)
+    # accepted under its own scheme and under no scheme (union)
+    decode_message(data)
+    decode_message(data, scheme="ed25519")
+    # rejected under the other scheme's sizes
+    with pytest.raises(SerializationError):
+        decode_message(data, scheme="bls")
+    # BLS-shaped material rejected by an ed25519 committee
+    vote_bls = Vote(
+        hash=vote.hash,
+        round=vote.round,
+        author=PublicKey(b"\x05" * 96),
+        signature=Signature(b"\x06" * 48),
+    )
+    data_bls = encode_vote(vote_bls)
+    decode_message(data_bls, scheme="bls")
+    with pytest.raises(SerializationError):
+        decode_message(data_bls, scheme="ed25519")

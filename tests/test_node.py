@@ -118,3 +118,37 @@ def test_lazy_device_verifier_routes_without_jax():
     # precompute is deferred, not lost
     v.precompute([pk.to_bytes()])
     assert v._precomputed and v._device is None
+
+
+@async_test
+async def test_client_conn_connect_is_cancellation_safe(monkeypatch):
+    """ADVICE r2 (client.py try_reconnect): the fd-leak race is the
+    cancel landing AT the ``await open_connection`` when the open has
+    already completed — the task machinery drops the (reader, writer)
+    result.  Reproduce it deterministically: let the inner open task
+    complete, cancel the connect task before its wakeup is processed,
+    and assert the orphaned transport is closed."""
+    closed = []
+
+    class FakeWriter:
+        def close(self):
+            closed.append(True)
+
+    async def fake_open_connection(*a, **k):
+        return object(), FakeWriter()
+
+    monkeypatch.setattr(asyncio, "open_connection", fake_open_connection)
+    from hotstuff_tpu.node.client import _NodeConn
+
+    conn = _NodeConn(("127.0.0.1", 1))
+    task = asyncio.ensure_future(conn.connect())
+    await asyncio.sleep(0)  # connect() starts, suspends on open_task
+    await asyncio.sleep(0)  # open_task completes; connect wakeup queued
+    task.cancel()  # delivered at the await: the completed result orphans
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+    await asyncio.sleep(0)  # let the reaper done-callback run
+    assert closed == [True]
+    assert conn.writer is None and not conn.alive

@@ -131,6 +131,102 @@ def test_config_generates_and_uploads(tmp_path, monkeypatch):
     assert len(key_uploads) == 4
 
 
+class SweepRunner(FakeRunner):
+    """Fake gcloud runner that synthesizes remote logs on scp download.
+
+    Node/client log content is keyed by the rate of the most recent
+    client launch so the sweep's per-config ``has_window`` gating can be
+    exercised: configured 'dead' rates produce logs with no commits.
+    """
+
+    def __init__(self, hosts_json, dead_rates=()):
+        super().__init__(hosts_json)
+        self.dead_rates = set(dead_rates)
+        self.current_rate = None
+
+    def __call__(self, cmd, timeout=600):
+        self.commands.append(list(cmd))
+        if "list" in cmd:
+            return self.hosts_json
+        joined = " ".join(cmd)
+        m = __import__("re").search(r"--rate (\d+)", joined)
+        if m:
+            self.current_rate = int(m.group(1))
+        # scp download: first operand is "host:path", second is the
+        # local destination (uploads are the reverse order)
+        if "scp" in joined:
+            operands = [a for a in cmd if not a.startswith("--")
+                        and "scp" not in a and a not in ("gcloud", "compute",
+                                                         "tpus", "tpu-vm")]
+            if len(operands) == 2 and ":" in operands[0]:
+                remote, local = operands
+                dead = self.current_rate in self.dead_rates
+                if "node-" in remote:
+                    content = (
+                        "2026-01-01T00:00:00.000Z INFO Timeout delay set to 5000 ms\n"
+                        "2026-01-01T00:00:01.000Z INFO Created block 1 (payloads pA) -> B1\n"
+                    )
+                    if not dead:
+                        content += (
+                            "2026-01-01T00:00:01.100Z INFO Committed block 1 -> B1\n"
+                        )
+                else:
+                    content = (
+                        "2026-01-01T00:00:00.500Z INFO Transactions rate: "
+                        f"{self.current_rate or 0} tx/s\n"
+                        "2026-01-01T00:00:00.900Z INFO Sending sample payload pA\n"
+                    )
+                with open(local, "w") as f:
+                    f.write(content)
+        return ""
+
+
+def test_remote_cli_sweep_end_to_end(tmp_path, monkeypatch):
+    """Drive the PUBLIC seam — ``python -m benchmark remote`` — through
+    main() with a fake runner.  Regression for the round-2 bug where
+    ``self.run = runner`` in __init__ shadowed the run() sweep method and
+    the CLI died with a TypeError on first use."""
+    import time as _time
+
+    from benchmark.__main__ import main
+
+    monkeypatch.chdir(tmp_path)
+    make_settings(tmp_path, count=2)  # writes tmp_path/settings.json
+    runner = SweepRunner(hosts_payload(2), dead_rates={200})
+    monkeypatch.setattr("benchmark.remote._default_runner", runner)
+    monkeypatch.setattr(_time, "sleep", lambda s: None)
+
+    rc = main([
+        "remote", "--settings", str(tmp_path / "settings.json"),
+        "--sizes", "4", "--rates", "100,200", "--duration", "1",
+        "--runs", "2", "--verifier", "tpu",
+    ])
+    assert rc == 0
+
+    cmds = [" ".join(c) for c in runner.commands]
+    # sweep shape: 2 rates x 2 runs = 4 single runs, each with one
+    # client launch and (nodes - faults) node launches
+    client_launches = [c for c in cmds if "hotstuff_tpu.node.client" in c]
+    assert len(client_launches) == 4
+    node_launches = [c for c in cmds if "hotstuff_tpu.node -vv run" in c]
+    assert len(node_launches) == 4 * 4
+    # results-file discipline: rate 100 committed -> file with 2 runs;
+    # rate 200 produced no commits -> has_window gating keeps it out
+    ok_file = tmp_path / "results" / "bench-0-4-100-tpu.txt"
+    assert ok_file.exists()
+    assert ok_file.read_text().count("SUMMARY") == 2
+    assert not (tmp_path / "results" / "bench-0-4-200-tpu.txt").exists()
+
+
+def test_remote_run_is_a_method(tmp_path):
+    """The run() sweep entry must be the class method, never an instance
+    attribute (the shadowing-bug regression check at the API level)."""
+    s = make_settings(tmp_path, count=1)
+    bench = RemoteBench(s, runner=FakeRunner())
+    assert callable(bench.run)
+    assert bench.run.__func__ is RemoteBench.run
+
+
 def test_run_single_boots_nodes_round_robin(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     s = make_settings(tmp_path, count=2)
