@@ -29,21 +29,42 @@ def format_address(a: Address) -> str:
 
 @dataclass
 class Parameters:
-    """Protocol timing knobs (milliseconds), JSON round-trippable."""
+    """Protocol timing knobs (milliseconds), JSON round-trippable.
+
+    ``timeout_backoff``/``timeout_cap_ms`` drive the core's exponential
+    view-change backoff (beyond reference parity — its timeout is fixed,
+    config.rs:16-23): after k CONSECUTIVE local timeouts the round timer
+    runs at ``timeout_delay * timeout_backoff^k`` (capped), snapping back
+    to the base on progress (a newer QC).  This makes a small base delay
+    safe — crash-faulted committees recover dead-leader rounds in ~one
+    base delay while a genuinely slow network still converges.
+    ``timeout_backoff = 1.0`` restores the reference's fixed timer."""
 
     timeout_delay: int = 5_000
     sync_retry_delay: int = 10_000
+    timeout_backoff: float = 2.0
+    timeout_cap_ms: int = 60_000
 
     def log(self) -> None:
         # NOTE: these log entries are used to compute performance
         # (reference config.rs:26-30 — the harness scrapes them).
         log.info("Timeout delay set to %s ms", self.timeout_delay)
         log.info("Sync retry delay set to %s ms", self.sync_retry_delay)
+        # echoed so result files record which backoff configuration
+        # produced a (fault) run — without this, runs at backoff 1.0
+        # (reference-parity fixed timer) vs 2.0 are indistinguishable
+        log.info(
+            "Timeout backoff set to %s (cap %s ms)",
+            self.timeout_backoff,
+            self.timeout_cap_ms,
+        )
 
     def to_json(self) -> dict:
         return {
             "timeout_delay": self.timeout_delay,
             "sync_retry_delay": self.sync_retry_delay,
+            "timeout_backoff": self.timeout_backoff,
+            "timeout_cap_ms": self.timeout_cap_ms,
         }
 
     @classmethod
@@ -53,6 +74,12 @@ class Parameters:
             timeout_delay=int(data.get("timeout_delay", default.timeout_delay)),
             sync_retry_delay=int(
                 data.get("sync_retry_delay", default.sync_retry_delay)
+            ),
+            timeout_backoff=float(
+                data.get("timeout_backoff", default.timeout_backoff)
+            ),
+            timeout_cap_ms=int(
+                data.get("timeout_cap_ms", default.timeout_cap_ms)
             ),
         )
 

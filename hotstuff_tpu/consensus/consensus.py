@@ -184,6 +184,8 @@ class Consensus:
             leader_elector,
             self.synchronizer,
             parameters.timeout_delay,
+            timeout_backoff=parameters.timeout_backoff,
+            timeout_cap_ms=parameters.timeout_cap_ms,
             rx_message=tx_consensus,
             rx_loopback=tx_loopback,
             tx_proposer=tx_proposer,

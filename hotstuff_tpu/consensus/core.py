@@ -123,18 +123,38 @@ class ProposerMessage:
     next burst arrives (bursty clients otherwise couple commit latency
     to their burst interval)."""
 
-    __slots__ = ("kind", "round", "qc", "tc", "rounds", "allow_empty")
+    __slots__ = (
+        "kind", "round", "qc", "tc", "rounds", "allow_empty", "payloads",
+        "committed_round",
+    )
 
     MAKE = "make"
     CLEANUP = "cleanup"
 
-    def __init__(self, kind, round_=0, qc=None, tc=None, rounds=(), allow_empty=False):
+    def __init__(
+        self,
+        kind,
+        round_=0,
+        qc=None,
+        tc=None,
+        rounds=(),
+        allow_empty=False,
+        payloads=frozenset(),
+        committed_round=0,
+    ):
         self.kind = kind
         self.round = round_
         self.qc = qc
         self.tc = tc
         self.rounds = list(rounds)
         self.allow_empty = allow_empty
+        # committed payload digests the proposer must drop from its
+        # buffer, and the round the chain is committed through — any of
+        # our in-flight proposals at <= committed_round whose payloads
+        # are not in the set are orphaned for good and get re-buffered
+        # (see Core._commit / Proposer orphan recovery)
+        self.payloads = payloads
+        self.committed_round = committed_round
 
     @classmethod
     def make(
@@ -143,8 +163,15 @@ class ProposerMessage:
         return cls(cls.MAKE, round_=round_, qc=qc, tc=tc, allow_empty=allow_empty)
 
     @classmethod
-    def cleanup(cls, rounds: list[Round]) -> "ProposerMessage":
-        return cls(cls.CLEANUP, rounds=rounds)
+    def cleanup(
+        cls, rounds: list[Round], payloads=frozenset(), committed_round=0
+    ) -> "ProposerMessage":
+        return cls(
+            cls.CLEANUP,
+            rounds=rounds,
+            payloads=payloads,
+            committed_round=committed_round,
+        )
 
 
 class Core:
@@ -163,6 +190,8 @@ class Core:
         tx_proposer: asyncio.Queue,
         tx_commit: asyncio.Queue,
         network: SimpleSender | None = None,
+        timeout_backoff: float = 2.0,
+        timeout_cap_ms: int = 60_000,
     ):
         self.name = name
         self.committee = committee
@@ -185,6 +214,15 @@ class Core:
         self.last_payload_round: Round = 0
         self.high_qc: QC = QC.genesis()
         self.timer = Timer(timeout_delay_ms)
+        # Exponential view-change backoff (config.Parameters docstring):
+        # consecutive local timeouts grow the round timer geometrically;
+        # observing a NEWER QC (real progress) snaps it back to base.
+        self._timeout_base_ms = timeout_delay_ms
+        self._timeout_backoff = timeout_backoff
+        self._timeout_cap_ms = timeout_cap_ms
+        self._timeout_exponent = 0
+        # TC advances since the last QC advance (see _advance_round)
+        self._consecutive_tcs = 0
         self.aggregator = Aggregator(committee, verifier, self_key=name)
         self.network = network if network is not None else SimpleSender()
         # Memo of QC cache-keys that already verified against this
@@ -294,11 +332,32 @@ class Core:
         self.last_committed_round = block.round
         self.state_changed = True
 
+        committed_payloads: set = set()
         for b in reversed(to_commit):
-            self.log.debug("Committed %r", b)
             await self.tx_commit.put(b)
-        # NOTE: this log entry is used to compute performance.
-        self.log.info("Committed block %d -> %s", block.round, block.digest())
+            committed_payloads.update(b.payloads)
+            # NOTE: this log entry is used to compute performance —
+            # one line per block in the chain walk (the reference logs
+            # inside its commit loop too, core.rs:204-209); logging only
+            # the head would hide the other blocks' payloads from the
+            # harness and undercount TPS after every view change.
+            self.log.info("Committed block %d -> %s", b.round, b.digest())
+        # Tell the proposer what committed: (a) it prunes those digests
+        # from its buffer — with single-homed clients (node/client.py)
+        # queues are disjoint so this is defense-in-depth against
+        # producers that DO multi-home a payload (each would otherwise
+        # be re-proposed by every node that buffered it); (b) the
+        # committed_round lets it resolve its in-flight proposals —
+        # payloads of orphaned blocks return to the buffer (orphan
+        # recovery; the reference instead drops whole per-round buckets
+        # on cleanup, proposer.rs:164-173, losing them entirely).
+        await self.tx_proposer.put(
+            ProposerMessage.cleanup(
+                [],
+                payloads=committed_payloads,
+                committed_round=self.last_committed_round,
+            )
+        )
 
     def _update_high_qc(self, qc: QC) -> None:
         if qc.round > self.high_qc.round:
@@ -307,9 +366,32 @@ class Core:
 
     # ---- round advancement and proposals -----------------------------------
 
-    def _advance_round(self, round_: Round) -> None:
+    def _advance_round(self, round_: Round, *, via_tc: bool = False) -> None:
         if round_ < self.round:
             return
+        # View-change backoff policy:
+        # - QC advance = real progress: snap timer and TC streak to base.
+        # - FIRST TC after progress: retry at base once — with
+        #   round-robin leaders a single crashed node deterministically
+        #   costs TWO view changes per lap (the preceding round's QC
+        #   dies with it: votes route to the dead collector; then its
+        #   own round stalls), and paying base + backed-off for a
+        #   structural event halves fault throughput for nothing.
+        # - CONSECUTIVE TCs (no QC in between): keep the backed-off
+        #   timer — under a uniformly slow but live network TCs keep
+        #   forming, and resetting on every TC would pin the timer at
+        #   base forever (endless view changes, zero commits).  Growth
+        #   is delayed by one view change but remains geometric, so
+        #   convergence under asynchrony is preserved.
+        if via_tc:
+            self._consecutive_tcs += 1
+            snap = self._consecutive_tcs == 1
+        else:
+            self._consecutive_tcs = 0
+            snap = True
+        if snap and self._timeout_exponent:
+            self._timeout_exponent = 0
+            self.timer.set_duration_ms(self._timeout_base_ms)
         self.timer.reset()
         self.round = round_ + 1
         self.state_changed = True
@@ -378,7 +460,7 @@ class Core:
         tc = self.aggregator.add_timeout(timeout, self.round)
         if tc is not None:
             self.log.debug("Assembled %r", tc)
-            self._advance_round(tc.round)
+            self._advance_round(tc.round, via_tc=True)
 
             addresses = [
                 addr for _, addr in self.committee.broadcast_addresses(self.name)
@@ -400,6 +482,17 @@ class Core:
             timeout.digest()
         )
         self.log.debug("Created %r", timeout)
+        # one more consecutive view change -> stretch the next round's
+        # timer (a dead-leader round costs ~one base delay; a genuinely
+        # slow network backs off geometrically instead of storming)
+        self._timeout_exponent += 1
+        self.timer.set_duration_ms(
+            min(
+                self._timeout_base_ms
+                * self._timeout_backoff**self._timeout_exponent,
+                self._timeout_cap_ms,
+            )
+        )
         self.timer.reset()
 
         addresses = [
@@ -465,7 +558,7 @@ class Core:
         block.verify(self.committee, self.verifier, qc_cache=self._qc_cache())
         self._process_qc(block.qc)
         if block.tc is not None:
-            self._advance_round(block.tc.round)
+            self._advance_round(block.tc.round, via_tc=True)
         await self._process_block(block)
 
     async def _handle_tc(self, tc: TC) -> None:
@@ -475,7 +568,7 @@ class Core:
         if tc.round < self.round:
             return
         tc.verify(self.committee, self.verifier)
-        self._advance_round(tc.round)
+        self._advance_round(tc.round, via_tc=True)
         if self.name == self.leader_elector.get_leader(self.round):
             await self._generate_proposal(tc)
 

@@ -20,8 +20,9 @@ reference's scheme produces):
   ~5 ms bursts separated by 5 s stalls, 87 ms mean consensus latency.
   The bucket scheme also costs one store round-trip per arriving payload
   (the ``latest_round`` read), 50k queue hops/s at the target rate.
-- Here: one FIFO deque with digest dedup.  ``Make`` pops the oldest
-  payload; if the deque is empty the make is DEFERRED and fires the
+- Here: one FIFO (ordered map) with digest dedup and O(1) removal of
+  committed payloads (core cleanup).  ``Make`` pops the oldest
+  payload; if the buffer is empty the make is DEFERRED and fires the
   moment the next payload arrives (superseded by newer makes, dropped by
   cleanups for later rounds).  No store reads at all on the payload
   path; consensus paces itself to the payload arrival rate instead of
@@ -32,7 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from collections import OrderedDict, deque
+from collections import OrderedDict
 
 from ..crypto import Digest, PublicKey, SignatureService
 from ..network import ReliableSender
@@ -67,8 +68,17 @@ class Proposer:
         self.rx_producer = rx_producer
         self.rx_message = rx_message
         self.tx_loopback = tx_loopback
-        self.pending: deque[Digest] = deque()
+        # FIFO with O(1) membership/removal: committed payloads are
+        # pruned by digest on every commit (Core._commit cleanup).
+        self.pending: OrderedDict[Digest, None] = OrderedDict()
         self.seen: OrderedDict[Digest, None] = OrderedDict()
+        # Our proposals whose fate is undecided: round -> payloads.
+        # With single-homed clients (node/client.py round-robin) only WE
+        # hold these digests — if the block orphans (a view change built
+        # the chain past it), they must return to the buffer or they are
+        # lost for good.  Resolved by commit signals (cleanup messages
+        # carrying committed_round).
+        self.inflight: dict[Round, tuple] = {}
         self.deferred: ProposerMessage | None = None
         # Highest round a block was actually created for: re-issued Makes
         # for the same round are dropped, so (a) the core may safely
@@ -88,7 +98,7 @@ class Proposer:
         self.seen[digest] = None
         while len(self.seen) > SEEN_CAP:
             self.seen.popitem(last=False)
-        self.pending.append(digest)
+        self.pending[digest] = None
 
     async def _make_block(
         self, round_: Round, qc: QC, tc: TC | None, allow_empty: bool = False
@@ -107,7 +117,11 @@ class Proposer:
         # commit now rather than on the producer's next burst.
         self.last_made_round = round_
         take = min(len(self.pending), MAX_BLOCK_PAYLOADS)
-        payloads = tuple(self.pending.popleft() for _ in range(take))
+        payloads = tuple(
+            self.pending.popitem(last=False)[0] for _ in range(take)
+        )
+        if payloads:
+            self.inflight[round_] = payloads
 
         block = Block(
             qc=qc, tc=tc, author=self.name, round=round_, payloads=payloads
@@ -155,6 +169,33 @@ class Proposer:
             for t in pending:
                 t.cancel()
 
+    def _resolve_inflight(self, message: ProposerMessage) -> None:
+        """Orphan recovery: once the chain is committed through round R,
+        every proposal of ours at round <= R either committed (its
+        payloads are in the accumulated committed sets) or was orphaned
+        by a view change — re-buffer the orphans at the FRONT of the
+        queue (oldest first) so single-homed payloads are never lost."""
+        if not message.committed_round:
+            return
+        for round_ in sorted(
+            (r for r in self.inflight if r <= message.committed_round),
+            reverse=True,  # re-insert newest first so oldest ends up in front
+        ):
+            payloads = self.inflight.pop(round_)
+            orphaned = [
+                d for d in payloads
+                if d not in message.payloads and d not in self.pending
+            ]
+            if orphaned:
+                self.log.info(
+                    "Re-buffering %d payloads from orphaned block %d",
+                    len(orphaned),
+                    round_,
+                )
+            for digest in reversed(orphaned):
+                self.pending[digest] = None
+                self.pending.move_to_end(digest, last=False)
+
     @staticmethod
     async def _ack_stake(handle: asyncio.Future, stake: int) -> int:
         # handle resolves with the peer's ACK; deliver that peer's stake
@@ -201,6 +242,14 @@ class Proposer:
                             and self.deferred.round <= max(message.rounds)
                         ):
                             self.deferred = None
+                        # Cleanup(payloads): these digests committed (in
+                        # anyone's block) — proposing them again would
+                        # waste block capacity on duplicates.  They stay
+                        # in `seen` so a re-delivered copy is not
+                        # re-buffered either.
+                        for digest in message.payloads:
+                            self.pending.pop(digest, None)
+                        self._resolve_inflight(message)
                     msg_task = asyncio.ensure_future(self.rx_message.get())
         finally:
             prod_task.cancel()

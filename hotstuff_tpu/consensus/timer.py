@@ -18,6 +18,12 @@ class Timer:
         self.duration = duration_ms / 1000.0
         self._deadline: float | None = None
 
+    def set_duration_ms(self, duration_ms: float) -> None:
+        """Change the duration used by subsequent resets (the core's
+        exponential view-change backoff drives this); the current
+        deadline is unaffected."""
+        self.duration = duration_ms / 1000.0
+
     def reset(self) -> None:
         self._deadline = asyncio.get_running_loop().time() + self.duration
 

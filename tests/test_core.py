@@ -275,3 +275,57 @@ async def test_wrong_leader_proposal_rejected(tmp_path):
         await asyncio.wait_for(asyncio.shield(listen), timeout=0.6)
     listen.cancel()
     teardown(h)
+
+
+@async_test
+async def test_timeout_backoff_grows_and_resets_on_progress(tmp_path):
+    """Exponential view-change backoff (beyond reference parity): each
+    consecutive local timeout stretches the round timer geometrically
+    (capped); observing a newer QC snaps it back to the base delay."""
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=100)
+    try:
+        core = h.core
+        base = 0.1
+        assert core.timer.duration == base
+        from hotstuff_tpu.consensus.errors import ConsensusError
+
+        async def fire_timer():
+            # as in Core.run: re-firing for the same round raises benign
+            # AuthorityReuse from the aggregator, which the loop logs
+            try:
+                await core._local_timeout_round()
+            except ConsensusError:
+                pass
+
+        for expected_exp in (1, 2, 3):
+            await fire_timer()
+            assert core._timeout_exponent == expected_exp
+            assert core.timer.duration == base * 2**expected_exp
+        # cap: exponent keeps counting but the duration is clamped
+        core._timeout_cap_ms = 500
+        await fire_timer()
+        assert core.timer.duration == 0.5
+        # FIRST TC after progress: retry at base once (a single dead
+        # leader structurally costs two view changes per lap — paying
+        # base + backed-off for it would halve fault throughput)
+        core._advance_round(core.round, via_tc=True)
+        assert core._timeout_exponent == 0
+        assert core.timer.duration == base
+        # CONSECUTIVE TCs (no QC between): keep the backed-off timer —
+        # under a uniformly slow but live network TCs keep forming, and
+        # resetting on every one would pin the timer at base forever
+        await fire_timer()
+        assert core._timeout_exponent == 1
+        core._advance_round(core.round, via_tc=True)
+        assert core._timeout_exponent == 1
+        assert core.timer.duration == base * 2
+        # a QC-driven advance IS progress: backoff and TC streak reset
+        blocks = chain(4)
+        qc = blocks[-1].qc
+        core.round = qc.round  # pretend we stalled at the QC's round
+        core._process_qc(qc)
+        assert core._timeout_exponent == 0
+        assert core._consecutive_tcs == 0
+        assert core.timer.duration == base
+    finally:
+        teardown(h)
