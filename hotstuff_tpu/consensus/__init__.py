@@ -7,7 +7,7 @@ Consensus wiring — reference crate ``consensus/``.
 """
 
 from .aggregator import Aggregator, QCMaker, TCMaker
-from .config import Authority, Committee, Parameters
+from .config import Authority, Committee, CommitteeSchedule, Parameters
 from .consensus import CHANNEL_CAPACITY, Consensus, ConsensusReceiverHandler
 from .core import ConsensusState, Core, ProposerMessage
 from .errors import (
@@ -33,6 +33,7 @@ __all__ = [
     "TCMaker",
     "Authority",
     "Committee",
+    "CommitteeSchedule",
     "Parameters",
     "CHANNEL_CAPACITY",
     "Consensus",

@@ -239,7 +239,9 @@ class Aggregator:
             raise AggregationBounds(f"vote for far-future round {vote.round}")
         # Authority check before any aggregation state is created, so
         # UnknownAuthority rejections cannot leave empty cells behind.
-        stake = self.committee.stake(vote.author)
+        # Epoch seam: stake/quorum come from the VOTE round's committee.
+        com = self.committee.for_round(vote.round)
+        stake = com.stake(vote.author)
         if stake <= 0:
             raise UnknownAuthority(vote.author)
         makers = self.votes_aggregators.setdefault(vote.round, {})
@@ -248,7 +250,7 @@ class Aggregator:
         created = maker is None
         if created:
             maker = self._admit_cell(vote, digest, makers)
-        qc = maker.append(vote, self.committee, self.verifier, stake=stake)
+        qc = maker.append(vote, com, self.verifier, stake=stake)
         if created and maker.protected:
             qc = self._replay_parked(vote.round, digest, maker) or qc
         return qc
@@ -268,7 +270,9 @@ class Aggregator:
         for author in [a for a, v in parked.items() if v.digest() == digest]:
             vote = parked.pop(author)
             try:
-                got = maker.append(vote, self.committee, self.verifier)
+                got = maker.append(
+                    vote, self.committee.for_round(round_), self.verifier
+                )
             except ConsensusError:
                 continue
             qc = got or qc
@@ -372,7 +376,9 @@ class Aggregator:
                 f"timeout for far-future round {timeout.round}"
             )
         maker = self.timeouts_aggregators.setdefault(timeout.round, TCMaker())
-        return maker.append(timeout, self.committee)
+        return maker.append(
+            timeout, self.committee.for_round(timeout.round)
+        )
 
     def cleanup(self, round_: Round) -> None:
         self.votes_aggregators = {

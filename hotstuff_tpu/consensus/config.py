@@ -156,6 +156,14 @@ class Committee:
                     f"invalid BLS proof of possession for {pk}"
                 )
 
+    def for_round(self, round_: int) -> "Committee":
+        """Committee in effect for ``round_``.  A bare Committee is a
+        one-epoch schedule: every round maps to itself.  This is the
+        seam that makes every verification/election call site epoch-
+        aware for free — ``CommitteeSchedule`` implements the same
+        method with a real lookup."""
+        return self
+
     def size(self) -> int:
         return len(self.authorities)
 
@@ -228,3 +236,146 @@ class Committee:
             epoch=int(data.get("epoch", 1)),
             scheme=data.get("scheme", "ed25519"),
         )
+
+
+class CommitteeSchedule:
+    """Epoch reconfiguration: committees keyed by activation round.
+
+    BEYOND reference parity (the reference has no reconfiguration at
+    all): a schedule maps round ranges to committees — rounds in
+    [from_round_i, from_round_{i+1}) run under committee i.  Everything
+    that verifies a certificate, elects a leader, or checks stake asks
+    ``for_round(r)``, so certificates formed under epoch e verify under
+    epoch e's committee forever (a block at the boundary carries a QC
+    from the previous epoch — each is checked against its own round's
+    validator set).  A bare ``Committee`` implements the same
+    ``for_round`` as a one-epoch schedule, so all single-epoch call
+    sites are unchanged.
+
+    The handoff itself needs no extra protocol: votes for the last
+    round of epoch e route to the leader of round+1 — an epoch-e+1
+    member — exactly like any other round; it assembles the QC and
+    proposes.  Members only of older epochs simply stop being elected
+    or counted.
+    """
+
+    def __init__(self, entries: list[tuple[int, Committee]]):
+        if not entries:
+            raise InvalidCommittee("empty committee schedule")
+        entries = sorted(entries, key=lambda e: e[0])
+        if entries[0][0] > 1:
+            raise InvalidCommittee(
+                "schedule must cover round 1 (first from_round > 1)"
+            )
+        froms = [f for f, _ in entries]
+        if len(set(froms)) != len(froms):
+            raise InvalidCommittee("duplicate from_round in schedule")
+        self.entries: list[tuple[int, Committee]] = entries
+
+    # ---- the epoch seam ----------------------------------------------------
+
+    def for_round(self, round_: int) -> Committee:
+        current = self.entries[0][1]
+        for from_round, committee in self.entries:
+            if round_ >= from_round:
+                current = committee
+            else:
+                break
+        return current
+
+    # ---- union views (round-less call sites) -------------------------------
+
+    def committees(self) -> list[Committee]:
+        return [c for _, c in self.entries]
+
+    def address(self, name: PublicKey) -> Address | None:
+        """A member's address, from the NEWEST epoch that knows it
+        (members keep one address across epochs in practice; newest wins
+        if they move)."""
+        for _, committee in reversed(self.entries):
+            addr = committee.address(name)
+            if addr is not None:
+                return addr
+        return None
+
+    def broadcast_addresses(
+        self, myself: PublicKey
+    ) -> list[tuple[PublicKey, Address]]:
+        """Union of every epoch's members except us (sync retries and
+        boundary-crossing certificates must be able to reach members of
+        adjacent epochs), deduplicated by key."""
+        seen: dict[PublicKey, Address] = {}
+        for _, committee in self.entries:
+            for name, auth in committee.authorities.items():
+                if name != myself:
+                    seen[name] = auth.address
+        return list(seen.items())
+
+    def stake(self, name: PublicKey) -> int:
+        """Round-less stake checks should not exist for schedules —
+        kept for duck-type compatibility: the stake in the newest epoch
+        that knows the member."""
+        for _, committee in reversed(self.entries):
+            if name in committee.authorities:
+                return committee.stake(name)
+        return 0
+
+    @property
+    def authorities(self) -> dict[PublicKey, Authority]:
+        """Union membership across epochs (newest epoch wins per key) —
+        round-less duck-type surface for kernel warmup, clients feeding
+        the committee, and diagnostics."""
+        merged: dict[PublicKey, Authority] = {}
+        for _, committee in self.entries:
+            merged.update(committee.authorities)
+        return merged
+
+    @property
+    def scheme(self) -> str:
+        """The committee-wide signature scheme when it is uniform across
+        every epoch; mixed schedules raise — per-round dispatch must use
+        ``for_round(r).scheme`` and the wire decode must accept the
+        union (wire_scheme())."""
+        schemes = {c.scheme for c in self.committees()}
+        if len(schemes) == 1:
+            return next(iter(schemes))
+        raise InvalidCommittee(
+            "schedule mixes signature schemes; use for_round(r).scheme"
+        )
+
+    def wire_scheme(self) -> str | None:
+        """The scheme to narrow wire decode to: the uniform scheme, or
+        None (accept the union) for mixed-scheme schedules."""
+        schemes = {c.scheme for c in self.committees()}
+        return next(iter(schemes)) if len(schemes) == 1 else None
+
+    def verify_pops(self) -> None:
+        for _, committee in self.entries:
+            committee.verify_pops()
+
+    # ---- JSON --------------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "schedule": [
+                {"from_round": from_round, **committee.to_json()}
+                for from_round, committee in self.entries
+            ]
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "CommitteeSchedule":
+        return cls(
+            [
+                (int(entry["from_round"]), Committee.from_json(entry))
+                for entry in data["schedule"]
+            ]
+        )
+
+
+def committee_from_json(data: dict):
+    """Polymorphic committee-file payload: a plain Committee or a
+    CommitteeSchedule (``schedule`` key)."""
+    if "schedule" in data:
+        return CommitteeSchedule.from_json(data)
+    return Committee.from_json(data)

@@ -154,7 +154,12 @@ class Consensus:
             address[1],
             ConsensusReceiverHandler(
                 tx_consensus, tx_helper, tx_producer,
-                scheme=committee.scheme,
+                # mixed-scheme schedules accept the union on the wire
+                scheme=(
+                    committee.wire_scheme()
+                    if hasattr(committee, "wire_scheme")
+                    else committee.scheme
+                ),
             ),
         )
         await self.receiver.spawn()

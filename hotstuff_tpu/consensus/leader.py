@@ -1,8 +1,10 @@
 """Leader election.
 
 Parity target: ``RRLeaderElector`` (reference consensus/src/leader.rs:5-21):
-round-robin over the sorted committee public keys. The sorted key list is
-computed once (the reference re-sorts per call; the committee is immutable
+round-robin over the sorted committee public keys.  Epoch-aware: the
+election asks ``for_round`` so a ``CommitteeSchedule`` rotates the
+validator set at its boundaries; sorted key lists are cached per epoch
+committee (the reference re-sorts per call; a committee is immutable
 within an epoch).
 """
 
@@ -15,10 +17,16 @@ from .messages import Round
 
 class RoundRobinLeaderElector:
     def __init__(self, committee: Committee):
-        self._keys: list[PublicKey] = committee.sorted_keys()
+        self._committee = committee
+        self._keys_cache: dict[int, list[PublicKey]] = {}
 
     def get_leader(self, round_: Round) -> PublicKey:
-        return self._keys[round_ % len(self._keys)]
+        com = self._committee.for_round(round_)
+        keys = self._keys_cache.get(id(com))
+        if keys is None:
+            keys = com.sorted_keys()
+            self._keys_cache[id(com)] = keys
+        return keys[round_ % len(keys)]
 
 
 LeaderElector = RoundRobinLeaderElector

@@ -163,6 +163,7 @@ class QC:
             key = self._cache_key()
             if key in cache:
                 return
+        committee = committee.for_round(self.round)  # epoch seam
         _check_certificate_weight(
             [pk for pk, _ in self.votes], committee, QCRequiresQuorum
         )
@@ -214,6 +215,7 @@ class TC:
         return [r for _, _, r in self.votes]
 
     def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+        committee = committee.for_round(self.round)  # epoch seam
         _check_certificate_weight(
             [pk for pk, _, _ in self.votes], committee, TCRequiresQuorum
         )
@@ -316,16 +318,24 @@ class Block:
         verifier: VerifierBackend,
         qc_cache: set | None = None,
     ) -> None:
-        if committee.stake(self.author) <= 0:
+        # Epoch seam: the author is judged by the block round's
+        # committee; each embedded certificate by ITS round's committee
+        # (at an epoch boundary the first new-epoch block carries a QC
+        # formed by the previous epoch's validators).  for_round is the
+        # identity on a bare Committee.
+        com = committee.for_round(self.round)
+        if com.stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
         if len(self.payloads) > MAX_BLOCK_PAYLOADS:
             raise MalformedBlock(self.digest())
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad author signature on block {self}")
         if not self.qc.is_genesis():
-            self.qc.verify(committee, verifier, cache=qc_cache)
+            self.qc.verify(
+                committee.for_round(self.qc.round), verifier, cache=qc_cache
+            )
         if self.tc is not None:
-            self.tc.verify(committee, verifier)
+            self.tc.verify(committee.for_round(self.tc.round), verifier)
 
     def encode(self, enc: Encoder) -> None:
         self.qc.encode(enc)
@@ -401,7 +411,7 @@ class Vote:
         return d
 
     def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
-        if committee.stake(self.author) <= 0:
+        if committee.for_round(self.round).stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad signature on vote {self}")
@@ -442,12 +452,17 @@ class Timeout:
         verifier: VerifierBackend,
         qc_cache: set | None = None,
     ) -> None:
-        if committee.stake(self.author) <= 0:
+        if committee.for_round(self.round).stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad signature on timeout {self}")
         if not self.high_qc.is_genesis():
-            self.high_qc.verify(committee, verifier, cache=qc_cache)
+            # the embedded QC belongs to ITS round's epoch
+            self.high_qc.verify(
+                committee.for_round(self.high_qc.round),
+                verifier,
+                cache=qc_cache,
+            )
 
     def encode(self, enc: Encoder) -> None:
         self.high_qc.encode(enc)

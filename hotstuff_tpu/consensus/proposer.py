@@ -79,6 +79,11 @@ class Proposer:
         # lost for good.  Resolved by commit signals (cleanup messages
         # carrying committed_round).
         self.inflight: dict[Round, tuple] = {}
+        # Recently COMMITTED digests (bounded LRU): orphan recovery must
+        # not re-buffer a payload that committed in an EARLIER walk via
+        # another node's block (multi-homed producers) — the per-walk
+        # payload set alone cannot show that.
+        self.committed_seen: OrderedDict[Digest, None] = OrderedDict()
         self.deferred: ProposerMessage | None = None
         # Highest round a block was actually created for: re-issued Makes
         # for the same round are dropped, so (a) the core may safely
@@ -139,6 +144,11 @@ class Proposer:
             block.digest(),
         )
 
+        # Broadcast to the union of epochs (committee.broadcast_addresses
+        # is the union on a CommitteeSchedule — members of the adjacent
+        # epoch need boundary blocks too); ACK stake counts only under
+        # the BLOCK round's committee.
+        com = self.committee.for_round(round_)
         names_addresses = self.committee.broadcast_addresses(self.name)
         message = encode_propose(block)
         handles = [
@@ -150,11 +160,11 @@ class Proposer:
 
         # Control system: wait for 2f+1 total stake (ours included) to ACK
         # the block before making the next one.
-        total_stake = self.committee.stake(self.name)
-        threshold = self.committee.quorum_threshold()
+        total_stake = com.stake(self.name)
+        threshold = com.quorum_threshold()
         pending = {
             asyncio.ensure_future(
-                self._ack_stake(handle, self.committee.stake(name))
+                self._ack_stake(handle, com.stake(name))
             )
             for name, handle in handles
         }
@@ -184,7 +194,9 @@ class Proposer:
             payloads = self.inflight.pop(round_)
             orphaned = [
                 d for d in payloads
-                if d not in message.payloads and d not in self.pending
+                if d not in message.payloads
+                and d not in self.committed_seen
+                and d not in self.pending
             ]
             if orphaned:
                 self.log.info(
@@ -249,6 +261,9 @@ class Proposer:
                         # re-buffered either.
                         for digest in message.payloads:
                             self.pending.pop(digest, None)
+                            self.committed_seen[digest] = None
+                        while len(self.committed_seen) > SEEN_CAP:
+                            self.committed_seen.popitem(last=False)
                         self._resolve_inflight(message)
                     msg_task = asyncio.ensure_future(self.rx_message.get())
         finally:

@@ -150,3 +150,54 @@ def make_device_verifier(scheme: str, kind: str) -> VerifierBackend:
         "ed25519 device verifiers are constructed by node.make_verifier "
         "(lazy-import hybrid)"
     )
+
+
+class DualSchemeVerifier:
+    """Verifier for mixed-scheme CommitteeSchedules (a scheme changeover
+    across an epoch boundary): routes each check to the per-scheme
+    backend by key wire size (32 = ed25519, 96 = BLS compressed G2).
+
+    One certificate never mixes schemes (a committee is single-scheme
+    and authority/stake checks against the round's committee run before
+    signatures), so routing by the first key is sound; a hostile
+    mixed-material certificate simply fails verification in whichever
+    backend it lands."""
+
+    name = "dual"
+
+    def __init__(self, backends: dict[str, "VerifierBackend"]):
+        self.backends = backends
+
+    def _route(self, pk_bytes: bytes) -> "VerifierBackend":
+        return self.backends["bls" if len(pk_bytes) == 96 else "ed25519"]
+
+    def verify_one(self, digest, pk, sig) -> bool:
+        return self._route(pk.data).verify_one(digest, pk, sig)
+
+    def verify_shared_msg(self, digest, votes) -> bool:
+        if not votes:
+            return False
+        return self._route(votes[0][0].data).verify_shared_msg(digest, votes)
+
+    def verify_many(self, digests, pks, sigs) -> list[bool]:
+        if not pks:
+            return []
+        return self._route(pks[0]).verify_many(digests, pks, sigs)
+
+    # boot-time hooks forwarded so device backends still warm up
+    def precompute(self, pubkeys: list[bytes]) -> None:
+        for pk in pubkeys:
+            backend = self._route(pk)
+            if hasattr(backend, "precompute"):
+                backend.precompute([pk])
+
+    def warmup(self, batch: int | None = None) -> None:
+        for backend in self.backends.values():
+            if hasattr(backend, "warmup"):
+                backend.warmup(batch)
+
+
+def make_dual_verifier(make_one) -> DualSchemeVerifier:
+    """Compose a mixed-scheme verifier from per-scheme factories
+    (``make_one(scheme) -> VerifierBackend``)."""
+    return DualSchemeVerifier({s: make_one(s) for s in SCHEMES})

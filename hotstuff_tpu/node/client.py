@@ -260,14 +260,15 @@ async def run_client(
             # slot unique; orphaned proposals are re-buffered by the
             # proposer (orphan recovery), so single-homing is safe.
             live = [c for c in conns if c.alive]
-            for i in range(burst):
+            # with zero live peers nothing is transmitted: neither the
+            # sent counter nor the sample log line may claim otherwise
+            # (the harness counts both)
+            for i in range(burst if live else 0):
                 digest = Digest.random()
                 if i == 0:
                     # NOTE: this log entry is used to compute performance.
                     log.info("Sending sample payload %s", digest)
-                message = encode_producer(digest)
-                if live:
-                    live[sent % len(live)].send_frame(message)
+                live[sent % len(live)].send_frame(encode_producer(digest))
                 sent += 1
             for c in conns:
                 await c.drain()

@@ -97,12 +97,19 @@ class Secret:
 
 
 def write_committee(committee: Committee, path: str) -> None:
+    """Accepts a Committee or a CommitteeSchedule (epoch handoff) —
+    both carry their own to_json shape."""
     _write_json(path, {"consensus": committee.to_json()})
 
 
 def read_committee(path: str) -> Committee:
+    """Returns a Committee, or a CommitteeSchedule when the file holds
+    one (a ``schedule`` key) — callers use them interchangeably via the
+    for_round seam."""
+    from ..consensus.config import committee_from_json
+
     data = _read_json(path)
-    return Committee.from_json(data.get("consensus", data))
+    return committee_from_json(data.get("consensus", data))
 
 
 def write_parameters(parameters: Parameters, path: str) -> None:

@@ -120,18 +120,51 @@ class Node:
         self = cls()
         committee = read_committee(committee_file)
         secret = Secret.read(key_file)
-        if secret.scheme != committee.scheme:
-            raise ConfigError(
-                f"key file scheme '{secret.scheme}' does not match the "
-                f"committee scheme '{committee.scheme}'"
-            )
+        schemes = (
+            {c.scheme for c in committee.committees()}
+            if hasattr(committee, "committees")  # CommitteeSchedule
+            else {committee.scheme}
+        )
+        if len(schemes) == 1:
+            if secret.scheme != next(iter(schemes)):
+                raise ConfigError(
+                    f"key file scheme '{secret.scheme}' does not match the "
+                    f"committee scheme '{next(iter(schemes))}'"
+                )
+        else:
+            # Mixed-scheme schedule (scheme changeover at an epoch
+            # boundary): identities are per-scheme — this node signs
+            # under its own key's scheme and must be a member of at
+            # least one epoch using it; verification must handle BOTH
+            # schemes (old-epoch certificates keep verifying after the
+            # changeover), so the verifier is the dual router.
+            my_epochs = [
+                c for c in committee.committees()
+                if secret.name in c.authorities
+            ]
+            if not my_epochs:
+                raise ConfigError(
+                    "key is not a member of any epoch in the schedule"
+                )
+            if any(c.scheme != secret.scheme for c in my_epochs):
+                raise ConfigError(
+                    f"key file scheme '{secret.scheme}' does not match an "
+                    "epoch this key belongs to"
+                )
         parameters = (
             read_parameters(parameters_file) if parameters_file else Parameters()
         )
 
         self.store = Store(store_path)
         signature_service = make_signing_service(secret.scheme, secret.secret)
-        verifier = make_verifier(verifier_backend, committee.scheme)
+        if len(schemes) == 1:
+            verifier = make_verifier(verifier_backend, next(iter(schemes)))
+        else:
+            from ..crypto.scheme import make_dual_verifier
+
+            verifier = make_dual_verifier(
+                lambda s: make_verifier(verifier_backend, s)
+            )
         if hasattr(verifier, "precompute"):
             # warm the TPU backend's committee point cache (epoch setup)
             verifier.precompute(
