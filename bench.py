@@ -87,7 +87,10 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     _kernel, staged = _stage(verifier, msgs, pks, sigs)
 
     # throughput: FIFO dispatch stream, clock stopped by a full fetch of
-    # the last result (the only sync the tunnel can't fake)
+    # the last result (the only sync the tunnel can't fake).  On this
+    # rig the stream is TUNNEL-bound (per-dispatch enqueue ~4-10 ms >>
+    # the ~2 ms kernel), so this is the honest end-to-end rate of THIS
+    # rig; the co-located device rate is device_sigs_per_s below.
     t0 = time.perf_counter()
     outs = [_kernel(*staged) for _ in range(ROUNDS)]
     final = np.asarray(outs[-1])
@@ -98,9 +101,9 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
     # QC-verify latency, two views per QC-shaped size:
     # - rig_p50/p99_ms: dispatch + full result fetch (includes the
     #   development tunnel's ~100 ms round-trip — what THIS rig sees);
-    # - device_ms: dispatch-slope estimate ((T32 - T8) / 24 over chained
-    #   dispatch streams), which cancels fixed per-stream overhead and
-    #   estimates the co-located per-QC device time.
+    # - device_ms: dispatch-slope estimate over chained dispatch
+    #   streams, which cancels fixed per-stream overhead and estimates
+    #   the co-located per-QC device time.
     latencies: dict = {}
     for qc_size in (16, 64, 256):
         qc_kernel, sub = _stage(
@@ -114,19 +117,77 @@ def bench_tpu(msgs, pks, sigs) -> tuple[float, dict]:
             times.append(time.perf_counter() - t0)
             assert ok.all()
         times.sort()
-        totals = {}
-        for n in (8, 32):
-            t0 = time.perf_counter()
-            for _ in range(n):
-                out = qc_kernel(*sub)
-            np.asarray(out)
-            totals[n] = time.perf_counter() - t0
         latencies[str(qc_size)] = {
             "rig_p50_ms": round(times[len(times) // 2] * 1e3, 3),
             "rig_p99_ms": round(times[-1] * 1e3, 3),
-            "device_ms": round((totals[32] - totals[8]) / 24 * 1e3, 3),
+            "device_ms": _device_slope_ms(qc_kernel, sub),
         }
-    return tput, latencies
+
+    # co-located device rate: batch-1024 kernel time via the in-dispatch
+    # loop slope (the dispatch-stream tput above is tunnel-bound)
+    device_ms_1024 = _device_slope_ms(_kernel, staged)
+    device_rate = round(BATCH / (device_ms_1024 / 1e3)) if device_ms_1024 > 0 else None
+    return tput, latencies, {
+        "batch": BATCH,
+        "device_ms": device_ms_1024,
+        "device_sigs_per_s": device_rate,
+    }
+
+
+def _device_slope_ms(kernel, staged) -> float:
+    """In-dispatch loop slope: the per-call DEVICE time measured by
+    running the kernel N times inside ONE dispatch (lax.fori_loop with a
+    data-dependent carry — rolling the scalar windows each iteration
+    defeats CSE/hoisting and forces sequential execution) and taking
+    (T_long - T_short) / (long - short) over single dispatches.
+
+    Why not chained host dispatches (r2's method): once the kernel
+    dropped under ~2 ms the chain became TUNNEL-bound — the dev rig's
+    per-dispatch enqueue cost (~4-10 ms, load-dependent) swamps the
+    device time entirely and the 'slope' measures tunnel weather
+    (observed: 0.7 ms and 4.5 ms for the SAME compiled shape in
+    back-to-back runs).  One dispatch per sample amortizes the tunnel
+    out of the slope."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def make(n):
+        @jax.jit
+        def run(args):
+            def body(_i, carry):
+                acc, s = carry
+                out = kernel(
+                    args[0], args[1], args[2], args[3],
+                    s, args[5], args[6], args[7],
+                )
+                return (
+                    acc + jnp.sum(out.astype(jnp.int32)),
+                    jnp.roll(s, 1, axis=-1),
+                )
+            acc, _ = jax.lax.fori_loop(
+                0, n, body, (jnp.int32(0), args[4])
+            )
+            return acc
+        return run
+
+    # 132 iterations of slope: the tunnel's ±15 ms single-dispatch RTT
+    # variance divides down to ±0.11 ms — adequate for sub-ms kernels
+    short, long = 4, 136
+    run_short, run_long = make(short), make(long)
+    np.asarray(run_short(staged))  # warm both loop shapes
+    np.asarray(run_long(staged))
+    slopes = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(run_short(staged))
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(run_long(staged))
+        t_long = time.perf_counter() - t0
+        slopes.append((t_long - t_short) / (long - short))
+    slopes.sort()
+    return round(slopes[len(slopes) // 2] * 1e3, 3)
 
 
 def make_tc_batch(n: int):
@@ -148,8 +209,9 @@ def make_tc_batch(n: int):
 
 def bench_tc(verifier) -> dict:
     """TC-verify latency at the 256-committee storm quorum (171 distinct
-    digests): p50/p99 of dispatch + full fetch, same methodology as the
-    QC latencies."""
+    digests): p50/p99 of dispatch + full fetch, plus the device-slope
+    line (VERDICT r2 weak #3 — the raw rig p50 is tunnel-RTT-dominated,
+    so the TC kernel's actual device cost was unmeasured)."""
     import numpy as np
 
     n = 2 * 256 // 3 + 1  # 171
@@ -168,6 +230,7 @@ def bench_tc(verifier) -> dict:
         "quorum": n,
         "rig_p50_ms": round(times[len(times) // 2] * 1e3, 3),
         "rig_p99_ms": round(times[-1] * 1e3, 3),
+        "device_ms": _device_slope_ms(kernel, staged),
     }
 
 
@@ -192,7 +255,7 @@ def main() -> int:
     msgs, pks, sigs = make_qc_batch(BATCH)
     platform = jax.devices()[0].platform
 
-    tpu_tput, qc_latency = bench_tpu(msgs, pks, sigs)
+    tpu_tput, qc_latency, device_tput = bench_tpu(msgs, pks, sigs)
     cpu_tput = bench_cpu(msgs, pks, sigs)
 
     from hotstuff_tpu.tpu.ed25519 import BatchVerifier
@@ -206,6 +269,7 @@ def main() -> int:
                 "value": round(tpu_tput),
                 "unit": "sigs/s",
                 "vs_baseline": round(tpu_tput / cpu_tput, 3),
+                "device_throughput": device_tput,
                 "qc_verify_ms": qc_latency,
                 "tc_verify_ms": tc_latency,
             }

@@ -164,6 +164,14 @@ class Committee:
         method with a real lookup."""
         return self
 
+    # one-epoch-schedule views (the CommitteeSchedule interface; call
+    # sites must never need hasattr checks to handle either type)
+    def committees(self) -> list["Committee"]:
+        return [self]
+
+    def wire_scheme(self) -> str | None:
+        return self.scheme
+
     def size(self) -> int:
         return len(self.authorities)
 

@@ -155,11 +155,7 @@ class Consensus:
             ConsensusReceiverHandler(
                 tx_consensus, tx_helper, tx_producer,
                 # mixed-scheme schedules accept the union on the wire
-                scheme=(
-                    committee.wire_scheme()
-                    if hasattr(committee, "wire_scheme")
-                    else committee.scheme
-                ),
+                scheme=committee.wire_scheme(),
             ),
         )
         await self.receiver.spawn()

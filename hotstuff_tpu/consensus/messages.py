@@ -319,10 +319,11 @@ class Block:
         qc_cache: set | None = None,
     ) -> None:
         # Epoch seam: the author is judged by the block round's
-        # committee; each embedded certificate by ITS round's committee
-        # (at an epoch boundary the first new-epoch block carries a QC
-        # formed by the previous epoch's validators).  for_round is the
-        # identity on a bare Committee.
+        # committee; each embedded certificate routes ITSELF to its own
+        # round's committee inside QC.verify/TC.verify (at an epoch
+        # boundary the first new-epoch block carries a QC formed by the
+        # previous epoch's validators).  for_round is the identity on a
+        # bare Committee.
         com = committee.for_round(self.round)
         if com.stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
@@ -331,11 +332,9 @@ class Block:
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad author signature on block {self}")
         if not self.qc.is_genesis():
-            self.qc.verify(
-                committee.for_round(self.qc.round), verifier, cache=qc_cache
-            )
+            self.qc.verify(committee, verifier, cache=qc_cache)
         if self.tc is not None:
-            self.tc.verify(committee.for_round(self.tc.round), verifier)
+            self.tc.verify(committee, verifier)
 
     def encode(self, enc: Encoder) -> None:
         self.qc.encode(enc)
@@ -457,12 +456,8 @@ class Timeout:
         if not verifier.verify_one(self.digest(), self.author, self.signature):
             raise InvalidSignature(f"bad signature on timeout {self}")
         if not self.high_qc.is_genesis():
-            # the embedded QC belongs to ITS round's epoch
-            self.high_qc.verify(
-                committee.for_round(self.high_qc.round),
-                verifier,
-                cache=qc_cache,
-            )
+            # QC.verify routes itself to its own round's committee
+            self.high_qc.verify(committee, verifier, cache=qc_cache)
 
     def encode(self, enc: Encoder) -> None:
         self.high_qc.encode(enc)

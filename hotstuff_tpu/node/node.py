@@ -14,6 +14,7 @@ from BASELINE.json.
 from __future__ import annotations
 
 import asyncio
+import os
 import logging
 
 from ..consensus import Consensus, Parameters
@@ -120,11 +121,7 @@ class Node:
         self = cls()
         committee = read_committee(committee_file)
         secret = Secret.read(key_file)
-        schemes = (
-            {c.scheme for c in committee.committees()}
-            if hasattr(committee, "committees")  # CommitteeSchedule
-            else {committee.scheme}
-        )
+        schemes = {c.scheme for c in committee.committees()}
         if len(schemes) == 1:
             if secret.scheme != next(iter(schemes)):
                 raise ConfigError(
@@ -183,6 +180,19 @@ class Node:
             # hybrid path anyway — then the kernel is never dispatched.
             verifier.warmup(batch=committee_size)
 
+        stats_task = None
+        if os.environ.get("HOTSTUFF_WORK_STATS"):
+            # per-node work accounting for the committee-scaling
+            # decomposition (utils/workstats.py): counted verifier +
+            # loop-lag probe, one parseable log line every few seconds
+            from ..utils.workstats import CountingVerifier, WorkStats, run_probe
+
+            stats = WorkStats()
+            verifier = CountingVerifier(verifier, stats)
+            stats_task = asyncio.ensure_future(
+                run_probe(stats, logging.getLogger(f"workstats.{secret.name}"))
+            )
+
         self.commit = asyncio.Queue(maxsize=self.CHANNEL_CAPACITY)
         self.consensus = await Consensus.spawn(
             secret.name,
@@ -195,6 +205,7 @@ class Node:
             bind_host=bind_host,
             transport=transport,
         )
+        self._stats_task = stats_task
         log.info("Node %s successfully booted", secret.name)
         return self
 
@@ -206,6 +217,9 @@ class Node:
             # Here the application would execute the committed payload.
 
     async def shutdown(self) -> None:
+        stats_task = getattr(self, "_stats_task", None)
+        if stats_task is not None:
+            stats_task.cancel()
         if self.consensus is not None:
             await self.consensus.shutdown()
         if self.store is not None:

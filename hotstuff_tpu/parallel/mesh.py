@@ -56,14 +56,15 @@ def _local_verify(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
 
 
 def _local_verify_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
-    """Per-shard dispatch of the VMEM-resident Pallas scan (the ~5x
-    single-chip winner over the XLA path) — each device runs the fused
-    kernel on its slice; per-shard batch must be a multiple of
-    pallas_dsm.LANE_TILE (the verifier's pad grid guarantees it)."""
+    """Per-shard dispatch of the fully fused Pallas verify (scan +
+    in-VMEM compressed-equality epilogue) — each device runs it on its
+    slice; per-shard batch must be a multiple of pallas_dsm.LANE_TILE
+    (the verifier's pad grid guarantees it)."""
     from ..tpu import pallas_dsm
 
-    p = pallas_dsm.dual_scalar_mult(s_bits, k_bits, (ax, ay, az, at))
-    return curve.compressed_equals(p, r_y, r_sign)
+    return pallas_dsm.verify_compressed(
+        s_bits, k_bits, (ax, ay, az, at), r_y, r_sign
+    )
 
 
 def make_sharded_verify(mesh: Mesh, pallas: bool = False):

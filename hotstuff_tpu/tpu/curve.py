@@ -67,10 +67,6 @@ def _base_table(window: int, base: "ref.Point" = ref.B_POINT) -> np.ndarray:
 # measured ~8% off whole-kernel latency.
 B_WINDOW = 8
 B_TABLE8 = _base_table(B_WINDOW)
-# Table for 2^128*B — the split-scalar kernel (pallas_dsm) processes
-# each scalar as two 128-bit halves: [s]B = [s_hi](2^128 B) + [s_lo]B.
-B128_POINT = ref.point_mul(1 << 128, ref.B_POINT)
-B128_TABLE8 = _base_table(B_WINDOW, base=B128_POINT)
 
 
 def identity(shape_like) -> Point:

@@ -59,37 +59,23 @@ def _verify_kernel(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
 
 @partial(jax.jit, static_argnames=())
 def _verify_kernel_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
-    """Same contract as _verify_kernel, with the double-scalar
-    multiplication running in the VMEM-resident Pallas kernel
-    (tpu/pallas_dsm.py).  TPU backend only; batch must be a multiple of
-    pallas_dsm.LANE_TILE (the pad sizes guarantee it)."""
+    """Same contract as _verify_kernel, with the WHOLE verification —
+    double-scalar multiplication AND the compressed-equality epilogue —
+    fused into one VMEM-resident Pallas dispatch (tpu/pallas_dsm.py;
+    the XLA epilogue was ~2 ms of sequential HBM round-trips).  TPU
+    backend only; batch must be a multiple of pallas_dsm.LANE_TILE (the
+    pad sizes guarantee it)."""
     from . import pallas_dsm
 
-    p = pallas_dsm.dual_scalar_mult(s_bits, k_bits, (ax, ay, az, at))
-    return curve.compressed_equals(p, r_y, r_sign)
-
-
-@partial(jax.jit, static_argnames=())
-def _verify_kernel_pallas_split(
-    ax, ay, az, at, s_bits, k_bits, base_off, r_y, r_sign
-):
-    """Split-scalar variant for QC-sized batches: operand rows are the
-    two 128-bit halves of each signature's scalars (prepare_split lays
-    them out per tile), the Pallas scan runs 16 macro steps instead of
-    32, and the halves recombine in-kernel — ~2x lower latency for
-    batches of <= BT/2 signatures."""
-    from . import pallas_dsm
-
-    p = pallas_dsm.dual_scalar_mult_split(
-        s_bits, k_bits, (ax, ay, az, at), base_off
+    return pallas_dsm.verify_compressed(
+        s_bits, k_bits, (ax, ay, az, at), r_y, r_sign
     )
-    return curve.compressed_equals(p, r_y, r_sign)
 
 
 # Pallas pad shapes: lane-aligned, capped at 1024 per dispatch (larger
 # batches chunk; each new shape costs a multi-minute Mosaic compile,
 # amortized by the persistent compilation cache).
-PALLAS_PAD_SIZES = (256, 1024)
+PALLAS_PAD_SIZES = (128, 256, 1024)
 
 
 def _bytes_to_limbs(b: bytes, lo_bits: int = 255) -> np.ndarray:
@@ -109,8 +95,7 @@ _WIN_WEIGHTS = (1 << np.arange(curve.WINDOW - 1, -1, -1)).astype(np.int32)
 
 def _bytes_to_windows_msb(rows: np.ndarray) -> np.ndarray:
     """[n, W] little-endian scalar bytes -> [n, 2W] MSB-first 4-bit
-    windows (W = 32 for full scalars < L < 2^253, W = 16 for the split
-    kernel's 128-bit halves)."""
+    windows (W = 32 for full scalars < L < 2^253)."""
     bits = np.unpackbits(rows[:, ::-1], axis=1, bitorder="big").astype(np.int32)
     nwin = rows.shape[1] * 8 // curve.WINDOW
     groups = bits.reshape(rows.shape[0], nwin, curve.WINDOW)
@@ -142,8 +127,6 @@ class BatchVerifier:
     def __init__(self, min_device_batch: int = 64, use_pallas: bool | None = None):
         # pk bytes -> (ax, ay, az, at) limb rows of the negated point, or None
         self._point_cache: dict[bytes, tuple | None] = {}
-        # pk bytes -> limb rows of -(2^128 * A) (split-kernel hi halves)
-        self._point128_cache: dict[bytes, tuple | None] = {}
         # The Pallas VMEM-resident kernel is the fast path on real TPU
         # hardware; the XLA kernel is the portable fallback (CPU tests,
         # sharded-mesh subclass).  use_pallas=None defers autodetection
@@ -178,12 +161,9 @@ class BatchVerifier:
 
     def precompute(self, pubkeys: list[bytes]) -> None:
         """Decompress + negate committee keys ahead of time (epoch
-        setup) — including the split kernel's 2^128-multiples, so the
-        ~128 host point-doublings per member never land inside a QC
-        verify."""
+        setup) so no point decompression lands inside a QC verify."""
         for pk in pubkeys:
             self._neg_point(pk)
-            self._neg_point128(pk)
 
     def warmup(self, batch: int | None = None) -> None:
         """Compile (or cache-load) the device kernel BEFORE entering the
@@ -203,14 +183,18 @@ class BatchVerifier:
         pk = ref.public_from_seed(seed)
         sig = ref.sign(seed, msg)
         n = max(batch or 0, self.min_device_batch, 1)  # force device path
-        sizes = {n}
-        if self.use_pallas and n > self.SPLIT_MAX:
-            # production dispatches BOTH kernels for such a committee:
-            # QCs (2f+1 votes) may be <= SPLIT_MAX and route to the
-            # split kernel while committee-sized batches use the
-            # standard one — warm both shapes
-            sizes.add(max(self.min_device_batch, 1))
-        for size in sorted(sizes):
+        # Warm EVERY pad shape a production batch can land on: QCs are
+        # 2f+1 <= committee size, so any pad size at or below the
+        # committee's own pad is reachable (e.g. committee 150 pads to
+        # 256, but its 101-vote QCs pad to 128 — leaving 128 cold would
+        # put a multi-minute Mosaic compile inside the consensus hot
+        # path, exactly what this warmup exists to prevent).
+        grid = self._padded_sizes()
+        ceiling = next((p for p in grid if n <= p), grid[-1])
+        floor = max(self.min_device_batch, 1)  # smaller pads never reach
+        # the device (the hybrid routing sends those batches to the CPU)
+        sizes = [p for p in grid if floor <= p <= ceiling] or [n]
+        for size in sizes:
             out = self.verify([msg] * size, [pk] * size, [sig] * size)
             if not out.all():
                 raise RuntimeError("verifier warmup produced invalid result")
@@ -223,41 +207,19 @@ class BatchVerifier:
             self._point_cache[pk] = hit
         return hit
 
-    def _neg_point128(self, pk: bytes):
-        """Limbs of -(2^128 * A) — the split kernel's hi-half A operand.
-        Cached per key (one ~128-doubling host computation per committee
-        member per epoch)."""
-        hit = self._point128_cache.get(pk)
-        if hit is None and pk not in self._point128_cache:
-            p = ref.point_decompress(pk)
-            hit = (
-                None
-                if p is None
-                else curve.point_to_limbs(
-                    ref.point_neg(ref.point_mul(1 << 128, p))
-                )
-            )
-            self._point128_cache[pk] = hit
-        return hit
-
-    def _prepare_item(self, msg, pk, sig, need128: bool):
-        """Shared per-item acceptance rules for both prepare paths
-        (one copy: divergent validation between batch sizes would be a
-        consensus-safety hazard).  Returns None if the item is invalid,
-        else (neg_point, neg_point128 | None, s, k)."""
+    def _prepare_item(self, msg, pk, sig):
+        """Per-item acceptance rules for batch preparation.  Returns
+        None if the item is invalid, else (neg_point, s, k)."""
         if len(sig) != 64 or len(pk) != 32:
             return None
         pt = self._neg_point(pk)
         if pt is None:
             return None
-        pt128 = self._neg_point128(pk) if need128 else None
-        if need128 and pt128 is None:
-            return None
         s = int.from_bytes(sig[32:], "little")
         if s >= ref.L:
             return None
         k = ref.verify_challenge(sig, pk, msg)
-        return pt, pt128, s, k
+        return pt, s, k
 
     def verify(
         self,
@@ -295,117 +257,23 @@ class BatchVerifier:
         ok = kernel(*arrays)
         return np.asarray(ok)[:n] & valid_host
 
-    # Split-path threshold: batches of <= SPLIT_MAX signatures double to
-    # <= one pallas tile of half-scalar rows, so the 16-step split
-    # kernel applies — ~2x lower scan depth.  The 512-row wide tile
-    # (pallas_dsm.SPLIT_BT) would raise this to 256 and cover the
-    # BASELINE's largest committee in one scan, and its parity is
-    # pinned (interpret-mode test, opt-in) — but its Mosaic compile did
-    # not complete within ~58 minutes on this toolchain (aborted; the
-    # round-1 attempt also exceeded 25 minutes), so production routing
-    # stays at 128 until the compile is tractable.
-    SPLIT_MAX = 128
-
     def stage(self, messages, pubkeys, signatures):
         """(kernel_fn, kernel arrays, host_validity) for this batch —
-        the single routing point between the split-scalar Pallas kernel
-        (small batches) and ``self._run_kernel`` (the 32-step Pallas or
-        XLA kernel; overridden by the mesh-sharded subclass).  bench.py
-        uses it to time exactly what production dispatches."""
-        if self.use_pallas and len(messages) <= self.SPLIT_MAX:
-            import jax.numpy as jnp
+        the production dispatch point (bench.py uses it to time exactly
+        what production dispatches; the mesh-sharded subclass overrides
+        ``_run_kernel``).
 
-            valid_host, arrays = self.prepare_split(
-                messages, pubkeys, signatures
-            )
-            return (
-                _verify_kernel_pallas_split,
-                tuple(jnp.asarray(a) for a in arrays),
-                valid_host,
-            )
+        NOTE (round 3): a split-scalar kernel variant (each signature as
+        two 128-bit half rows, 16 macro steps) lived here through round
+        2.  It was DELETED together with its 2^128-point caches, doubled
+        base tables and interleave layout: its entire win was avoiding
+        the old 256-lane minimum pad, and the kernel is VPU-throughput-
+        bound (~linear cost in lanes — scripts/probe_tile_scaling.py),
+        so with the 128-lane tile a 64-vote QC at 32 steps x 128 lanes
+        costs the same as 16 steps x 256 lanes, without ~600 lines of
+        machinery."""
         valid_host, arrays = self.prepare(messages, pubkeys, signatures)
         return self._run_kernel, arrays, valid_host
-
-    def prepare_split(self, messages, pubkeys, signatures):
-        """Host prep for the split-scalar kernel: each signature becomes
-        TWO rows — ([s_lo]B + [k_lo](-A)) and ([s_hi](2^128 B) +
-        [k_hi](-2^128 A)) — interleaved per pallas tile (the lo halves
-        of a tile's signatures, then their hi halves), with the hi rows'
-        base-table byte offset by 256 into the doubled table.  Returns
-        (host_validity[n], kernel_arrays) for _verify_kernel_pallas_split."""
-        from .pallas_dsm import LANE_TILE, split_half_tile
-
-        n = len(messages)
-        valid_host = np.ones(n, bool)
-        n_pad = ((n + LANE_TILE - 1) // LANE_TILE) * LANE_TILE
-        # interleave unit must match the tile the kernel picks for this
-        # row count (pallas_dsm.split_half_tile — single source of truth)
-        half_tile = split_half_tile(n_pad)
-
-        a_lo = [np.zeros((n_pad, F.NLIMBS), np.int32) for _ in range(4)]
-        a_hi = [np.zeros((n_pad, F.NLIMBS), np.int32) for _ in range(4)]
-        s_lo_b = np.zeros((n_pad, 16), np.uint8)
-        s_hi_b = np.zeros((n_pad, 16), np.uint8)
-        k_lo_b = np.zeros((n_pad, 16), np.uint8)
-        k_hi_b = np.zeros((n_pad, 16), np.uint8)
-        r_bytes = np.zeros((n_pad, 32), np.uint8)
-        r_sign = np.zeros(n_pad, np.int32)
-        # identity rows for pads: s = k = 0 -> P = identity, which
-        # compresses to y = 1, sign = 0
-        for arrs in (a_lo, a_hi):
-            arrs[1][:, 0] = 1  # Y = 1
-            arrs[2][:, 0] = 1  # Z = 1
-
-        mask128 = (1 << 128) - 1
-        for i, (msg, pk, sig) in enumerate(zip(messages, pubkeys, signatures)):
-            item = self._prepare_item(msg, pk, sig, need128=True)
-            if item is None:
-                valid_host[i] = False
-                continue
-            pt, pt128, s, k = item
-            for c in range(4):
-                a_lo[c][i] = pt[c]
-                a_hi[c][i] = pt128[c]
-            s_lo_b[i] = np.frombuffer((s & mask128).to_bytes(16, "little"), np.uint8)
-            s_hi_b[i] = np.frombuffer((s >> 128).to_bytes(16, "little"), np.uint8)
-            k_lo_b[i] = np.frombuffer((k & mask128).to_bytes(16, "little"), np.uint8)
-            k_hi_b[i] = np.frombuffer((k >> 128).to_bytes(16, "little"), np.uint8)
-            r_bytes[i] = np.frombuffer(sig[:32], np.uint8)
-            r_sign[i] = sig[31] >> 7
-
-        s_lo_w = _bytes_to_windows_msb(s_lo_b)  # [n_pad, 32]
-        s_hi_w = _bytes_to_windows_msb(s_hi_b)
-        k_lo_w = _bytes_to_windows_msb(k_lo_b)
-        k_hi_w = _bytes_to_windows_msb(k_hi_b)
-        r_y = _bytes_rows_to_limbs(r_bytes)
-        if n_pad > n:
-            r_y[n:] = 0
-            r_y[n:, 0] = 1
-
-        # interleave per tile: rows [t*BT : t*BT+128] = lo halves of the
-        # tile's signatures, [t*BT+128 : (t+1)*BT] = their hi halves
-        tiles = n_pad // half_tile
-
-        def interleave(lo_rows, hi_rows):
-            lo3 = lo_rows.reshape(tiles, half_tile, -1)
-            hi3 = hi_rows.reshape(tiles, half_tile, -1)
-            return np.concatenate([lo3, hi3], axis=1).reshape(
-                2 * n_pad, lo_rows.shape[-1]
-            )
-
-        coords = tuple(
-            interleave(a_lo[c], a_hi[c]) for c in range(4)
-        )  # [2n_pad, NLIMBS]
-        s_win = interleave(s_lo_w, s_hi_w).T  # [32, 2n_pad]
-        k_win = interleave(k_lo_w, k_hi_w).T
-        base_off = interleave(
-            np.zeros((n_pad, 1), np.int32),
-            np.full((n_pad, 1), 256, np.int32),
-        ).reshape(2 * n_pad)
-
-        return valid_host, (
-            *coords, s_win, k_win, base_off, r_y, r_sign,
-        )
 
     def prepare(
         self,
@@ -430,11 +298,11 @@ class BatchVerifier:
         r_sign = np.zeros(n, np.int32)
 
         for i, (msg, pk, sig) in enumerate(zip(messages, pubkeys, signatures)):
-            item = self._prepare_item(msg, pk, sig, need128=False)
+            item = self._prepare_item(msg, pk, sig)
             if item is None:
                 valid_host[i] = False
                 continue
-            pt, _, s, k = item
+            pt, s, k = item
             ax[i], ay[i], az[i], at[i] = pt
             scalar_bytes_s[i] = np.frombuffer(sig[32:], np.uint8)
             scalar_bytes_k[i] = np.frombuffer(

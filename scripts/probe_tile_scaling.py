@@ -47,7 +47,10 @@ def main() -> int:
         )
         return jnp.asarray(s_win), jnp.asarray(k_win), a
 
-    def slope_ms(batch, short=4, long=16, reps=5):
+    def slope_ms(batch, short=8, long=64, reps=7):
+        # long chains: the tunnel's RTT variance (~±15 ms) must be small
+        # against (long-short) dispatches of signal, or slopes go
+        # negative (observed with 4-vs-16 chains)
         s, k, a = inputs(batch)
         out = dual_scalar_mult(s, k, a)
         jax.block_until_ready(out)  # compile/warm

@@ -172,129 +172,77 @@ def test_pallas_dsm_parity_interpret():
         assert (np.asarray(canon(xla)) == np.asarray(canon(pal))).all()
 
 
-def test_pallas_split_kernel_parity_interpret():
-    """The split-scalar Pallas kernel (16-step scan over 128-bit scalar
-    halves, in-kernel recombine) must agree with the standard verify
-    path, including rejection of a tampered signature."""
+def test_pallas_fused_epilogue_parity_interpret():
+    """The in-kernel compressed-equality epilogue (limb-major ports of
+    _chain/_strict/canonical/pow_inv) against the XLA field ops: encode
+    the XLA scan's outputs host-side, corrupt the sign on some lanes and
+    the y encoding on others, and check the fused unsplit kernel's
+    verdict lane-by-lane."""
     import jax.numpy as jnp
 
     from hotstuff_tpu.tpu import pallas_dsm
+    from hotstuff_tpu.tpu.ed25519 import _bytes_to_windows_msb
 
-    n = 10
-    items = _sign_many(n, lambda i: b"split-%d" % i)
-    msgs, pks, sigs = map(list, zip(*items))
-    sigs[4] = sigs[4][:40] + b"\x01" + sigs[4][41:]  # tamper one
-
-    v = BatchVerifier(min_device_batch=0, use_pallas=False)
-    want = v.verify(msgs, pks, sigs)  # XLA path
-
-    valid_host, arrays = v.prepare_split(msgs, pks, sigs)
-    (ax, ay, az, at, s_win, k_win, base_off, r_y, r_sign) = arrays
-    p = pallas_dsm.dual_scalar_mult_split(
-        jnp.asarray(s_win),
-        jnp.asarray(k_win),
-        tuple(jnp.asarray(c) for c in (ax, ay, az, at)),
-        jnp.asarray(base_off),
-        interpret=True,
+    B = pallas_dsm.LANE_TILE
+    s_rows = np.stack(
+        [
+            np.frombuffer(
+                rng.randrange(ref.L).to_bytes(32, "little"), np.uint8
+            )
+            for _ in range(B)
+        ]
     )
+    k_rows = np.stack(
+        [
+            np.frombuffer(
+                rng.randrange(ref.L).to_bytes(32, "little"), np.uint8
+            )
+            for _ in range(B)
+        ]
+    )
+    s_win = jnp.asarray(_bytes_to_windows_msb(s_rows).T)
+    k_win = jnp.asarray(_bytes_to_windows_msb(k_rows).T)
+    pts = [rand_point() for _ in range(B)]
+    a_point = tuple(
+        jnp.asarray(np.stack([curve.point_to_limbs(p)[c] for p in pts]))
+        for c in range(4)
+    )
+
+    # the true compressed encodings, via the XLA path
+    X, Y, Z, _ = curve.dual_scalar_mult(s_win, k_win, a_point)
+    zinv = jax.jit(F.pow_inv)(Z)
+    y_can = np.asarray(jax.jit(F.canonical)(F.mul(Y, zinv)))
+    x_can = np.asarray(jax.jit(F.canonical)(F.mul(X, zinv)))
+    r_y = y_can.copy()
+    r_sign = (x_can[:, 0] & 1).astype(np.int32)
+    expect = np.ones(B, bool)
+    r_sign[:8] ^= 1  # wrong sign bit
+    r_y[8:16, 0] ^= 1  # wrong y encoding
+    expect[:16] = False
+
     ok = np.asarray(
-        curve.compressed_equals(p, jnp.asarray(r_y), jnp.asarray(r_sign))
-    )[:n] & valid_host
-    assert ok.tolist() == want.tolist()
-    assert not ok[4] and ok[:4].all() and ok[5:].all()
+        pallas_dsm.verify_compressed(
+            s_win,
+            k_win,
+            a_point,
+            jnp.asarray(r_y),
+            jnp.asarray(r_sign),
+            interpret=True,
+        )
+    )
+    assert ok.tolist() == expect.tolist()
 
 
 def test_stage_routing_thresholds():
-    """stage() routing contract: <= SPLIT_MAX signatures take the split
-    kernel when pallas is on; larger batches and non-pallas verifiers
-    take _run_kernel."""
-    from hotstuff_tpu.tpu import ed25519 as mod
-
+    """stage() contract after the split-kernel deletion: every batch
+    goes through prepare() to _run_kernel (overridden by the
+    mesh-sharded subclass); use_pallas only changes which kernel
+    _run_kernel dispatches."""
     items = _sign_many(3, lambda i: b"route-%d" % i)
     msgs, pks, sigs = map(list, zip(*items))
 
-    v = BatchVerifier(min_device_batch=0, use_pallas=True)
-    kernel, arrays, valid = v.stage(msgs, pks, sigs)
-    assert kernel is mod._verify_kernel_pallas_split
-    assert valid.all() and len(arrays) == 9  # incl. base_off
-
-    v_plain = BatchVerifier(min_device_batch=0, use_pallas=False)
-    kernel, arrays, _ = v_plain.stage(msgs, pks, sigs)
-    assert kernel == v_plain._run_kernel and len(arrays) == 8
-
-    big = BatchVerifier(min_device_batch=0, use_pallas=True)
-    n = big.SPLIT_MAX + 1
-    kernel, _, _ = big.stage([msgs[0]] * n, [pks[0]] * n, [sigs[0]] * n)
-    assert kernel == big._run_kernel
-
-
-def test_pallas_split_wide_tile_parity_interpret():
-    """The 512-row split tile (one 16-step scan for up to 256-signature
-    batches): 140 signatures pad to 256 -> 512 rows -> the SPLIT_BT
-    tile.  Parity with the XLA path, including a tampered signature.
-
-    Interpret-mode at this width costs several CPU-minutes, so the test
-    is opt-in (HOTSTUFF_WIDE_TILE_TEST=1); fast structural coverage of
-    the tile-selection/interleave contract is in
-    test_prepare_split_wide_tile_layout, and the kernel itself is
-    validated on hardware (results/ + BENCH)."""
-    import os
-
-    import pytest
-
-    if not os.environ.get("HOTSTUFF_WIDE_TILE_TEST"):
-        pytest.skip("opt-in: interpret mode needs minutes at 512 lanes")
-    import jax.numpy as jnp
-
-    from hotstuff_tpu.tpu import pallas_dsm
-
-    n = 140
-    items = _sign_many(n, lambda i: b"wide-%d" % i)
-    msgs, pks, sigs = map(list, zip(*items))
-    sigs[77] = sigs[77][:40] + b"\x01" + sigs[77][41:]  # tamper one
-
-    v = BatchVerifier(min_device_batch=0, use_pallas=False)
-    want = v.verify(msgs, pks, sigs)  # XLA path
-
-    valid_host, arrays = v.prepare_split(msgs, pks, sigs)
-    (ax, ay, az, at, s_win, k_win, base_off, r_y, r_sign) = arrays
-    assert s_win.shape[1] == 512  # wide tile engaged
-    p = pallas_dsm.dual_scalar_mult_split(
-        jnp.asarray(s_win),
-        jnp.asarray(k_win),
-        tuple(jnp.asarray(c) for c in (ax, ay, az, at)),
-        jnp.asarray(base_off),
-        interpret=True,
-    )
-    ok = np.asarray(
-        curve.compressed_equals(p, jnp.asarray(r_y), jnp.asarray(r_sign))
-    )[:n] & valid_host
-    assert ok.tolist() == want.tolist()
-    assert not ok[77] and ok[:77].all() and ok[78:].all()
-
-
-def test_prepare_split_wide_tile_layout():
-    """Host-side contract of the wide split tile: 140 signatures pad to
-    256 and interleave with half-tile 256 (one 512-row kernel tile —
-    lo rows 0..255, hi rows 256..511), and the tile choice matches
-    pallas_dsm.split_half_tile for every pad size."""
-    from hotstuff_tpu.tpu.pallas_dsm import BT, SPLIT_BT, split_half_tile
-
-    assert split_half_tile(128) == BT // 2
-    assert split_half_tile(256) == SPLIT_BT // 2
-    assert split_half_tile(384) == BT // 2
-    assert split_half_tile(512) == SPLIT_BT // 2
-
-    n = 140
-    items = _sign_many(n, lambda i: b"layout-%d" % i)
-    msgs, pks, sigs = map(list, zip(*items))
-    v = BatchVerifier(min_device_batch=0, use_pallas=False)
-    valid_host, arrays = v.prepare_split(msgs, pks, sigs)
-    (ax, ay, az, at, s_win, k_win, base_off, r_y, r_sign) = arrays
-    assert valid_host.all()
-    assert s_win.shape == (32, 512) and base_off.shape == (512,)
-    # lo half rows carry base offset 0, hi half rows 256
-    assert (base_off[:256] == 0).all() and (base_off[256:] == 256).all()
-    # row i and row 256+i belong to the same signature: the hi half of a
-    # zero-padded row is the identity A-point, real rows are not
-    assert (ay[256 + n :, 0] == 1).all()  # identity pads in the hi half
+    for use_pallas in (True, False):
+        v = BatchVerifier(min_device_batch=0, use_pallas=use_pallas)
+        kernel, arrays, valid = v.stage(msgs, pks, sigs)
+        assert kernel == v._run_kernel
+        assert valid.all() and len(arrays) == 8
