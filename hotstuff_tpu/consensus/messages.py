@@ -230,6 +230,7 @@ class TC:
             digests,
             [pk.to_bytes() for pk, _, _ in self.votes],
             [sig.to_bytes() for _, sig, _ in self.votes],
+            aggregate_ok=True,
         )
         if not all(ok):
             raise InvalidSignature(f"bad signature in TC for round {self.round}")

@@ -179,10 +179,14 @@ class DualSchemeVerifier:
             return False
         return self._route(votes[0][0].data).verify_shared_msg(digest, votes)
 
-    def verify_many(self, digests, pks, sigs) -> list[bool]:
+    def verify_many(
+        self, digests, pks, sigs, aggregate_ok: bool = False
+    ) -> list[bool]:
         if not pks:
             return []
-        return self._route(pks[0]).verify_many(digests, pks, sigs)
+        return self._route(pks[0]).verify_many(
+            digests, pks, sigs, aggregate_ok=aggregate_ok
+        )
 
     # boot-time hooks forwarded so device backends still warm up
     def precompute(self, pubkeys: list[bytes]) -> None:

@@ -33,9 +33,20 @@ class VerifierBackend(Protocol):
         digests: list[bytes],
         pks: list[bytes],
         sigs: list[bytes],
+        aggregate_ok: bool = False,
     ) -> list[bool]:
         """Per-item validity over distinct messages (TC verify / eviction
-        shape)."""
+        shape).
+
+        ``aggregate_ok=True`` permits backends to use AGGREGATE
+        acceptance within same-digest groups — per-entry results may
+        then be certified only collectively (entries that individually
+        fail but cancel in the sum pass).  That is sound ONLY for
+        certificate verification whose trust base already covers
+        aggregation (TC.verify: PoP-checked keys, stake rules run
+        first — the same argument as QC aggregation).  Callers that
+        make PER-ENTRY decisions (the aggregator's eviction/suspect
+        logic) must leave it False."""
         ...
 
 
@@ -65,6 +76,7 @@ class CpuVerifier:
         digests: list[bytes],
         pks: list[bytes],
         sigs: list[bytes],
+        aggregate_ok: bool = False,
     ) -> list[bool]:
         from .signature import batch_verify_arrays
 

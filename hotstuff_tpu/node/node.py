@@ -81,10 +81,14 @@ class LazyDeviceVerifier:
             return self._cpu.verify_shared_msg(digest, votes)
         return self._materialize().verify_shared_msg(digest, votes)
 
-    def verify_many(self, digests, pks, sigs) -> list[bool]:
+    def verify_many(
+        self, digests, pks, sigs, aggregate_ok: bool = False
+    ) -> list[bool]:
         if len(digests) < self.min_device_batch:
             return self._cpu.verify_many(digests, pks, sigs)
-        return self._materialize().verify_many(digests, pks, sigs)
+        return self._materialize().verify_many(
+            digests, pks, sigs, aggregate_ok=aggregate_ok
+        )
 
 
 def make_verifier(kind: str, scheme: str = "ed25519") -> VerifierBackend:

@@ -365,7 +365,10 @@ class BatchVerifier:
         digests: list[bytes],
         pks: list[bytes],
         sigs: list[bytes],
+        aggregate_ok: bool = False,
     ) -> list[bool]:
+        # aggregate_ok is irrelevant for ed25519: verification is
+        # per-signature on the device regardless
         return [bool(v) for v in self.verify(digests, pks, sigs)]
 
     def verify_one(self, digest, pk, sig) -> bool:

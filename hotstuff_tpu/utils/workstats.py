@@ -94,10 +94,11 @@ class CountingVerifier:
             len(votes), self.inner.verify_shared_msg, digest, votes
         )
 
-    def verify_many(self, digests, pks, sigs):
-        return self._timed(
-            len(digests), self.inner.verify_many, digests, pks, sigs
-        )
+    def verify_many(self, digests, pks, sigs, aggregate_ok: bool = False):
+        def call(d, p, s):
+            return self.inner.verify_many(d, p, s, aggregate_ok=aggregate_ok)
+
+        return self._timed(len(digests), call, digests, pks, sigs)
 
     def __getattr__(self, item):
         # precompute/warmup/etc. pass through untimed

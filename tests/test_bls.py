@@ -414,3 +414,40 @@ def test_native_batch_rejects_small_order_component():
     assert not native.verify_batch(msgs, pks, sigs[:-1])
     # and the untampered set still verifies
     assert native.verify_batch(msgs, pks, sigs)
+
+
+def test_grouped_tc_batch_verification():
+    """The same-digest grouped TC path (storm shape: every entry shares
+    one timeout digest): a valid grouped batch passes, one tampered
+    entry is pinpointed by the per-item fallback, and a mixed batch
+    (two digest groups) verifies group-aggregated."""
+    from hotstuff_tpu.crypto.scheme import bls_keygen, make_cpu_verifier
+
+    v = make_cpu_verifier("bls")
+    members = [bls_keygen(b"\x61" * 32, i) for i in range(8)]
+    d1, d2 = b"\x01" * 32, b"\x02" * 32
+
+    def sign(secret, msg):
+        from hotstuff_tpu.crypto.bls import BlsSecretKey
+
+        scalar = int.from_bytes(secret, "big")
+        return BlsSecretKey(scalar).sign(msg).to_bytes()
+
+    # one shared digest (the realistic storm TC)
+    digests = [d1] * 8
+    pks = [pk.to_bytes() for pk, _ in members]
+    sigs = [sign(sk, d1) for _, sk in members]
+    assert v.verify_many(digests, pks, sigs) == [True] * 8
+
+    # two groups
+    digests2 = [d1] * 5 + [d2] * 3
+    sigs2 = [sign(sk, d) for (_, sk), d in zip(members, digests2)]
+    assert v.verify_many(digests2, pks, sigs2) == [True] * 8
+
+    # tampered entry: the grouped aggregate fails, the per-item
+    # fallback pinpoints exactly the bad index
+    bad = bytearray(sigs[3])
+    bad[1] ^= 0xFF
+    sigs_bad = sigs[:3] + [bytes(bad)] + sigs[4:]
+    out = v.verify_many(digests, pks, sigs_bad)
+    assert out == [True] * 3 + [False] + [True] * 4
