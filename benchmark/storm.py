@@ -153,6 +153,20 @@ def _measure(committee, timeouts, tc, verifier) -> dict[str, float]:
         t.verify(committee, verifier, qc_cache=None)
     sampled = max(4, len(timeouts) // 16)
     out["flood_naive_s"] = (time.perf_counter() - t0) / sampled * len(timeouts)
+    # 1c. the burst path (Core._preverify_timeout_burst): per 64-message
+    # burst ONE aggregate signature check over the shared timeout
+    # digest, then per-timeout stake + memoized-QC checks only
+    cache2: set = set()
+    t0 = time.perf_counter()
+    for start in range(0, len(timeouts), 64):
+        chunk = timeouts[start : start + 64]
+        ok = verifier.verify_shared_msg(
+            chunk[0].digest(), [(t.author, t.signature) for t in chunk]
+        )
+        assert ok
+        for t in chunk:
+            t.verify(committee, verifier, qc_cache=cache2, sig_verified=True)
+    out["flood_burst_s"] = time.perf_counter() - t0
     # 2. TC verification: realistic (all entries share one timeout
     # digest — same-digest grouping applies) and adversarial worst case
     # (every digest distinct — full multi-pairing)
@@ -218,6 +232,8 @@ def format_report(nodes: int, results: dict[str, dict[str, float]]) -> str:
             f"{_fmt_ms(m['flood_memo_s'])}",
             f"   Timeout flood x{quorum} (naive, extrapolated): "
             f"{_fmt_ms(m['flood_naive_s'])}",
+            f"   Timeout flood x{quorum} (burst aggregate): "
+            f"{_fmt_ms(m['flood_burst_s'])}",
             f"   TC verify ({quorum} entries, shared high_qc_round): "
             f"{_fmt_ms(m['tc_verify_s'])}",
             f"   TC verify ({quorum} DISTINCT digests, worst case): "

@@ -446,7 +446,9 @@ class Core:
             self._verified_qcs.clear()
         return self._verified_qcs
 
-    async def _handle_timeout(self, timeout: Timeout) -> None:
+    async def _handle_timeout(
+        self, timeout: Timeout, sig_verified: bool = False
+    ) -> None:
         self.log.debug("Processing %r", timeout)
         if timeout.round < self.round:
             return
@@ -454,7 +456,14 @@ class Core:
         # single signature is checked FIRST (cheap), so a spoofed timeout
         # cannot force the expensive embedded-QC batch verify — and the
         # TCMaker can then emit TCs from pre-verified entries.
-        timeout.verify(self.committee, self.verifier, qc_cache=self._qc_cache())
+        # ``sig_verified``: the burst drain already aggregate-verified
+        # this timeout's author signature (_preverify_timeout_burst).
+        timeout.verify(
+            self.committee,
+            self.verifier,
+            qc_cache=self._qc_cache(),
+            sig_verified=sig_verified,
+        )
         self._process_qc(timeout.high_qc)
 
         tc = self.aggregator.add_timeout(timeout, self.round)
@@ -574,14 +583,73 @@ class Core:
 
     # ---- the select loop -----------------------------------------------------
 
-    async def _dispatch(self, tagged) -> None:
+    def _preverify_timeout_burst(self, burst: list) -> set[int]:
+        """Aggregate signature verification for a timeout flood.
+
+        Under a view-change storm 2f+1 timeouts land nearly at once,
+        all signing the SAME digest (same round, same high_qc round) —
+        on BLS that is 2f+1 pairing equalities (~5.7 ms each, measured
+        ~0.95 s for the 171-flood).  Timeouts in the burst are grouped
+        by digest; each group of >= 2 is checked as ONE shared-message
+        aggregate.  On success every member is marked sig-verified
+        (the stake and embedded-QC checks still run per message in
+        _handle_timeout); on failure the group falls back to per-item
+        verification — a garbage timeout mixed into a burst costs the
+        attacker exactly today's per-item price, never an amplification.
+
+        Trust base: identical to TC.verify's grouped path — aggregation
+        is ONLY over authors holding stake in their round's committee
+        (PoP-checked under BLS; a rogue key pk_E = x*G2 - pk_B that
+        would let an attacker forge an honest member's entry inside the
+        aggregate cannot carry a valid proof of possession, and
+        non-members never enter the sum at all — they fall back to
+        per-item verification, where the stake check rejects them).
+        A TC formed from collectively-certified entries is re-verified
+        by every receiver under the same semantics."""
+        groups: dict = {}  # Digest -> burst indices
+        for idx, (tag, payload) in enumerate(burst):
+            if (
+                tag == TAG_TIMEOUT
+                and payload.round >= self.round
+                # committee membership BEFORE aggregation — the
+                # soundness precondition above
+                and self.committee.for_round(payload.round).stake(
+                    payload.author
+                )
+                > 0
+            ):
+                groups.setdefault(payload.digest(), []).append(idx)
+        preverified: set[int] = set()
+        for digest, idxs in groups.items():
+            if len(idxs) < 2:
+                continue
+            votes = [
+                (burst[i][1].author, burst[i][1].signature) for i in idxs
+            ]
+            try:
+                if self.verifier.verify_shared_msg(digest, votes):
+                    preverified.update(idxs)
+            except Exception as e:  # noqa: BLE001 — any backend failure
+                # must degrade to per-item verification, never crash the
+                # core; but silently losing the fast path forever is a
+                # debugging trap, so say so
+                self.log.warning(
+                    "timeout burst aggregate check failed (%s); "
+                    "falling back to per-item verification",
+                    e,
+                )
+        return preverified
+
+    async def _dispatch(self, tagged, sig_verified: bool = False) -> None:
+        """``sig_verified`` applies to TAG_TIMEOUT only: the burst drain
+        aggregate-verified this message's author signature."""
         tag, payload = tagged
         if tag == TAG_PROPOSE:
             await self._handle_proposal(payload)
         elif tag == TAG_VOTE:
             await self._handle_vote(payload)
         elif tag == TAG_TIMEOUT:
-            await self._handle_timeout(payload)
+            await self._handle_timeout(payload, sig_verified=sig_verified)
         elif tag == TAG_TC:
             await self._handle_tc(payload)
         else:
@@ -610,25 +678,28 @@ class Core:
                 # in the select set, or the loop would re-fire the same branch
                 # with the same payload forever.
                 if msg_task in done:
-                    message = msg_task.result()
-                    msg_task = asyncio.ensure_future(self.rx_message.get())
-                    try:
-                        await self._dispatch(message)
-                    except ConsensusError as e:
-                        self.log.warning("%s", e)
-                    # burst drain: handle whatever queued while the
+                    # burst drain: collect whatever queued while the last
                     # handler ran in THIS wake-up — re-arming a fresh
                     # get() task per message costs a task create + two
                     # switches each, which under load dominates the loop.
                     # Bounded so a message flood cannot starve the timer
-                    # branch.
+                    # branch.  Collected FIRST so a view-change storm's
+                    # timeout flood can be signature-verified as one
+                    # aggregate (_preverify_timeout_burst) instead of
+                    # 2f+1 single checks.
+                    burst = [msg_task.result()]
+                    msg_task = asyncio.ensure_future(self.rx_message.get())
                     for _ in range(64):
                         try:
-                            message = self.rx_message.get_nowait()
+                            burst.append(self.rx_message.get_nowait())
                         except asyncio.QueueEmpty:
                             break
+                    preverified = self._preverify_timeout_burst(burst)
+                    for idx, message in enumerate(burst):
                         try:
-                            await self._dispatch(message)
+                            await self._dispatch(
+                                message, sig_verified=idx in preverified
+                            )
                         except ConsensusError as e:
                             self.log.warning("%s", e)
                 if loop_task in done:

@@ -451,10 +451,17 @@ class Timeout:
         committee: Committee,
         verifier: VerifierBackend,
         qc_cache: set | None = None,
+        sig_verified: bool = False,
     ) -> None:
+        """``sig_verified=True`` skips only the author-signature check —
+        for callers that already verified it as part of a burst
+        aggregate (Core's timeout-flood batching); the authority/stake
+        check and the embedded-QC verification always run."""
         if committee.for_round(self.round).stake(self.author) <= 0:
             raise UnknownAuthority(self.author)
-        if not verifier.verify_one(self.digest(), self.author, self.signature):
+        if not sig_verified and not verifier.verify_one(
+            self.digest(), self.author, self.signature
+        ):
             raise InvalidSignature(f"bad signature on timeout {self}")
         if not self.high_qc.is_genesis():
             # QC.verify routes itself to its own round's committee

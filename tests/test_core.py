@@ -329,3 +329,87 @@ async def test_timeout_backoff_grows_and_resets_on_progress(tmp_path):
         assert core.timer.duration == base
     finally:
         teardown(h)
+
+
+@async_test
+async def test_timeout_burst_aggregate_verification(tmp_path):
+    """A view-change storm's timeout flood arriving in one burst is
+    signature-verified as ONE shared-message aggregate (all flood
+    entries sign the same digest); a garbage timeout in the burst makes
+    its group fall back to per-item verification, where it is rejected
+    while the honest timeouts still land in the TC maker."""
+    from hotstuff_tpu.consensus.wire import TAG_TIMEOUT
+    from hotstuff_tpu.crypto import Signature
+    from hotstuff_tpu.crypto.service import CpuVerifier
+
+    class CountingVerifier(CpuVerifier):
+        ones = 0
+        shared = 0
+
+        def verify_one(self, d, pk, sig):
+            CountingVerifier.ones += 1
+            return super().verify_one(d, pk, sig)
+
+        def verify_shared_msg(self, d, votes):
+            CountingVerifier.shared += 1
+            return super().verify_shared_msg(d, votes)
+
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
+    try:
+        from hotstuff_tpu.consensus import QC
+
+        h.core.verifier = CountingVerifier()
+        ks = keys()
+        # clean burst: 3 timeouts over the same digest (round 1, genesis
+        # high_qc) -> one aggregate, zero per-item signature checks
+        burst = [
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, pk, sk))
+            for pk, sk in ks[:3]
+        ]
+        pre = h.core._preverify_timeout_burst(burst)
+        assert pre == {0, 1, 2}
+        assert CountingVerifier.shared == 1
+        assert CountingVerifier.ones == 0
+
+        # poisoned burst: one garbage signature -> the aggregate fails,
+        # nothing is preverified (per-item fallback happens in
+        # _handle_timeout, where the garbage one raises)
+        bad = signed_timeout(QC.genesis(), 1, ks[2][0], ks[2][1])
+        bad.signature = Signature(b"\x01" * 64)
+        burst_bad = [
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[0][0], ks[0][1])),
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[1][0], ks[1][1])),
+            (TAG_TIMEOUT, bad),
+        ]
+        pre = h.core._preverify_timeout_burst(burst_bad)
+        assert pre == set()
+
+        # NON-MEMBER authors must never enter an aggregate (the BLS
+        # rogue-key precondition: only PoP-checked committee keys may
+        # be summed) — a stranger's timeout is excluded from grouping
+        # even when the rest of the burst is honest
+        from hotstuff_tpu.crypto import generate_keypair
+
+        spk, ssk = generate_keypair(b"\x77" * 32, 0)  # not in committee
+        stranger = signed_timeout(QC.genesis(), 1, spk, ssk)
+        CountingVerifier.shared = 0
+        burst_mixed = [
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[0][0], ks[0][1])),
+            (TAG_TIMEOUT, stranger),
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[1][0], ks[1][1])),
+        ]
+        pre = h.core._preverify_timeout_burst(burst_mixed)
+        assert pre == {0, 2}  # members aggregate; the stranger never joins
+        assert CountingVerifier.shared == 1
+        # the per-item path still accepts the honest ones and rejects
+        # the garbage one
+        await h.core._handle_timeout(burst_bad[0][1])
+        from hotstuff_tpu.consensus.errors import InvalidSignature
+
+        try:
+            await h.core._handle_timeout(bad)
+            raise AssertionError("garbage timeout accepted")
+        except InvalidSignature:
+            pass
+    finally:
+        teardown(h)
