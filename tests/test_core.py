@@ -413,3 +413,35 @@ async def test_timeout_burst_aggregate_verification(tmp_path):
             pass
     finally:
         teardown(h)
+
+
+@async_test
+async def test_timeout_burst_mixed_rounds_group_separately(tmp_path):
+    """Timeouts for different rounds (distinct digests) in one burst
+    aggregate per group — each round's group verifies independently."""
+    from hotstuff_tpu.consensus import QC
+    from hotstuff_tpu.consensus.wire import TAG_TIMEOUT
+    from hotstuff_tpu.crypto.service import CpuVerifier
+
+    class CountingVerifier(CpuVerifier):
+        shared = 0
+
+        def verify_shared_msg(self, d, votes):
+            CountingVerifier.shared += 1
+            return super().verify_shared_msg(d, votes)
+
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
+    try:
+        h.core.verifier = CountingVerifier()
+        ks = keys()
+        burst = [
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[0][0], ks[0][1])),
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 2, ks[1][0], ks[1][1])),
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[2][0], ks[2][1])),
+            (TAG_TIMEOUT, signed_timeout(QC.genesis(), 2, ks[3][0], ks[3][1])),
+        ]
+        pre = h.core._preverify_timeout_burst(burst)
+        assert pre == {0, 1, 2, 3}
+        assert CountingVerifier.shared == 2  # one aggregate per round
+    finally:
+        teardown(h)

@@ -325,3 +325,26 @@ async def test_scheme_changeover_e2e(tmp_path):
             await stack.shutdown()
         for _, _, store in nodes:
             store.close()
+
+
+def test_departed_member_block_rejected_post_boundary():
+    """A block authored by the rotated-out member for a post-boundary
+    round must be rejected — by leader election (it never leads epoch-2
+    rounds) and by verification (no epoch-2 stake)."""
+    from hotstuff_tpu.consensus import UnknownAuthority, WrongLeader  # noqa: F401
+
+    schedule, ks = make_schedule(9_240)
+    verifier = CpuVerifier()
+    elector = LeaderElector(schedule)
+    departed_pk, departed_sk = ks[3]
+
+    forged = signed_block(departed_pk, departed_sk, round_=SWITCH_ROUND + 2)
+    # never elected past the boundary
+    assert elector.get_leader(forged.round) != departed_pk
+    # and carries no stake under the round's committee
+    with pytest.raises(UnknownAuthority):
+        forged.verify(schedule, verifier)
+    # the same author's PRE-boundary block still verifies (round routed
+    # to epoch 1)
+    ok_block = signed_block(departed_pk, departed_sk, round_=3)
+    ok_block.verify(schedule, verifier)
