@@ -331,7 +331,7 @@ def test_departed_member_block_rejected_post_boundary():
     """A block authored by the rotated-out member for a post-boundary
     round must be rejected — by leader election (it never leads epoch-2
     rounds) and by verification (no epoch-2 stake)."""
-    from hotstuff_tpu.consensus import UnknownAuthority, WrongLeader  # noqa: F401
+    from hotstuff_tpu.consensus import UnknownAuthority
 
     schedule, ks = make_schedule(9_240)
     verifier = CpuVerifier()
