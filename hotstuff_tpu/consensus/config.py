@@ -43,7 +43,26 @@ class Parameters:
     timeout_delay: int = 5_000
     sync_retry_delay: int = 10_000
     timeout_backoff: float = 2.0
-    timeout_cap_ms: int = 60_000
+    # None = derived: max(60 s, timeout_delay) — so a large base delay
+    # never collides with the fixed default cap.
+    timeout_cap_ms: int | None = None
+
+    def __post_init__(self) -> None:
+        # A backoff below 1 would make consecutive timeouts geometrically
+        # SHRINK the round timer toward zero — a self-inflicted
+        # view-change storm from a mistyped config.  A cap below the base
+        # delay is equally incoherent (the cap would override the base).
+        if self.timeout_backoff < 1.0:
+            raise InvalidParameters(
+                f"timeout_backoff must be >= 1.0, got {self.timeout_backoff}"
+            )
+        if self.timeout_cap_ms is None:
+            self.timeout_cap_ms = max(60_000, self.timeout_delay)
+        if self.timeout_cap_ms < self.timeout_delay:
+            raise InvalidParameters(
+                f"timeout_cap_ms ({self.timeout_cap_ms}) must be >= "
+                f"timeout_delay ({self.timeout_delay})"
+            )
 
     def log(self) -> None:
         # NOTE: these log entries are used to compute performance
@@ -78,10 +97,17 @@ class Parameters:
             timeout_backoff=float(
                 data.get("timeout_backoff", default.timeout_backoff)
             ),
-            timeout_cap_ms=int(
-                data.get("timeout_cap_ms", default.timeout_cap_ms)
+            timeout_cap_ms=(
+                int(data["timeout_cap_ms"])
+                if data.get("timeout_cap_ms") is not None
+                else None
             ),
         )
+
+
+class InvalidParameters(ValueError):
+    """A parameters file that must not be allowed to run (incoherent
+    timing knobs that would destroy liveness)."""
 
 
 class InvalidCommittee(ValueError):

@@ -336,11 +336,12 @@ class Core:
         for b in reversed(to_commit):
             await self.tx_commit.put(b)
             committed_payloads.update(b.payloads)
-            # NOTE: this log entry is used to compute performance —
-            # one line per block in the chain walk (the reference logs
-            # inside its commit loop too, core.rs:204-209); logging only
-            # the head would hide the other blocks' payloads from the
-            # harness and undercount TPS after every view change.
+            # NOTE: this log entry is used to compute performance.
+            # One info line per block in the chain walk — a DELIBERATE
+            # divergence from the reference, which info-logs only the
+            # head and debug-logs the rest (core.rs:204-209): head-only
+            # logging hides the other blocks' payloads from the harness
+            # and undercounts TPS after every view change.
             self.log.info("Committed block %d -> %s", b.round, b.digest())
         # Tell the proposer what committed: (a) it prunes those digests
         # from its buffer — with single-homed clients (node/client.py)

@@ -18,15 +18,21 @@ from .messages import Round
 class RoundRobinLeaderElector:
     def __init__(self, committee: Committee):
         self._committee = committee
-        self._keys_cache: dict[int, list[PublicKey]] = {}
+        # id(com) -> (com, sorted keys).  The cache holds a STRONG
+        # reference to each committee it has served, which is what makes
+        # the id() key sound: a cached committee can never be collected,
+        # so its id can never be reused by a different object (ADVICE r3
+        # flagged the bare-id() variant's reliance on the schedule's own
+        # lifetime for this).
+        self._keys_cache: dict[int, tuple[Committee, list[PublicKey]]] = {}
 
     def get_leader(self, round_: Round) -> PublicKey:
         com = self._committee.for_round(round_)
-        keys = self._keys_cache.get(id(com))
-        if keys is None:
-            keys = com.sorted_keys()
-            self._keys_cache[id(com)] = keys
-        return keys[round_ % len(keys)]
+        hit = self._keys_cache.get(id(com))
+        if hit is None:
+            hit = (com, com.sorted_keys())
+            self._keys_cache[id(com)] = hit
+        return hit[1][round_ % len(hit[1])]
 
 
 LeaderElector = RoundRobinLeaderElector

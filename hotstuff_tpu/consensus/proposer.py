@@ -49,6 +49,14 @@ log = logging.getLogger(__name__)
 MAX_PENDING = 100_000
 # Dedup window: digests remembered (buffered or already proposed).
 SEEN_CAP = 200_000
+# In-flight proposal bound (rounds whose fate is undecided).  When commit
+# signals stall past this many proposals, the OLDEST one's payloads are
+# conservatively re-buffered (treated as orphaned).  The bound keeps
+# inflight memory finite through arbitrarily long partitions; the
+# eager re-buffer can duplicate a payload only if its commit signal is
+# still unseen AFTER this many newer proposals resolved — and the
+# committed_seen LRU (SEEN_CAP deep) still filters those on resolution.
+MAX_INFLIGHT = 1_024
 
 
 class Proposer:
@@ -127,6 +135,8 @@ class Proposer:
         )
         if payloads:
             self.inflight[round_] = payloads
+            while len(self.inflight) > MAX_INFLIGHT:
+                self._requeue_oldest_inflight()
 
         block = Block(
             qc=qc, tc=tc, author=self.name, round=round_, payloads=payloads
@@ -179,6 +189,40 @@ class Proposer:
             for t in pending:
                 t.cancel()
 
+    def _requeue_orphans(
+        self, round_: Round, payloads: tuple, committed=frozenset(), note: str = ""
+    ) -> None:
+        """Re-buffer a resolved/abandoned proposal's payloads at the
+        FRONT of the queue (oldest-first order preserved by callers
+        iterating newest-round-first), skipping anything known
+        committed or already buffered."""
+        orphaned = [
+            d for d in payloads
+            if d not in committed
+            and d not in self.committed_seen
+            and d not in self.pending
+        ]
+        if orphaned:
+            self.log.info(
+                "Re-buffering %d payloads from %s block %d",
+                len(orphaned),
+                note or "orphaned",
+                round_,
+            )
+        for digest in reversed(orphaned):
+            self.pending[digest] = None
+            self.pending.move_to_end(digest, last=False)
+
+    def _requeue_oldest_inflight(self) -> None:
+        """Inflight overflow (MAX_INFLIGHT): re-buffer the oldest
+        undecided proposal's payloads as if orphaned.  Single-homed
+        payloads survive the stall; the committed_seen/pending filters
+        keep the duplicate window bounded (see MAX_INFLIGHT note)."""
+        round_ = min(self.inflight)
+        self._requeue_orphans(
+            round_, self.inflight.pop(round_), note="unresolved"
+        )
+
     def _resolve_inflight(self, message: ProposerMessage) -> None:
         """Orphan recovery: once the chain is committed through round R,
         every proposal of ours at round <= R either committed (its
@@ -191,22 +235,9 @@ class Proposer:
             (r for r in self.inflight if r <= message.committed_round),
             reverse=True,  # re-insert newest first so oldest ends up in front
         ):
-            payloads = self.inflight.pop(round_)
-            orphaned = [
-                d for d in payloads
-                if d not in message.payloads
-                and d not in self.committed_seen
-                and d not in self.pending
-            ]
-            if orphaned:
-                self.log.info(
-                    "Re-buffering %d payloads from orphaned block %d",
-                    len(orphaned),
-                    round_,
-                )
-            for digest in reversed(orphaned):
-                self.pending[digest] = None
-                self.pending.move_to_end(digest, last=False)
+            self._requeue_orphans(
+                round_, self.inflight.pop(round_), committed=message.payloads
+            )
 
     @staticmethod
     async def _ack_stake(handle: asyncio.Future, stake: int) -> int:

@@ -72,6 +72,21 @@ def test_log_parser_matches_real_client_format():
     assert "AbCd+/==" in parser.samples
 
 
+def test_no_sample_committed_reports_na_not_zero():
+    """Result honesty (VERDICT r3 item 5): when no sample payload lands
+    in the window, the e2e latency must read n/a — a 0 ms would read as
+    a (great) measurement."""
+    client_log = (
+        "2026-01-01T00:00:00.500Z [INFO] Transactions rate: 1000 tx/s\n"
+        "2026-01-01T00:00:00.900Z [INFO] Sending sample payload NEVERCOMMITTED\n"
+    )
+    parser = LogParser([NODE_LOG], [client_log])
+    assert parser.end_to_end_latency() is None
+    summary = parser.result(faults=0, nodes=1, verifier="cpu")
+    assert "End-to-end latency: n/a" in summary
+    assert "End-to-end latency: 0 ms" not in summary
+
+
 def test_result_summary_and_aggregate(tmp_path):
     parser = LogParser([NODE_LOG, NODE_LOG_B], [CLIENT_LOG])
     summary = parser.result(faults=0, nodes=2, verifier="cpu")

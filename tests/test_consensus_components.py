@@ -93,6 +93,80 @@ async def test_synchronizer_miss_requests_then_loopback(tmp_path):
     store.close()
 
 
+def test_parameters_reject_incoherent_backoff():
+    """ADVICE r3: a backoff < 1.0 would geometrically SHRINK the round
+    timer under consecutive timeouts (view-change storm from a typo); a
+    cap below the base delay is equally incoherent."""
+    import pytest
+
+    from hotstuff_tpu.consensus.config import InvalidParameters, Parameters
+
+    with pytest.raises(InvalidParameters):
+        Parameters(timeout_backoff=0.5)
+    with pytest.raises(InvalidParameters):
+        Parameters(timeout_delay=5_000, timeout_cap_ms=1_000)
+    with pytest.raises(InvalidParameters):
+        Parameters.from_json({"timeout_backoff": 0.9})
+    # the reference-parity fixed timer (backoff exactly 1.0) stays legal
+    Parameters(timeout_backoff=1.0)
+
+
+def test_leader_cache_distinguishes_same_epoch_committees():
+    """ADVICE r3: the elector's key cache must never alias two distinct
+    committee objects — including schedule entries that share the
+    default epoch number (legal in existing committee files)."""
+    from hotstuff_tpu.consensus.config import CommitteeSchedule
+    from hotstuff_tpu.consensus.leader import RoundRobinLeaderElector
+
+    base = fresh_base_port()
+    c1 = committee(base)
+    # a second epoch with the SAME default epoch number but its members
+    # rotated: the leader sequence must follow the active committee
+    c2 = committee(base + 100)
+    drop = c2.sorted_keys()[0]
+    del c2.authorities[drop]
+    schedule = CommitteeSchedule([(1, c1), (100, c2)])
+    elector = RoundRobinLeaderElector(schedule)
+    assert elector.get_leader(4) in c1.authorities
+    assert elector.get_leader(4) == c1.sorted_keys()[4 % 4]
+    assert elector.get_leader(103) == c2.sorted_keys()[103 % 3]
+    assert elector.get_leader(103) != drop
+
+
+def test_proposer_inflight_bound_requeues_oldest():
+    """ADVICE r3: inflight must not grow without bound when commit
+    signals stall — the oldest undecided proposal's payloads return to
+    the buffer instead."""
+    import logging
+    from collections import OrderedDict
+
+    import hotstuff_tpu.consensus.proposer as P
+    from hotstuff_tpu.consensus.proposer import Proposer
+    from hotstuff_tpu.crypto import Digest
+
+    proposer = Proposer.__new__(Proposer)  # state-only exercise
+    proposer.pending = OrderedDict()
+    proposer.committed_seen = OrderedDict()
+    proposer.inflight = {}
+    proposer.log = logging.getLogger("test-proposer")
+
+    digests = [Digest(bytes([i]) * 32) for i in range(8)]
+    for r in range(1, 6):
+        proposer.inflight[r] = (digests[r],)
+    proposer.committed_seen[digests[1]] = None  # round 1's payload committed
+    old_cap = P.MAX_INFLIGHT
+    P.MAX_INFLIGHT = 3
+    try:
+        while len(proposer.inflight) > P.MAX_INFLIGHT:
+            proposer._requeue_oldest_inflight()
+    finally:
+        P.MAX_INFLIGHT = old_cap
+    assert set(proposer.inflight) == {3, 4, 5}
+    # round 1's payload was already committed -> NOT re-buffered;
+    # round 2's was orphan-requeued
+    assert list(proposer.pending) == [digests[2]]
+
+
 @async_test
 async def test_helper_replies_to_sync_request(tmp_path):
     """Helper reads the requested block and sends it back as a Propose
