@@ -66,6 +66,11 @@ class QCMaker:
         # Protected cells (the digest this node itself voted for) are
         # never evicted.
         self.protected = False
+        # Entries whose signature was NOT individually pre-verified on
+        # entry (async-preverify path, core._preverify_burst).  When
+        # empty at quorum, the batch dispatch is skipped — every
+        # signature in the certificate already passed.
+        self.unverified: set[PublicKey] = set()
 
     def append(
         self,
@@ -73,6 +78,7 @@ class QCMaker:
         committee: Committee,
         verifier: VerifierBackend,
         stake: int | None = None,
+        sig_verified: bool = False,
     ) -> QC | None:
         author = vote.author
         if author in self.used:
@@ -84,26 +90,34 @@ class QCMaker:
             # was already counted). Without the swap, whichever message
             # wins the race would decide whether the honest vote ever
             # counts (vote-suppression attack).
-            self._maybe_replace(vote, verifier)
+            self._maybe_replace(vote, verifier, incoming_verified=sig_verified)
             raise AuthorityReuse(author)
         if stake is None:
             stake = committee.stake(author)
         if stake <= 0:
             raise UnknownAuthority(author)
-        if author in self.suspect:
+        if sig_verified:
+            self.verified = True
+        elif author in self.suspect:
             # this author's slot was already poisoned once — pay one eager
             # verify instead of trusting the deferred batch again
             if not verifier.verify_one(vote.digest(), author, vote.signature):
                 raise InvalidSignature(f"bad signature on vote {vote!r}")
             self.verified = True
+        else:
+            self.unverified.add(author)
         self.used.add(author)
         self.votes.append((author, vote.signature))
         self.weight += stake
         if self.weight < committee.quorum_threshold():
             return None
 
-        # Quorum reached: dispatch the whole set as one batch.
-        if not verifier.verify_shared_msg(vote.digest(), self.votes):
+        # Quorum reached: dispatch the whole set as one batch — unless
+        # every entry was already individually pre-verified (the async
+        # preverify path), in which case the certificate is proven.
+        if self.unverified and not verifier.verify_shared_msg(
+            vote.digest(), self.votes
+        ):
             self._evict_invalid(vote.digest(), committee, verifier)
             if self.weight < committee.quorum_threshold():
                 return None  # keep accumulating
@@ -127,14 +141,18 @@ class QCMaker:
             return True
         return False
 
-    def _maybe_replace(self, vote: Vote, verifier: VerifierBackend) -> None:
+    def _maybe_replace(
+        self, vote: Vote, verifier: VerifierBackend,
+        incoming_verified: bool = False,
+    ) -> None:
         for i, (pk, sig) in enumerate(self.votes):
             if pk != vote.author:
                 continue
             if sig == vote.signature:
                 return  # true duplicate
-            if verifier.verify_one(
-                vote.digest(), vote.author, vote.signature
+            if (
+                incoming_verified
+                or verifier.verify_one(vote.digest(), vote.author, vote.signature)
             ) and not verifier.verify_one(vote.digest(), pk, sig):
                 log.warning(
                     "Replacing spoofed vote signature naming %s with the "
@@ -142,6 +160,7 @@ class QCMaker:
                     pk,
                 )
                 self.votes[i] = (vote.author, vote.signature)
+                self.unverified.discard(pk)
             return
 
     def _evict_invalid(
@@ -161,6 +180,8 @@ class QCMaker:
                 self.used.discard(pk)
                 self.suspect.add(pk)
         self.votes = [v for v, valid in zip(self.votes, ok) if valid]
+        # every survivor just passed a per-signature check
+        self.unverified.clear()
         self.weight = sum(committee.stake(pk) for pk, _ in self.votes)
         if self.votes:
             self.verified = True  # survivors passed per-signature checks
@@ -231,7 +252,16 @@ class Aggregator:
         # honest votes.  Bounded: one vote per author per round.
         self.parked: dict[Round, dict[PublicKey, Vote]] = {}
 
-    def add_vote(self, vote: Vote, current_round: Round | None = None) -> QC | None:
+    def add_vote(
+        self,
+        vote: Vote,
+        current_round: Round | None = None,
+        sig_verified: bool = False,
+    ) -> QC | None:
+        """``sig_verified=True``: the vote's signature was individually
+        pre-verified (async burst preverify or a self-signed vote) — the
+        cell skips deferred-batch bookkeeping for it and, when every
+        entry arrived pre-verified, emits the QC without a quorum batch."""
         if (
             current_round is not None
             and vote.round > current_round + ROUND_LOOKAHEAD
@@ -249,8 +279,12 @@ class Aggregator:
         maker = makers.get(digest)
         created = maker is None
         if created:
-            maker = self._admit_cell(vote, digest, makers)
-        qc = maker.append(vote, com, self.verifier, stake=stake)
+            maker = self._admit_cell(
+                vote, digest, makers, sig_verified=sig_verified
+            )
+        qc = maker.append(
+            vote, com, self.verifier, stake=stake, sig_verified=sig_verified
+        )
         if created and maker.protected:
             qc = self._replay_parked(vote.round, digest, maker) or qc
         return qc
@@ -279,7 +313,11 @@ class Aggregator:
         return qc
 
     def _admit_cell(
-        self, vote: Vote, digest: Digest, makers: dict[Digest, QCMaker]
+        self,
+        vote: Vote,
+        digest: Digest,
+        makers: dict[Digest, QCMaker],
+        sig_verified: bool = False,
     ) -> QCMaker:
         """Create a new digest cell, charging for it when it isn't the first.
 
@@ -296,7 +334,9 @@ class Aggregator:
         own = self.self_key is not None and vote.author == self.self_key
         verified = False
         if makers and not own:
-            if not self.verifier.verify_one(digest, vote.author, vote.signature):
+            if not sig_verified and not self.verifier.verify_one(
+                digest, vote.author, vote.signature
+            ):
                 raise InvalidSignature(f"bad signature on vote {vote!r}")
             payers = self.cell_payers.setdefault(vote.round, set())
             if vote.author in payers:

@@ -145,11 +145,44 @@ class QC:
             parts.append(sig.data)
         return sha512_trunc(b"".join(parts))
 
+    def check_weight(self, committee: Committee) -> None:
+        """The stake/structure rules alone (no signatures): authority
+        reuse, unknown authorities, 2f+1 stake — under this
+        certificate's own round's committee."""
+        committee = committee.for_round(self.round)  # epoch seam
+        _check_certificate_weight(
+            [pk for pk, _ in self.votes], committee, QCRequiresQuorum
+        )
+
+    def claims(self, cache: set | None = None) -> list:
+        """The signature claims an async preverifier must discharge for
+        this certificate (crypto/async_service.py): one shared-message
+        claim, or none when genesis / already memoized in ``cache``.
+
+        SAFETY: a successful claim verdict proves only the SIGNATURES.
+        A caller that memoizes this certificate as verified (the core's
+        qc_cache — ``verify`` early-returns on a hit) must check
+        ``check_weight`` FIRST, or a sub-quorum certificate with one
+        valid self-signature would enter the cache and bypass the
+        quorum rule forever."""
+        if self.is_genesis():
+            return []
+        if cache is not None and self._cache_key() in cache:
+            return []
+        return [
+            (
+                "shared",
+                self.digest().to_bytes(),
+                tuple((pk.to_bytes(), sig.to_bytes()) for pk, sig in self.votes),
+            )
+        ]
+
     def verify(
         self,
         committee: Committee,
         verifier: VerifierBackend,
         cache: set | None = None,
+        sigs_verified: bool = False,
     ) -> None:
         """``cache`` (per-core, optional) memoizes certificates that
         already verified against THIS committee: under a view-change
@@ -157,19 +190,22 @@ class QC:
         without the memo the node re-runs the identical batch
         verification n times (n x the most expensive check in the
         protocol).  Only successes are cached; the set is bounded by the
-        owner (core.py)."""
+        owner (core.py).
+
+        ``sigs_verified=True``: the caller already discharged this
+        certificate's signature ``claims()`` through the async
+        preverifier — only the stake/structure rules run here."""
         key = None
         if cache is not None:
             key = self._cache_key()
             if key in cache:
                 return
-        committee = committee.for_round(self.round)  # epoch seam
-        _check_certificate_weight(
-            [pk for pk, _ in self.votes], committee, QCRequiresQuorum
-        )
+        self.check_weight(committee)
         # One batched verification over the shared vote digest — the hot
         # kernel (reference messages.rs:195 → crypto verify_batch).
-        if not verifier.verify_shared_msg(self.digest(), self.votes):
+        if not sigs_verified and not verifier.verify_shared_msg(
+            self.digest(), self.votes
+        ):
             raise InvalidSignature(f"bad signature in QC for {self.hash}")
         if cache is not None:
             cache.add(key)
@@ -214,11 +250,46 @@ class TC:
     def high_qc_rounds(self) -> list[Round]:
         return [r for _, _, r in self.votes]
 
-    def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
+    def claims(self) -> list:
+        """Signature claims for the async preverifier: entries signing
+        the SAME timeout digest (same high_qc_round — the common storm
+        shape) group into shared claims so aggregate-preferring backends
+        (BLS) pay one check per group; distinct rounds become single
+        claims."""
+        groups: dict[Round, list] = {}
+        for pk, sig, hq_round in self.votes:
+            groups.setdefault(hq_round, []).append((pk, sig))
+        out = []
+        for hq_round, members in groups.items():
+            digest = timeout_digest(self.round, hq_round).to_bytes()
+            if len(members) == 1:
+                pk, sig = members[0]
+                out.append(("one", digest, pk.to_bytes(), sig.to_bytes()))
+            else:
+                out.append(
+                    (
+                        "shared",
+                        digest,
+                        tuple(
+                            (pk.to_bytes(), sig.to_bytes())
+                            for pk, sig in members
+                        ),
+                    )
+                )
+        return out
+
+    def verify(
+        self,
+        committee: Committee,
+        verifier: VerifierBackend,
+        sigs_verified: bool = False,
+    ) -> None:
         committee = committee.for_round(self.round)  # epoch seam
         _check_certificate_weight(
             [pk for pk, _, _ in self.votes], committee, TCRequiresQuorum
         )
+        if sigs_verified:
+            return  # claims() discharged by the async preverifier
         # Each entry signs a different digest (its own high_qc_round), so
         # this is the distinct-message batch shape (reference verifies these
         # sequentially, messages.rs:305-311 — here one dispatched batch).
@@ -313,11 +384,29 @@ class Block:
             self._digest = d
         return d
 
+    def claims(self, qc_cache: set | None = None) -> list:
+        """Signature claims for the async preverifier: the author
+        signature, the embedded QC (unless memoized), and the embedded
+        TC's entries."""
+        out = [
+            (
+                "one",
+                self.digest().to_bytes(),
+                self.author.to_bytes(),
+                self.signature.to_bytes(),
+            )
+        ]
+        out.extend(self.qc.claims(cache=qc_cache))
+        if self.tc is not None:
+            out.extend(self.tc.claims())
+        return out
+
     def verify(
         self,
         committee: Committee,
         verifier: VerifierBackend,
         qc_cache: set | None = None,
+        sigs_verified: bool = False,
     ) -> None:
         # Epoch seam: the author is judged by the block round's
         # committee; each embedded certificate routes ITSELF to its own
@@ -330,12 +419,16 @@ class Block:
             raise UnknownAuthority(self.author)
         if len(self.payloads) > MAX_BLOCK_PAYLOADS:
             raise MalformedBlock(self.digest())
-        if not verifier.verify_one(self.digest(), self.author, self.signature):
+        if not sigs_verified and not verifier.verify_one(
+            self.digest(), self.author, self.signature
+        ):
             raise InvalidSignature(f"bad author signature on block {self}")
         if not self.qc.is_genesis():
-            self.qc.verify(committee, verifier, cache=qc_cache)
+            self.qc.verify(
+                committee, verifier, cache=qc_cache, sigs_verified=sigs_verified
+            )
         if self.tc is not None:
-            self.tc.verify(committee, verifier)
+            self.tc.verify(committee, verifier, sigs_verified=sigs_verified)
 
     def encode(self, enc: Encoder) -> None:
         self.qc.encode(enc)
@@ -409,6 +502,15 @@ class Vote:
             )
             self._digest = d
         return d
+
+    def claim(self) -> tuple:
+        """This vote's signature claim for the async preverifier."""
+        return (
+            "one",
+            self.digest().to_bytes(),
+            self.author.to_bytes(),
+            self.signature.to_bytes(),
+        )
 
     def verify(self, committee: Committee, verifier: VerifierBackend) -> None:
         if committee.for_round(self.round).stake(self.author) <= 0:

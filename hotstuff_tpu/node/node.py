@@ -30,40 +30,97 @@ from .config import ConfigError, Secret, read_committee, read_parameters
 log = logging.getLogger(__name__)
 
 
+class _DeviceDispatch:
+    """Forced-device view of a shared BatchVerifier for the async verify
+    service (crypto/async_service.py): the service makes the
+    device-vs-CPU routing decision itself, so this view must never
+    silently re-route a batch back to the host the way the hybrid
+    ``verify_many`` would.  One instance per device kind, process-wide —
+    its identity is the coalescing key: every in-process core's claims
+    land in the same dispatch stream."""
+
+    def __init__(self, device):
+        self._device = device
+        self.name = getattr(device, "name", "tpu")
+
+    def verify_many(
+        self, digests, pks, sigs, aggregate_ok: bool = False
+    ) -> list:
+        return [bool(v) for v in self._device.verify_device(digests, pks, sigs)]
+
+
 class LazyDeviceVerifier:
     """Defers the jax/numpy import (seconds of interpreter time per node
     process, serialized across a co-located committee sharing few cores)
     until a batch is actually big enough for the device.  Small batches
     route to the CPU backend exactly like the device verifier's own
     hybrid routing, so committees whose batches never reach
-    ``min_device_batch`` boot and run without ever importing jax."""
+    ``min_device_batch`` boot and run without ever importing jax.
+
+    The materialized device verifier is shared per kind, process-wide:
+    an in-process committee holds ONE point cache and ONE compiled
+    kernel set, and the async verify service (``async_backend``)
+    coalesces every core's claims into one dispatch stream."""
 
     min_device_batch = 64
+
+    _shared_device: dict[str, VerifierBackend] = {}
+    _shared_dispatch: dict[str, _DeviceDispatch] = {}
+    # kinds whose device kernel has been warmed (compiled/cache-loaded)
+    # in THIS process — the async service routes to the device only then
+    _warm: set[str] = set()
 
     def __init__(self, kind: str):
         self._kind = kind
         self._cpu = CpuVerifier()
-        self._device: VerifierBackend | None = None
         self._precomputed: list[bytes] = []
         self.name = kind
+        # Advertises the async off-loop claim path to AsyncVerifyService
+        # (one coalescing service per kind per loop).
+        self.async_kind = kind
+
+    @property
+    def cpu_backend(self) -> CpuVerifier:
+        return self._cpu
+
+    @property
+    def device_ready(self) -> bool:
+        """True once the device kernel is warm — the async service must
+        never trigger a cold jax import or Mosaic compile mid-consensus."""
+        return self._kind in self._warm
+
+    @property
+    def _device(self) -> VerifierBackend | None:
+        return self._shared_device.get(self._kind)
 
     def _materialize(self) -> VerifierBackend:
-        if self._device is None:
+        device = self._shared_device.get(self._kind)
+        if device is None:
             if self._kind == "tpu":
                 from ..tpu.ed25519 import BatchVerifier
 
-                self._device = BatchVerifier(
-                    min_device_batch=self.min_device_batch
-                )
+                device = BatchVerifier(min_device_batch=self.min_device_batch)
             else:  # tpu-sharded: batch sharded over every visible device
                 from ..parallel.mesh import ShardedBatchVerifier
 
-                self._device = ShardedBatchVerifier(
+                device = ShardedBatchVerifier(
                     min_device_batch=self.min_device_batch
                 )
-            if self._precomputed:
-                self._device.precompute(self._precomputed)
-        return self._device
+            self._shared_device[self._kind] = device
+        if self._precomputed:
+            device.precompute(self._precomputed)
+            self._precomputed = []
+        return device
+
+    @property
+    def async_backend(self) -> _DeviceDispatch:
+        """The shared forced-device dispatch view (one per kind) the
+        async verify service coalesces on."""
+        dispatch = self._shared_dispatch.get(self._kind)
+        if dispatch is None:
+            dispatch = _DeviceDispatch(self._materialize())
+            self._shared_dispatch[self._kind] = dispatch
+        return dispatch
 
     def precompute(self, pubkeys: list[bytes]) -> None:
         self._precomputed = list(pubkeys)
@@ -71,7 +128,10 @@ class LazyDeviceVerifier:
             self._device.precompute(pubkeys)
 
     def warmup(self, batch: int | None = None) -> None:
+        if self._kind in self._warm:
+            return  # the shared device instance is already warm
         self._materialize().warmup(batch)
+        self._warm.add(self._kind)
 
     def verify_one(self, digest, pk, sig) -> bool:
         return self._cpu.verify_one(digest, pk, sig)
@@ -172,8 +232,20 @@ class Node:
                 [pk.to_bytes() for pk in committee.authorities]
             )
         committee_size = len(committee.authorities)
-        if hasattr(verifier, "warmup") and committee_size >= getattr(
-            verifier, "min_device_batch", 0
+        # Nodes co-located in this process (run-many sets the hint): their
+        # verification claims coalesce into ONE dispatch stream, so the
+        # device pays off far below the per-node min_device_batch and the
+        # warm shapes must cover whole-committee waves.
+        colocated = int(os.environ.get("HOTSTUFF_COLOCATED_NODES", "1") or 1)
+        # HOTSTUFF_SKIP_WARMUP (diagnostic): run the device-verifier
+        # plumbing with jax never imported — the service's ready gate
+        # keeps everything on CPU.  Must skip the WHOLE warmup block,
+        # not just the co-location boost.
+        if hasattr(verifier, "warmup") and not os.environ.get(
+            "HOTSTUFF_SKIP_WARMUP"
+        ) and (
+            committee_size >= getattr(verifier, "min_device_batch", 0)
+            or colocated > 1
         ):
             # compile/cache-load the device kernel BEFORE binding the
             # consensus port: a cold compile on the first QC verify
@@ -182,7 +254,13 @@ class Node:
             # invisible to the measured window).  Skipped when every
             # possible batch (<= committee size) routes to the CPU
             # hybrid path anyway — then the kernel is never dispatched.
-            verifier.warmup(batch=committee_size)
+            quorum = committee_size * 2 // 3 + 1
+            wave = (
+                committee_size
+                if colocated <= 1
+                else min(1024, colocated * (quorum + 2))
+            )
+            verifier.warmup(batch=wave)
 
         stats_task = None
         if os.environ.get("HOTSTUFF_WORK_STATS"):

@@ -127,6 +127,25 @@ class BatchVerifier:
     def __init__(self, min_device_batch: int = 64, use_pallas: bool | None = None):
         # pk bytes -> (ax, ay, az, at) limb rows of the negated point, or None
         self._point_cache: dict[bytes, tuple | None] = {}
+        # Vectorized prepare path: pk bytes -> row index into the stacked
+        # point table (row 0 is a zero dummy for invalid items), rebuilt
+        # lazily when new keys enter the cache.  Committee keys are fixed
+        # per epoch, so steady state is one fancy-index gather per batch
+        # instead of a per-item Python copy loop (measured 11-28 ms of
+        # GIL-held prep per 736-sig wave before this).
+        self._row_index: dict[bytes, int] = {}
+        # the published build: (coordinate tables, row index) or None.
+        # _table_lock serializes cache inserts/invalidation and rebuilds:
+        # this object is shared between the event loop and the async
+        # verify service's worker thread, and an unlocked rebuild racing
+        # an insert can either crash (dict changed size during
+        # iteration) or publish a build missing the new key while
+        # clobbering the staleness marker — after which that key's valid
+        # signatures map to the zero dummy row forever.
+        import threading
+
+        self._table_lock = threading.Lock()
+        self._tables: tuple | None = None
         # The Pallas VMEM-resident kernel is the fast path on real TPU
         # hardware; the XLA kernel is the portable fallback (CPU tests,
         # sharded-mesh subclass).  use_pallas=None defers autodetection
@@ -204,8 +223,38 @@ class BatchVerifier:
         if hit is None and pk not in self._point_cache:
             p = ref.point_decompress(pk)
             hit = None if p is None else curve.point_to_limbs(ref.point_neg(p))
-            self._point_cache[pk] = hit
+            with self._table_lock:
+                self._point_cache[pk] = hit
+                self._tables = None  # stacked table is stale
         return hit
+
+    def _rebuild_tables(self):
+        """Build (tables, row_index) FULLY in locals, then publish with
+        one atomic assignment — this object is shared across the event
+        loop and the async verify service's worker thread, so a reader
+        must never observe a partially-built index (a torn index maps a
+        valid key to the zero row and an honest signature reports
+        invalid).  Readers snapshot ``self._tables`` once and use only
+        that build."""
+        with self._table_lock:
+            valid = [
+                (pk, pt)
+                for pk, pt in self._point_cache.items()
+                if pt is not None
+            ]
+            k = len(valid) + 1
+            tables = tuple(
+                np.zeros((k, F.NLIMBS), np.int32) for _ in range(4)
+            )
+            row_index: dict[bytes, int] = {}
+            for row, (pk, pt) in enumerate(valid, start=1):
+                row_index[pk] = row
+                for t, coord in zip(tables, pt):
+                    t[row] = coord
+            build = (tables, row_index)
+            self._tables = build
+            self._row_index = row_index
+            return build
 
     def _prepare_item(self, msg, pk, sig):
         """Per-item acceptance rules for batch preparation.  Returns
@@ -239,12 +288,27 @@ class BatchVerifier:
 
                 self._cpu = batch_verify_arrays
             return np.asarray(self._cpu(messages, pubkeys, signatures))
+        return self.verify_device(messages, pubkeys, signatures)
+
+    def verify_device(
+        self,
+        messages: list[bytes],
+        pubkeys: list[bytes],
+        signatures: list[bytes],
+    ) -> np.ndarray:
+        """Per-item validity, forced onto the device kernel regardless of
+        ``min_device_batch`` — for callers that already made the
+        device-vs-CPU routing decision (the async verify service's
+        adaptive dispatcher)."""
+        n = len(messages)
+        if n == 0:
+            return np.zeros(0, bool)
         if n > self._padded_sizes()[-1]:
             # split oversized batches into max-shape chunks
             step = self._padded_sizes()[-1]
             return np.concatenate(
                 [
-                    self.verify(
+                    self.verify_device(
                         messages[i : i + step],
                         pubkeys[i : i + step],
                         signatures[i : i + step],
@@ -288,10 +352,6 @@ class BatchVerifier:
         ``_run_kernel`` directly."""
         n = len(messages)
         valid_host = np.ones(n, bool)  # host-side rejections
-        ax = np.zeros((n, F.NLIMBS), np.int32)
-        ay = np.zeros((n, F.NLIMBS), np.int32)
-        az = np.zeros((n, F.NLIMBS), np.int32)
-        at = np.zeros((n, F.NLIMBS), np.int32)
         scalar_bytes_s = np.zeros((n, 32), np.uint8)
         scalar_bytes_k = np.zeros((n, 32), np.uint8)
         r_bytes = np.zeros((n, 32), np.uint8)
@@ -302,14 +362,31 @@ class BatchVerifier:
             if item is None:
                 valid_host[i] = False
                 continue
-            pt, s, k = item
-            ax[i], ay[i], az[i], at[i] = pt
+            _pt, s, k = item
             scalar_bytes_s[i] = np.frombuffer(sig[32:], np.uint8)
             scalar_bytes_k[i] = np.frombuffer(
                 k.to_bytes(32, "little"), np.uint8
             )
             r_bytes[i] = np.frombuffer(sig[:32], np.uint8)
             r_sign[i] = sig[31] >> 7
+
+        # point rows: ONE fancy-index gather from the stacked committee
+        # tables (index 0 = zero dummy for invalid items — their scalars
+        # are zero too, so the kernel computes the identity and
+        # valid_host masks the lane out, exactly as before).  Snapshot
+        # the build once: any build taken here post-dates this batch's
+        # cache inserts (the item loop above decompressed every key
+        # BEFORE invalidating), so row_of covers every valid pk in the
+        # batch even if another thread rebuilds concurrently.
+        build = self._tables
+        if build is None:
+            build = self._rebuild_tables()
+        tables, row_of = build
+        idxs = np.zeros(n, np.int64)
+        for i, pk in enumerate(pubkeys):
+            if valid_host[i]:
+                idxs[i] = row_of.get(pk, 0)
+        ax, ay, az, at = (t[idxs] for t in tables)
 
         # scalars -> MSB-first window planes [n, NWIN]
         s_bits = _bytes_to_windows_msb(scalar_bytes_s)

@@ -1,0 +1,108 @@
+"""Probe the rig's per-dispatch cost anatomy (VERDICT r3 item 1 groundwork).
+
+The round-3 bench recorded a flat ~112 ms rig p50 per QC-verify dispatch
+regardless of batch size, while the in-dispatch device time is 0.2-0.5 ms.
+Before redesigning the consensus integration, decompose that fixed cost:
+
+  - h2d: host->device transfer round trip (jax.device_put + wait)
+  - exec: dispatch of an already-resident computation (args on device)
+  - d2h: result fetch (np.asarray on a device array)
+  - e2e: the production-shaped call (numpy args in, bool out)
+  - pipelined: N async dispatches issued back-to-back, one final block —
+    does the tunnel pipeline them (cost ~1 RTT) or serialize (~N RTT)?
+
+Run:  python scripts/probe_dispatch.py
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def q(xs):
+    xs = sorted(xs)
+    return {
+        "p50": round(xs[len(xs) // 2] * 1000, 2),
+        "min": round(xs[0] * 1000, 2),
+        "max": round(xs[-1] * 1000, 2),
+    }
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices())
+    dev = jax.devices()[0]
+
+    @jax.jit
+    def f(x):
+        return (x * 2 + 1).sum(axis=1)
+
+    x_host = np.ones((256, 20), np.int32)
+    x_dev = jax.device_put(x_host, dev)
+    jax.block_until_ready(f(x_dev))  # compile
+
+    N = 15
+
+    h2d = []
+    for _ in range(N):
+        t = time.perf_counter()
+        jax.block_until_ready(jax.device_put(x_host, dev))
+        h2d.append(time.perf_counter() - t)
+
+    ex = []
+    for _ in range(N):
+        t = time.perf_counter()
+        jax.block_until_ready(f(x_dev))
+        ex.append(time.perf_counter() - t)
+
+    y = f(x_dev)
+    jax.block_until_ready(y)
+    d2h = []
+    for _ in range(N):
+        t = time.perf_counter()
+        np.asarray(y)
+        d2h.append(time.perf_counter() - t)
+
+    e2e = []
+    for _ in range(N):
+        t = time.perf_counter()
+        np.asarray(f(x_host))
+        e2e.append(time.perf_counter() - t)
+
+    # pipelining: issue K dispatches without blocking, then block once
+    pipe = {}
+    for k in (1, 4, 16):
+        ts = []
+        for _ in range(N):
+            t = time.perf_counter()
+            outs = [f(x_dev) for _ in range(k)]
+            jax.block_until_ready(outs)
+            ts.append(time.perf_counter() - t)
+        pipe[k] = q(ts)
+
+    # many-arg dispatch (the production kernel takes 8 arrays): does each
+    # host numpy arg add a separate transfer round trip?
+    @jax.jit
+    def g(a, b, c, d, e, f_, g_, h):
+        return (a + b + c + d + e + f_ + g_ + h).sum(axis=1)
+
+    args = [np.ones((256, 20), np.int32) for _ in range(8)]
+    jax.block_until_ready(g(*args))
+    many = []
+    for _ in range(N):
+        t = time.perf_counter()
+        np.asarray(g(*args))
+        many.append(time.perf_counter() - t)
+
+    print("h2d (device_put 20KB):", q(h2d))
+    print("exec (resident args):", q(ex))
+    print("d2h (np.asarray 1KB):", q(d2h))
+    print("e2e 1-arg (numpy in, numpy out):", q(e2e))
+    print("e2e 8-arg:", q(many))
+    print("pipelined exec:", pipe)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Second dispatch probe: is the ~125 ms per-dispatch cost a fixed
+tunnel RTT, or load-state-dependent (fast when idle, slow under
+sustained dispatch)?  Measures the same resident-arg exec at different
+points and paces.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+
+def q(xs):
+    xs = sorted(xs)
+    return {
+        "p50": round(xs[len(xs) // 2] * 1000, 2),
+        "min": round(xs[0] * 1000, 2),
+        "max": round(xs[-1] * 1000, 2),
+    }
+
+
+def main():
+    dev = jax.devices()[0]
+
+    @jax.jit
+    def f(x):
+        return (x * 2 + 1).sum(axis=1)
+
+    x_dev = jax.device_put(np.ones((256, 20), np.int32), dev)
+    jax.block_until_ready(f(x_dev))
+
+    def burst(n, sleep=0.0, label=""):
+        ts = []
+        for _ in range(n):
+            t = time.perf_counter()
+            jax.block_until_ready(f(x_dev))
+            ts.append(time.perf_counter() - t)
+            if sleep:
+                time.sleep(sleep)
+        print(f"{label}: {q(ts)}  (n={n}, sleep={sleep})")
+        return ts
+
+    burst(20, 0, "cold-ish back-to-back")
+    time.sleep(2)
+    burst(20, 0, "after 2s idle, back-to-back")
+    burst(20, 0.1, "paced 100ms")
+    burst(20, 0.02, "paced 20ms")
+    time.sleep(2)
+    # async issue then single wait: measure issue cost vs wait cost
+    for k in (8,):
+        t0 = time.perf_counter()
+        outs = [f(x_dev) for _ in range(k)]
+        t1 = time.perf_counter()
+        jax.block_until_ready(outs)
+        t2 = time.perf_counter()
+        print(
+            f"async x{k}: issue={round((t1-t0)*1e3,2)}ms "
+            f"wait={round((t2-t1)*1e3,2)}ms"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Third probe: isolate implicit-host-arg transfer vs resident args,
+interleaved A/B/A/B so tunnel weather can't confound the comparison.
+Also times explicit device_put of all args + call, and the production
+BatchVerifier.verify path at QC shapes.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+
+def q(xs):
+    xs = sorted(xs)
+    return {
+        "p50": round(xs[len(xs) // 2] * 1000, 2),
+        "min": round(xs[0] * 1000, 2),
+        "max": round(xs[-1] * 1000, 2),
+    }
+
+
+def main():
+    dev = jax.devices()[0]
+
+    @jax.jit
+    def g(a, b, c, d, e, f_, g_, h):
+        return (a + b + c + d + e + f_ + g_ + h).sum(axis=1)
+
+    host_args = [np.ones((256, 20), np.int32) for _ in range(8)]
+    dev_args = [jax.device_put(a, dev) for a in host_args]
+    jax.block_until_ready(g(*dev_args))
+
+    N = 12
+    res, imp, put = [], [], []
+    for _ in range(N):
+        t = time.perf_counter()
+        np.asarray(g(*dev_args))
+        res.append(time.perf_counter() - t)
+
+        t = time.perf_counter()
+        np.asarray(g(*host_args))
+        imp.append(time.perf_counter() - t)
+
+        t = time.perf_counter()
+        moved = [jax.device_put(a, dev) for a in host_args]
+        np.asarray(g(*moved))
+        put.append(time.perf_counter() - t)
+
+    print("resident args:", q(res))
+    print("implicit host args:", q(imp))
+    print("explicit device_put then call:", q(put))
+
+    # production path at QC shapes
+    from hotstuff_tpu.crypto import ed25519_ref as ref
+    from hotstuff_tpu.tpu.ed25519 import BatchVerifier
+
+    seed = b"\x11" * 32
+    msg = b"probe3"
+    pk = ref.public_from_seed(seed)
+    sig = ref.sign(seed, msg)
+    v = BatchVerifier(min_device_batch=0)
+    v.verify([msg] * 22, [pk] * 22, [sig] * 22)  # warm 128-pad shape
+    prod = []
+    for _ in range(N):
+        t = time.perf_counter()
+        out = v.verify([msg] * 22, [pk] * 22, [sig] * 22)
+        prod.append(time.perf_counter() - t)
+        assert out.all()
+    print("BatchVerifier.verify 22 sigs (pad 128):", q(prod))
+
+
+if __name__ == "__main__":
+    main()

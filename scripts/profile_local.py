@@ -1,0 +1,139 @@
+"""Profile an in-process committee run (VERDICT r3 item 6: decompose the
+~20 ms local consensus-latency floor at 4 nodes / 1k tx/s).
+
+Runs the whole committee (run-many shape) under cProfile in THIS process
+while a client subprocess drives load, then prints the top functions by
+cumulative and total time, plus a bucketed per-stage summary (crypto,
+store, framing/network, asyncio machinery, serialization).
+
+    python scripts/profile_local.py [--nodes 4] [--rate 1000] [--duration 10]
+"""
+
+import argparse
+import asyncio
+import cProfile
+import os
+import pstats
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+BUCKETS = {
+    "crypto": ("crypto/", "tpu/", "_verify", "sign"),
+    "store": ("store/",),
+    "network": ("framing", "network/", "streams.py", "selector_events"),
+    "serialization": ("codec", "wire.py", "messages.py"),
+    "consensus": ("core.py", "proposer.py", "aggregator.py", "synchronizer"),
+    "asyncio": ("asyncio/",),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rate", type=int, default=1000)
+    ap.add_argument("--duration", type=float, default=10.0)
+    args = ap.parse_args()
+
+    from benchmark.local import LocalBench
+    from benchmark.logs import LogParser
+    from benchmark.utils import PathMaker
+    from hotstuff_tpu.node.main import setup_logging
+    from hotstuff_tpu.node.node import Node
+
+    bench = LocalBench(nodes=args.nodes, rate=args.rate, duration=args.duration)
+    bench._cleanup_files()
+    bench._config()
+    setup_logging(2)
+    # route node logs to the log file the parser expects
+    import logging
+
+    handler = logging.FileHandler(PathMaker.node_log_file(0))
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s.%(msecs)03dZ [%(levelname)s] %(name)s %(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        )
+    )
+    logging.getLogger().addHandler(handler)
+
+    client = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "hotstuff_tpu.node.client",
+            "--committee",
+            PathMaker.committee_file(),
+            "--rate",
+            str(args.rate),
+            "--duration",
+            str(args.duration),
+            "--warmup",
+            "1",
+        ],
+        stdout=open(PathMaker.client_log_file(), "w"),
+        stderr=subprocess.STDOUT,
+        env={**os.environ, "PYTHONPATH": "."},
+    )
+
+    async def committee():
+        nodes = []
+        for i in range(args.nodes):
+            nodes.append(
+                await Node.new(
+                    committee_file=PathMaker.committee_file(),
+                    key_file=PathMaker.key_file(i),
+                    store_path=PathMaker.db_path(i),
+                    parameters_file=PathMaker.parameters_file(),
+                    bind_host="127.0.0.1",
+                )
+            )
+        drain = asyncio.gather(*(n.analyze_block() for n in nodes))
+        await asyncio.sleep(args.duration + 3)
+        drain.cancel()
+        for n in nodes:
+            try:
+                await n.shutdown()
+            except Exception:
+                pass
+
+    prof = cProfile.Profile()
+    t0 = time.time()
+    prof.enable()
+    asyncio.run(committee())
+    prof.disable()
+    wall = time.time() - t0
+    client.wait(timeout=10)
+
+    stats = pstats.Stats(prof)
+    stats.sort_stats("cumulative")
+    print(f"=== wall: {wall:.1f}s ===")
+    stats.print_stats(25)
+
+    # bucket tottime by module
+    totals: dict[str, float] = {k: 0.0 for k in BUCKETS}
+    other = 0.0
+    grand = 0.0
+    for (file, _line, _fn), (_cc, _nc, tt, _ct, _callers) in stats.stats.items():
+        grand += tt
+        for bucket, pats in BUCKETS.items():
+            if any(p in file for p in pats):
+                totals[bucket] += tt
+                break
+        else:
+            other = other + tt
+    print("\n=== tottime buckets (s) ===")
+    for k, v in sorted(totals.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:14s} {v:7.2f}  ({100*v/max(grand,1e-9):.0f}%)")
+    print(f"  {'other':14s} {other:7.2f}  ({100*other/max(grand,1e-9):.0f}%)")
+    print(f"  {'total':14s} {grand:7.2f}  (wall {wall:.1f}s)")
+
+    parser = LogParser.process(PathMaker.logs_path())
+    print(parser.result(faults=0, nodes=args.nodes, verifier="cpu-profiled"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

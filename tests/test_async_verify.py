@@ -1,0 +1,398 @@
+"""Async coalescing verification service + burst preverification tests
+(VERDICT r3 item 1: QC/TC verification off the consensus critical path).
+"""
+
+import asyncio
+
+from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+from hotstuff_tpu.crypto.async_service import (
+    AsyncVerifyService,
+    eval_claims_sync,
+    flatten_claims,
+)
+from hotstuff_tpu.crypto.service import CpuVerifier
+
+from .common import async_test
+
+
+def _signed(seed: int, msg: bytes):
+    """(pk, signature over the 32-byte msg treated as a digest)."""
+    pk, sk = generate_keypair(bytes([seed]) * 32, 0)
+    return pk, Signature.new(Digest(msg), sk)
+
+
+def test_flatten_claims_spans():
+    d1, d2 = b"\x01" * 32, b"\x02" * 32
+    claims = [
+        ("one", d1, b"pk1", b"s1"),
+        ("shared", d2, ((b"pk2", b"s2"), (b"pk3", b"s3"))),
+        ("one", d1, b"pk4", b"s4"),
+    ]
+    digests, pks, sigs, spans = flatten_claims(claims)
+    assert digests == [d1, d2, d2, d1]
+    assert pks == [b"pk1", b"pk2", b"pk3", b"pk4"]
+    assert spans == [(0, 1), (1, 3), (3, 4)]
+
+
+def test_eval_claims_mixed_validity():
+    msg = b"m" * 32
+    pk1, sig1 = _signed(1, msg)
+    pk2, sig2 = _signed(2, msg)
+    pk3, sig3 = _signed(3, msg)
+    good_shared = (
+        "shared",
+        msg,
+        (
+            (pk1.to_bytes(), sig1.to_bytes()),
+            (pk2.to_bytes(), sig2.to_bytes()),
+        ),
+    )
+    bad_shared = (
+        "shared",
+        msg,
+        (
+            (pk1.to_bytes(), sig1.to_bytes()),
+            (pk2.to_bytes(), sig1.to_bytes()),  # wrong sig for pk2
+        ),
+    )
+    good_one = ("one", msg, pk3.to_bytes(), sig3.to_bytes())
+    bad_one = ("one", msg, pk3.to_bytes(), sig1.to_bytes())
+    out = eval_claims_sync(
+        CpuVerifier(), [good_shared, bad_shared, good_one, bad_one]
+    )
+    assert out == [True, False, True, False]
+
+
+def test_eval_claims_aggregate_preferring_backend():
+    """prefers_aggregate backends see shared claims via verify_shared_msg
+    (the BLS one-pairing path), singles via verify_many."""
+
+    class Agg(CpuVerifier):
+        prefers_aggregate = True
+        shared_calls = 0
+        many_calls = 0
+
+        def verify_shared_msg(self, d, votes):
+            Agg.shared_calls += 1
+            return super().verify_shared_msg(d, votes)
+
+        def verify_many(self, d, p, s, aggregate_ok=False):
+            Agg.many_calls += 1
+            return super().verify_many(d, p, s)
+
+    msg = b"n" * 32
+    pk1, sig1 = _signed(4, msg)
+    pk2, sig2 = _signed(5, msg)
+    claims = [
+        ("shared", msg, ((pk1.to_bytes(), sig1.to_bytes()),
+                         (pk2.to_bytes(), sig2.to_bytes()))),
+        ("one", msg, pk1.to_bytes(), sig1.to_bytes()),
+        ("one", msg, pk2.to_bytes(), sig1.to_bytes()),  # invalid
+    ]
+    out = eval_claims_sync(Agg(), claims)
+    assert out == [True, True, False]
+    assert Agg.shared_calls == 1
+    assert Agg.many_calls == 1  # both singles in one batch
+
+
+@async_test
+async def test_inline_service_is_synchronous():
+    msg = b"q" * 32
+    pk, sig = _signed(6, msg)
+    service = AsyncVerifyService.for_backend(CpuVerifier())
+    assert not service.device
+    out = await service.verify_claims(
+        [("one", msg, pk.to_bytes(), sig.to_bytes())]
+    )
+    assert out == [True]
+
+
+class _FakeDeviceHost:
+    """A device host whose 'device' counts dispatches and records batch
+    sizes — stands in for node.LazyDeviceVerifier + BatchVerifier."""
+
+    def __init__(self, kind="fake", ready=True, delay=0.0):
+        self.async_kind = kind
+        self._ready = ready
+        self.cpu_backend = CpuVerifier()
+        self.dispatched_batches = []
+        self._delay = delay
+        host = self
+
+        class _Dispatch:
+            def verify_many(self, digests, pks, sigs, aggregate_ok=False):
+                host.dispatched_batches.append(len(digests))
+                if host._delay:
+                    import time
+
+                    time.sleep(host._delay)
+                return CpuVerifier().verify_many(digests, pks, sigs)
+
+        self.async_backend = _Dispatch()
+
+    @property
+    def device_ready(self):
+        return self._ready
+
+
+@async_test
+async def test_device_service_coalesces_concurrent_submissions():
+    """Claims submitted by many tasks in the same wave ride ONE device
+    dispatch — the in-process committee coalescing that amortizes the
+    tunnel round trip."""
+    msg = b"w" * 32
+    pairs = [_signed(10 + i, msg) for i in range(8)]
+    host = _FakeDeviceHost(kind="coalesce-test")
+    service = AsyncVerifyService.for_backend(host)
+    assert service.device
+
+    async def submit(pk, sig):
+        return await service.verify_claims(
+            [("one", msg, pk.to_bytes(), sig.to_bytes())]
+        )
+
+    outs = await asyncio.gather(*(submit(pk, sig) for pk, sig in pairs))
+    assert all(o == [True] for o in outs)
+    # every submission coalesced into one batch of 8
+    assert host.dispatched_batches == [8]
+    service.close()
+
+
+@async_test
+async def test_device_service_gates_on_readiness():
+    """A device that is not warm must never be dispatched to (cold
+    compile mid-consensus) — claims route to the CPU backend."""
+    msg = b"r" * 32
+    pk, sig = _signed(30, msg)
+    host = _FakeDeviceHost(kind="gate-test", ready=False)
+    service = AsyncVerifyService.for_backend(host)
+    out = await service.verify_claims(
+        [("one", msg, pk.to_bytes(), sig.to_bytes())]
+    )
+    assert out == [True]
+    assert host.dispatched_batches == []  # CPU path took it
+    service.close()
+
+
+@async_test
+async def test_device_service_adapts_to_slow_device():
+    """A device dispatch that measures slower than the CPU estimate
+    makes later small batches route to the CPU (the tunnel-weather
+    fallback), with periodic probes keeping recovery possible."""
+    import hotstuff_tpu.crypto.async_service as asv
+
+    msg = b"s" * 32
+    pk, sig = _signed(31, msg)
+    host = _FakeDeviceHost(kind="adapt-test", delay=0.05)  # 50 ms "tunnel"
+    service = AsyncVerifyService.for_backend(host)
+    claim = ("one", msg, pk.to_bytes(), sig.to_bytes())
+    # first dispatch probes the device optimistically and measures 50 ms
+    await service.verify_claims([claim])
+    assert host.dispatched_batches == [1]
+    assert service._device_ewma_s > 0.04
+    # ~1 sig -> CPU estimate ~130 us << 50 ms: next ones go to CPU
+    service._last_probe = asv.time.monotonic()  # suppress the probe window
+    await service.verify_claims([claim])
+    await service.verify_claims([claim])
+    assert host.dispatched_batches == [1]
+    # a huge batch's CPU estimate exceeds the EWMA -> device again
+    # (distinct claims — identical ones would dedup to a single check)
+    big = [
+        ("one", bytes([i % 256, i // 256]) + b"\x00" * 30,
+         pk.to_bytes(), sig.to_bytes())
+        for i in range(600)
+    ]
+    out = await service.verify_claims(big)
+    assert len(out) == 600
+    assert host.dispatched_batches == [1, 600]
+    service.close()
+
+
+def test_empty_shared_claim_is_false():
+    """A certificate with zero signatures proves nothing: vacuous truth
+    over an empty span would verify a votes=[] forgery."""
+    out = eval_claims_sync(CpuVerifier(), [("shared", b"\x01" * 32, ())])
+    assert out == [False]
+
+    class Agg(CpuVerifier):
+        prefers_aggregate = True
+
+    out = eval_claims_sync(Agg(), [("shared", b"\x01" * 32, ())])
+    assert out == [False]
+
+
+@async_test
+async def test_subquorum_qc_never_memoized_via_preverify(tmp_path):
+    """SAFETY (r4 review): a sub-quorum QC with one valid self-signature
+    must not enter the verified-QC cache through the burst preverifier —
+    the cache hit would skip QC.verify's quorum-weight check forever."""
+    from hotstuff_tpu.consensus import QC
+    from hotstuff_tpu.consensus.messages import Vote
+    from hotstuff_tpu.consensus.wire import TAG_TIMEOUT
+
+    from .common import chain, fresh_base_port, keys, signed_timeout
+    from .test_core import make_core, teardown
+
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
+    try:
+        ks = keys()
+        block = chain(1)[0]
+        # a forged "QC": ONE valid vote signature, far below 2f+1
+        attacker_pk, attacker_sk = ks[3]
+        vote = Vote(hash=block.digest(), round=1, author=attacker_pk)
+        vote.signature = Signature.new(vote.digest(), attacker_sk)
+        forged = QC(hash=block.digest(), round=1, votes=[(attacker_pk, vote.signature)])
+        evil_timeout = signed_timeout(forged, 2, ks[3][0], ks[3][1])
+
+        pre = await h.core._preverify_burst([(TAG_TIMEOUT, evil_timeout)])
+        # the message may have its AUTHOR sig preverified or not, but the
+        # forged certificate must NOT be in the verified cache
+        assert forged._cache_key() not in h.core._verified_qcs
+        # and the full handler path rejects it
+        from hotstuff_tpu.consensus.errors import ConsensusError
+
+        try:
+            await h.core._handle_timeout(
+                evil_timeout, sig_verified=0 in pre
+            )
+            raise AssertionError("sub-quorum high_qc accepted")
+        except ConsensusError:
+            pass
+        assert forged._cache_key() not in h.core._verified_qcs
+        # a votes=[] forgery is equally rejected
+        empty = QC(hash=block.digest(), round=1, votes=[])
+        t2 = signed_timeout(empty, 2, ks[2][0], ks[2][1])
+        await h.core._preverify_burst([(TAG_TIMEOUT, t2)])
+        assert empty._cache_key() not in h.core._verified_qcs
+    finally:
+        teardown(h)
+
+
+@async_test
+async def test_identical_claims_deduplicate_across_submissions():
+    """One broadcast message's claims arrive from every co-located core
+    in the same wave — the service verifies each unique claim once
+    (verdicts are pure functions of the claim bytes)."""
+    msg = b"d" * 32
+    pk, sig = _signed(50, msg)
+    host = _FakeDeviceHost(kind="dedup-test")
+    service = AsyncVerifyService.for_backend(host)
+    claim = ("one", msg, pk.to_bytes(), sig.to_bytes())
+
+    outs = await asyncio.gather(
+        *(service.verify_claims([claim]) for _ in range(8))
+    )
+    assert all(o == [True] for o in outs)
+    assert host.dispatched_batches == [1]  # 8 submissions, ONE evaluation
+    service.close()
+
+
+@async_test
+async def test_stalled_device_dispatch_does_not_stall_later_waves():
+    """A tunnel-stalled device dispatch must not queue later waves
+    behind it: the deadline serves the stalled batch from the CPU, and
+    while the device is busy new batches route to the CPU directly
+    (measured failure mode: one stall collapsed a 32-node committee to
+    a third of the CPU rate)."""
+    import time as _time
+
+    msg = b"t" * 32
+    pk, sig = _signed(40, msg)
+    host = _FakeDeviceHost(kind="stall-test", delay=0.5)  # 500 ms stall
+    service = AsyncVerifyService.for_backend(host)
+    claim = ("one", msg, pk.to_bytes(), sig.to_bytes())
+    t0 = _time.perf_counter()
+    out = await service.verify_claims([claim])
+    first_wall = _time.perf_counter() - t0
+    assert out == [True]
+    # the deadline (100 ms floor, 4x EWMA) cut the wait well below the
+    # 500 ms stall and the batch was served from the CPU
+    assert first_wall < 0.45
+    assert service.deadline_misses == 1
+    # while the stalled dispatch is still in flight, new waves go
+    # straight to the CPU (device busy)
+    t0 = _time.perf_counter()
+    out = await service.verify_claims([claim])
+    assert out == [True]
+    assert _time.perf_counter() - t0 < 0.2
+    assert host.dispatched_batches == [1]  # no second device dispatch
+    await asyncio.sleep(0.6)  # let the stalled dispatch land
+    assert not service._device_busy
+    service.close()
+
+
+@async_test
+async def test_qcmaker_skips_batch_when_all_preverified():
+    """A cell whose every vote arrived pre-verified emits the QC with no
+    quorum-time batch dispatch (the signatures are already proven)."""
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.consensus.messages import Vote
+
+    from .common import committee, fresh_base_port, keys
+
+    class Counting(CpuVerifier):
+        shared = 0
+
+        def verify_shared_msg(self, d, votes):
+            Counting.shared += 1
+            return super().verify_shared_msg(d, votes)
+
+    com = committee(fresh_base_port())
+    ks = keys()
+    agg = Aggregator(com, Counting(), self_key=ks[0][0])
+    block_hash = Digest(b"\x09" * 32)
+    qc = None
+    for pk, sk in ks[:3]:
+        vote = Vote(hash=block_hash, round=1, author=pk)
+        vote.signature = Signature.new(vote.digest(), sk)
+        qc = agg.add_vote(vote, 1, sig_verified=True) or qc
+    assert qc is not None and qc.round == 1
+    assert Counting.shared == 0  # no quorum batch needed
+
+    # mixed cell: one unverified entry forces the quorum batch
+    Counting.shared = 0
+    agg2 = Aggregator(com, Counting(), self_key=ks[0][0])
+    for i, (pk, sk) in enumerate(ks[:3]):
+        vote = Vote(hash=block_hash, round=2, author=pk)
+        vote.signature = Signature.new(vote.digest(), sk)
+        agg2.add_vote(vote, 2, sig_verified=i != 1)
+    assert Counting.shared == 1
+
+
+@async_test
+async def test_preverified_proposal_skips_sync_crypto(tmp_path):
+    """A proposal whose claims all pass arrives at the handler with
+    sigs_verified=True: zero synchronous signature work on the loop."""
+    from hotstuff_tpu.consensus.wire import TAG_PROPOSE
+
+    from .common import chain, fresh_base_port
+    from .test_core import make_core, teardown
+
+    class Counting(CpuVerifier):
+        ones = 0
+        shared = 0
+
+        def verify_one(self, d, pk, sig):
+            Counting.ones += 1
+            return super().verify_one(d, pk, sig)
+
+        def verify_shared_msg(self, d, votes):
+            Counting.shared += 1
+            return super().verify_shared_msg(d, votes)
+
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
+    try:
+        blocks = chain(2)
+        burst = [(TAG_PROPOSE, blocks[1])]
+        pre = await h.core._preverify_burst(burst)
+        assert pre == {0}
+        # now swap in the counting verifier: the handler must not touch it
+        h.core.verifier = Counting()
+        h.core.aggregator.verifier = h.core.verifier
+        await h.core._dispatch(burst[0], sig_verified=True)
+        assert Counting.ones == 0
+        assert Counting.shared == 0
+        # and the embedded QC is memoized for future bursts
+        assert blocks[1].qc._cache_key() in h.core._verified_qcs
+    finally:
+        teardown(h)

@@ -334,46 +334,48 @@ async def test_timeout_backoff_grows_and_resets_on_progress(tmp_path):
 @async_test
 async def test_timeout_burst_aggregate_verification(tmp_path):
     """A view-change storm's timeout flood arriving in one burst is
-    signature-verified as ONE shared-message aggregate (all flood
-    entries sign the same digest); a garbage timeout in the burst makes
-    its group fall back to per-item verification, where it is rejected
-    while the honest timeouts still land in the TC maker."""
+    signature-verified as ONE coalesced claim batch (flood entries over
+    the same digest form one shared claim); a garbage timeout in the
+    burst makes its group fall back to per-item verification, where it
+    is rejected while the honest timeouts still land in the TC maker."""
     from hotstuff_tpu.consensus.wire import TAG_TIMEOUT
     from hotstuff_tpu.crypto import Signature
+    from hotstuff_tpu.crypto.async_service import AsyncVerifyService
     from hotstuff_tpu.crypto.service import CpuVerifier
 
     class CountingVerifier(CpuVerifier):
         ones = 0
-        shared = 0
+        many = 0
 
         def verify_one(self, d, pk, sig):
             CountingVerifier.ones += 1
             return super().verify_one(d, pk, sig)
 
-        def verify_shared_msg(self, d, votes):
-            CountingVerifier.shared += 1
-            return super().verify_shared_msg(d, votes)
+        def verify_many(self, digests, pks, sigs, aggregate_ok=False):
+            CountingVerifier.many += 1
+            return super().verify_many(digests, pks, sigs)
 
     h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
     try:
         from hotstuff_tpu.consensus import QC
 
         h.core.verifier = CountingVerifier()
+        h.core.averifier = AsyncVerifyService.for_backend(h.core.verifier)
         ks = keys()
         # clean burst: 3 timeouts over the same digest (round 1, genesis
-        # high_qc) -> one aggregate, zero per-item signature checks
+        # high_qc) -> one flattened claim batch, zero per-item checks
         burst = [
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, pk, sk))
             for pk, sk in ks[:3]
         ]
-        pre = h.core._preverify_timeout_burst(burst)
+        pre = await h.core._preverify_burst(burst)
         assert pre == {0, 1, 2}
-        assert CountingVerifier.shared == 1
+        assert CountingVerifier.many == 1
         assert CountingVerifier.ones == 0
 
-        # poisoned burst: one garbage signature -> the aggregate fails,
-        # nothing is preverified (per-item fallback happens in
-        # _handle_timeout, where the garbage one raises)
+        # poisoned burst: one garbage signature -> the group's shared
+        # claim fails, nothing is preverified (per-item fallback happens
+        # in _handle_timeout, where the garbage one raises)
         bad = signed_timeout(QC.genesis(), 1, ks[2][0], ks[2][1])
         bad.signature = Signature(b"\x01" * 64)
         burst_bad = [
@@ -381,7 +383,7 @@ async def test_timeout_burst_aggregate_verification(tmp_path):
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[1][0], ks[1][1])),
             (TAG_TIMEOUT, bad),
         ]
-        pre = h.core._preverify_timeout_burst(burst_bad)
+        pre = await h.core._preverify_burst(burst_bad)
         assert pre == set()
 
         # NON-MEMBER authors must never enter an aggregate (the BLS
@@ -392,15 +394,13 @@ async def test_timeout_burst_aggregate_verification(tmp_path):
 
         spk, ssk = generate_keypair(b"\x77" * 32, 0)  # not in committee
         stranger = signed_timeout(QC.genesis(), 1, spk, ssk)
-        CountingVerifier.shared = 0
         burst_mixed = [
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[0][0], ks[0][1])),
             (TAG_TIMEOUT, stranger),
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[1][0], ks[1][1])),
         ]
-        pre = h.core._preverify_timeout_burst(burst_mixed)
+        pre = await h.core._preverify_burst(burst_mixed)
         assert pre == {0, 2}  # members aggregate; the stranger never joins
-        assert CountingVerifier.shared == 1
         # the per-item path still accepts the honest ones and rejects
         # the garbage one
         await h.core._handle_timeout(burst_bad[0][1])
@@ -418,21 +418,30 @@ async def test_timeout_burst_aggregate_verification(tmp_path):
 @async_test
 async def test_timeout_burst_mixed_rounds_group_separately(tmp_path):
     """Timeouts for different rounds (distinct digests) in one burst
-    aggregate per group — each round's group verifies independently."""
+    form one claim group per round — each verifies independently, and on
+    an aggregate-preferring backend (BLS) each group costs exactly one
+    shared-message check."""
     from hotstuff_tpu.consensus import QC
     from hotstuff_tpu.consensus.wire import TAG_TIMEOUT
+    from hotstuff_tpu.crypto.async_service import AsyncVerifyService
     from hotstuff_tpu.crypto.service import CpuVerifier
 
-    class CountingVerifier(CpuVerifier):
+    class AggregateCountingVerifier(CpuVerifier):
+        """Counts shared-claim checks the way a BLS backend would see
+        them (prefers_aggregate routes shared claims to
+        verify_shared_msg instead of flattening)."""
+
+        prefers_aggregate = True
         shared = 0
 
         def verify_shared_msg(self, d, votes):
-            CountingVerifier.shared += 1
+            AggregateCountingVerifier.shared += 1
             return super().verify_shared_msg(d, votes)
 
     h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
     try:
-        h.core.verifier = CountingVerifier()
+        h.core.verifier = AggregateCountingVerifier()
+        h.core.averifier = AsyncVerifyService.for_backend(h.core.verifier)
         ks = keys()
         burst = [
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[0][0], ks[0][1])),
@@ -440,8 +449,8 @@ async def test_timeout_burst_mixed_rounds_group_separately(tmp_path):
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 1, ks[2][0], ks[2][1])),
             (TAG_TIMEOUT, signed_timeout(QC.genesis(), 2, ks[3][0], ks[3][1])),
         ]
-        pre = h.core._preverify_timeout_burst(burst)
+        pre = await h.core._preverify_burst(burst)
         assert pre == {0, 1, 2, 3}
-        assert CountingVerifier.shared == 2  # one aggregate per round
+        assert AggregateCountingVerifier.shared == 2  # one aggregate per round
     finally:
         teardown(h)
