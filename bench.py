@@ -249,6 +249,31 @@ def bench_cpu(msgs, pks, sigs) -> float:
     return rounds * len(msgs) / dt
 
 
+def bench_sharded(msgs, pks, sigs) -> dict:
+    """The PRODUCTION sharded route (shard_map + per-shard Pallas) on the
+    real device mesh (VERDICT r3 item 7): a mesh of every visible device
+    (1 on this rig — the code path is identical to a v5e-8's, only the
+    axis size differs).  Records the 256-vote QC device slope for a
+    parity check against the single-device kernel."""
+    import numpy as np
+
+    from hotstuff_tpu.parallel.mesh import ShardedBatchVerifier, default_mesh
+
+    mesh = default_mesh()
+    verifier = ShardedBatchVerifier(mesh=mesh, min_device_batch=0)
+    verifier.precompute(pks)
+    qc = 256
+    out = verifier.verify(msgs[:qc], pks[:qc], sigs[:qc])
+    assert out.all(), "sharded verify returned invalid on a valid batch"
+    kernel, staged = _stage(verifier, msgs[:qc], pks[:qc], sigs[:qc])
+    np.asarray(kernel(*staged))
+    return {
+        "mesh_devices": int(mesh.devices.size),
+        "per_shard_pallas": bool(verifier._shard_pallas),
+        "qc256_device_ms": _device_slope_ms(kernel, staged),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -261,6 +286,7 @@ def main() -> int:
     from hotstuff_tpu.tpu.ed25519 import BatchVerifier
 
     tc_latency = bench_tc(BatchVerifier(min_device_batch=0))
+    sharded = bench_sharded(msgs, pks, sigs)
 
     print(
         json.dumps(
@@ -272,6 +298,7 @@ def main() -> int:
                 "device_throughput": device_tput,
                 "qc_verify_ms": qc_latency,
                 "tc_verify_ms": tc_latency,
+                "sharded_route": sharded,
             }
         )
     )

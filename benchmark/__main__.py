@@ -32,6 +32,7 @@ def task_local(args) -> int:
         transport=args.transport,
         scheme=args.scheme,
         in_process=args.in_process,
+        tx_size=args.tx_size,
     )
     parser = bench.run()
     label = (
@@ -188,6 +189,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("local")
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument(
+        "--tx-size",
+        type=int,
+        default=512,
+        help="payload body bytes (0 = digest-only; 512 = reference parity)",
+    )
     p.add_argument("--duration", type=float, default=20.0)
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)

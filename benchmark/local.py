@@ -41,9 +41,11 @@ class LocalBench:
         base_port: int = BASE_PORT,
         scheme: str = "ed25519",
         in_process: bool = False,
+        tx_size: int = 512,
     ):
         self.nodes = nodes
         self.rate = rate
+        self.tx_size = tx_size
         self.duration = duration
         self.faults = faults
         self.timeout_delay = timeout_delay
@@ -114,7 +116,15 @@ class LocalBench:
             stderr=subprocess.STDOUT,
             env={
                 **os.environ,
-                "PYTHONPATH": root,
+                # PREPEND the repo root — clobbering an existing
+                # PYTHONPATH can drop site dirs that register jax
+                # backend plugins (the tunneled-TPU rig loads its
+                # backend that way)
+                "PYTHONPATH": os.pathsep.join(
+                    p
+                    for p in (root, os.environ.get("PYTHONPATH", ""))
+                    if p
+                ),
                 # share one persistent XLA/Mosaic compilation cache across
                 # the committee AND with bench/test runs: with --verifier
                 # tpu every node would otherwise pay the full first
@@ -202,6 +212,8 @@ class LocalBench:
                     PathMaker.committee_file(),
                     "--rate",
                     str(self.rate),
+                    "--size",
+                    str(self.tx_size),
                     "--duration",
                     str(self.duration),
                     "--warmup",
@@ -212,7 +224,29 @@ class LocalBench:
                 PathMaker.client_log_file(),
             )
 
-            time.sleep(self.duration + 6)  # warmup + drain margin
+            # Wait for the client to actually START sending before timing
+            # the measurement window: boot cost varies hugely (CPU runs
+            # boot in ~a second; --verifier tpu pays a device-kernel
+            # warmup of seconds-to-minutes on a cold compilation cache),
+            # and a fixed sleep would kill a tpu committee mid-warmup.
+            boot_deadline = time.time() + max(60.0, 4.0 * self.nodes) + (
+                300.0 if self.verifier.startswith("tpu") else 0.0
+            )
+            started = False
+            while time.time() < boot_deadline:
+                try:
+                    with open(PathMaker.client_log_file()) as f:
+                        if "Start sending transactions" in f.read():
+                            started = True
+                            break
+                except OSError:
+                    pass
+                if any(p.poll() is not None for p in self._procs):
+                    break  # something died — parse what we have
+                time.sleep(0.5)
+            if not started:
+                Print.warn("client never started sending (boot timeout)")
+            time.sleep(self.duration + 4)  # the window + drain margin
         except (OSError, subprocess.SubprocessError) as e:
             raise BenchError(f"Failed to run benchmark: {e}") from e
         finally:

@@ -45,6 +45,7 @@ RE_COMMITTED = re.compile(_TS + r".*Committed block (\d+) -> (\S+)")
 RE_TIMEOUT = re.compile(_TS + r".*Timeout reached for round (\d+)")
 RE_TIMEOUT_DELAY = re.compile(r"Timeout delay set to (\d+) ms")
 RE_CLIENT_RATE = re.compile(_TS + r".*Transactions rate: (\d+) tx/s")
+RE_CLIENT_SIZE = re.compile(r"Transactions size: (\d+) B")
 RE_SAMPLE = re.compile(_TS + r".*Sending sample payload (\S+)")
 RE_RATE_HIGH = re.compile(r"rate too high")
 
@@ -96,6 +97,7 @@ class LogParser:
 
         self.client_start: float | None = None
         self.input_rate: int | None = None
+        self.tx_size: int = 0  # payload body bytes (0 = digest-only)
         self.samples: dict[str, float] = {}  # payload -> send time
         self.rate_warnings = 0
         for content in client_logs:
@@ -103,6 +105,9 @@ class LogParser:
             if m:
                 self.client_start = _ts(m.group(1))
                 self.input_rate = int(m.group(2))
+            m = RE_CLIENT_SIZE.search(content)
+            if m:
+                self.tx_size = int(m.group(1))
             for ts, payload in RE_SAMPLE.findall(content):
                 self.samples[payload] = _ts(ts)
             self.rate_warnings += len(RE_RATE_HIGH.findall(content))
@@ -184,6 +189,15 @@ class LogParser:
             f"{round(self.consensus_latency() * 1000)} ms" if self.commits
             else "n/a (no commits)"
         )
+        # Byte throughput (reference logs.py:147-169 reports BPS): the
+        # committed-payload rate times the measured body size.  Only
+        # meaningful when the client sent real bodies (tx_size > 0).
+        if self.tx_size:
+            c_bps_txt = f" Consensus BPS: {round(c_tps * self.tx_size):,} B/s\n"
+            e_bps_txt = f" End-to-end BPS: {round(e_tps * self.tx_size):,} B/s\n"
+        else:
+            c_bps_txt = " Consensus BPS: n/a (digest-only payloads)\n"
+            e_bps_txt = " End-to-end BPS: n/a (digest-only payloads)\n"
         return (
             "\n"
             "-----------------------------------------\n"
@@ -193,15 +207,18 @@ class LogParser:
             f" Faults: {faults} node(s)\n"
             f" Committee size: {nodes if nodes is not None else '?'} node(s)\n"
             f" Input rate: {self.input_rate or 0} tx/s\n"
+            f" Transaction size: {self.tx_size} B\n"
             f" Verifier backend: {verifier}\n"
             f" Consensus timeout delay: {self.timeout_delay or 0} ms\n"
             f" Execution time: {round(c_dur)} s\n"
             "\n"
             " + RESULTS:\n"
             f" Consensus TPS: {round(c_tps)} payloads/s\n"
-            f" Consensus latency: {c_lat_txt}\n"
+            + c_bps_txt
+            + f" Consensus latency: {c_lat_txt}\n"
             f" End-to-end TPS: {round(e_tps)} payloads/s\n"
-            f" End-to-end latency: {e2e_lat_txt}\n"
+            + e_bps_txt
+            + f" End-to-end latency: {e2e_lat_txt}\n"
             f" Committed blocks: {len(self.commits)}\n"
             f" View-change timeouts: {self.timeouts}\n"
             f" Client rate warnings: {self.rate_warnings}\n"

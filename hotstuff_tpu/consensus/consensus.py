@@ -44,6 +44,15 @@ log = logging.getLogger(__name__)
 CHANNEL_CAPACITY = 1_000
 
 
+PAYLOAD_KEY_PREFIX = b"p"  # store namespace for payload bodies
+
+
+def payload_key(digest) -> bytes:
+    """Store key of a payload body (33 bytes — disjoint from the
+    32-byte block-digest key space)."""
+    return PAYLOAD_KEY_PREFIX + digest.to_bytes()
+
+
 class ConsensusReceiverHandler:
     def __init__(
         self,
@@ -51,6 +60,7 @@ class ConsensusReceiverHandler:
         tx_helper: asyncio.Queue,
         tx_producer: asyncio.Queue,
         scheme: str | None = None,
+        store: Store | None = None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
@@ -59,6 +69,7 @@ class ConsensusReceiverHandler:
         if scheme is not None and scheme not in SCHEME_WIRE_SIZES:
             raise ValueError(f"unknown committee scheme '{scheme}'")
         self.scheme = scheme
+        self.store = store
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         try:
@@ -75,11 +86,25 @@ class ConsensusReceiverHandler:
                 pass
             await self.tx_consensus.put((tag, payload))
         elif tag == TAG_PRODUCER:
+            digest, body = payload
+            if body:
+                # content addressing: a body that doesn't hash to its
+                # digest is a poisoned submission — drop it (no ACK)
+                from ..crypto import Digest
+
+                if Digest.of(body) != digest:
+                    log.warning(
+                        "Dropping producer payload whose body does not "
+                        "match its digest"
+                    )
+                    return
+                if self.store is not None:
+                    await self.store.write(payload_key(digest), body)
             try:
                 await writer.send(ACK)
             except (ConnectionError, OSError):
                 pass
-            await self.tx_producer.put(payload)
+            await self.tx_producer.put(digest)
         else:
             await self.tx_consensus.put((tag, payload))
 
@@ -156,6 +181,7 @@ class Consensus:
                 tx_consensus, tx_helper, tx_producer,
                 # mixed-scheme schedules accept the union on the wire
                 scheme=committee.wire_scheme(),
+                store=store,
             ),
         )
         await self.receiver.spawn()

@@ -58,8 +58,22 @@ def encode_sync_request(missing: Digest, origin: PublicKey) -> bytes:
     return enc.finish()
 
 
-def encode_producer(payload: Digest) -> bytes:
-    return Encoder().u8(TAG_PRODUCER).raw(payload.to_bytes()).finish()
+# Per-payload body cap (wire sanity bound; the reference's WAN config
+# uses 512-byte transactions, data/2-chain/README.md:42-57).
+MAX_PAYLOAD_BODY = 65_536
+
+
+def encode_producer(payload: Digest, body: bytes = b"") -> bytes:
+    """The fork's ingest message (consensus.rs:37), extended with an
+    optional payload BODY: the reference's 512-byte transactions flow
+    through its (deleted) mempool; here the producer may attach the
+    body so nodes store real bytes and the harness measures BPS
+    (VERDICT r3 item 4).  An empty body preserves the digest-only
+    producer contract (dissemination stays the producer's job, as in
+    the reference fork)."""
+    enc = Encoder().u8(TAG_PRODUCER).raw(payload.to_bytes())
+    enc.var_bytes(body)
+    return enc.finish()
 
 
 def decode_message(data: bytes, scheme: str | None = None):
@@ -94,7 +108,7 @@ def decode_message(data: bytes, scheme: str | None = None):
         elif tag == TAG_SYNC_REQUEST:
             out = (Digest(dec.raw(Digest.SIZE)), decode_pk(dec))
         elif tag == TAG_PRODUCER:
-            out = Digest(dec.raw(Digest.SIZE))
+            out = (Digest(dec.raw(Digest.SIZE)), dec.var_bytes(MAX_PAYLOAD_BODY))
         else:
             raise CodecError(f"unknown message tag {tag}")
         dec.finish()

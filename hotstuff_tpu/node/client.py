@@ -180,11 +180,20 @@ async def run_client(
     duration: float,
     warmup: float = 0.0,
     expect_faults: int = 0,
+    size: int = 512,
 ) -> int:
     """Send ``rate`` producer payloads/s for ``duration`` seconds,
     round-robining each payload to ONE live node (disjoint proposer
     queues — see the comment at the send loop).  Returns the TOTAL
-    number of payloads sent across all nodes."""
+    number of payloads sent across all nodes.
+
+    ``size``: payload BODY bytes per transaction (default 512, the
+    reference's WAN tx size, data/2-chain/README.md:42-57) — the body
+    rides the producer message and is stored by the ingest node, so the
+    harness measures real byte throughput.  ``size=0`` sends bare
+    digests (the fork's original digest-only producer contract)."""
+    import os
+
     from ..consensus.wire import encode_producer
 
     log.info("Waiting for all nodes to be online...")
@@ -233,8 +242,9 @@ async def run_client(
 
     burst = max(1, rate // PRECISION)
     log.info("Start sending transactions")
-    # NOTE: this log entry is used to compute performance.
+    # NOTE: these log entries are used to compute performance.
     log.info("Transactions rate: %d tx/s", rate)
+    log.info("Transactions size: %d B", size)
 
     loop = asyncio.get_running_loop()
     start = loop.time()
@@ -264,11 +274,23 @@ async def run_client(
             # sent counter nor the sample log line may claim otherwise
             # (the harness counts both)
             for i in range(burst if live else 0):
-                digest = Digest.random()
+                if size > 0:
+                    # real transaction bytes, content-addressed: the
+                    # counter makes every body unique (reference
+                    # client.rs:103-133 tags bodies with a counter too)
+                    body = sent.to_bytes(8, "big") + os.urandom(
+                        max(0, size - 8)
+                    )
+                    digest = Digest.of(body)
+                else:
+                    body = b""
+                    digest = Digest.random()
                 if i == 0:
                     # NOTE: this log entry is used to compute performance.
                     log.info("Sending sample payload %s", digest)
-                live[sent % len(live)].send_frame(encode_producer(digest))
+                live[sent % len(live)].send_frame(
+                    encode_producer(digest, body)
+                )
                 sent += 1
             for c in conns:
                 await c.drain()
@@ -299,6 +321,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--rate", type=int, default=1_000, help="payloads/s")
     parser.add_argument(
+        "--size",
+        type=int,
+        default=512,
+        help="payload body bytes (0 = digest-only producer contract)",
+    )
+    parser.add_argument(
         "--duration", type=float, default=20.0, help="send window (s)"
     )
     parser.add_argument(
@@ -319,6 +347,15 @@ def main(argv=None) -> int:
         datefmt="%Y-%m-%dT%H:%M:%S",
     )
 
+    from ..consensus.wire import MAX_PAYLOAD_BODY
+
+    if not 0 <= args.size <= MAX_PAYLOAD_BODY:
+        # fail FAST: an oversized body would be dropped by every node's
+        # wire decoder and the run would silently report zero commits
+        parser.error(
+            f"--size must be in [0, {MAX_PAYLOAD_BODY}] "
+            "(the wire decoder's payload-body cap)"
+        )
     committee = read_committee(args.committee)
     addresses = [a.address for a in committee.authorities.values()]
     sent = asyncio.run(
@@ -328,6 +365,7 @@ def main(argv=None) -> int:
             args.duration,
             args.warmup,
             expect_faults=args.faults,
+            size=args.size,
         )
     )
     log.info("Sent %d payloads", sent)

@@ -39,6 +39,7 @@ from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
 from hotstuff_tpu.crypto.service import CpuVerifier
 
 from .common import (
+    async_test,
     chain,
     committee,
     keys,
@@ -81,6 +82,61 @@ def test_wire_roundtrip_all_tags():
         got_tag, payload = decode_message(encoded)
         assert got_tag == tag
         assert payload is not None
+
+
+def test_producer_body_roundtrip():
+    """Producer messages carry an optional content-addressed body
+    (VERDICT r3 item 4: real transaction bytes through the producer
+    path)."""
+    body = b"\xab" * 512
+    digest = Digest.of(body)
+    tag, (got_digest, got_body) = decode_message(encode_producer(digest, body))
+    assert tag == TAG_PRODUCER
+    assert got_digest == digest and got_body == body
+    # digest-only form still round-trips (empty body)
+    tag, (d2, b2) = decode_message(encode_producer(digest))
+    assert d2 == digest and b2 == b""
+
+
+@async_test
+async def test_receiver_handler_stores_body_and_rejects_mismatch(tmp_path):
+    """The ingest handler verifies content addressing, stores the body
+    keyed by digest, and forwards the bare digest to the proposer; a
+    body that does not hash to its digest is dropped without an ACK."""
+    import asyncio
+
+    from hotstuff_tpu.consensus.consensus import (
+        ConsensusReceiverHandler,
+        payload_key,
+    )
+    from hotstuff_tpu.store import Store
+
+    class FakeWriter:
+        def __init__(self):
+            self.sent = []
+
+        async def send(self, data):
+            self.sent.append(data)
+
+    store = Store(str(tmp_path / "db"))
+    tx_producer: asyncio.Queue = asyncio.Queue()
+    handler = ConsensusReceiverHandler(
+        asyncio.Queue(), asyncio.Queue(), tx_producer, store=store
+    )
+    body = b"\xcd" * 512
+    digest = Digest.of(body)
+    w = FakeWriter()
+    await handler.dispatch(w, encode_producer(digest, body))
+    assert w.sent  # ACK
+    assert tx_producer.get_nowait() == digest
+    assert await store.read(payload_key(digest)) == body
+
+    # poisoned: body does not hash to the claimed digest
+    w2 = FakeWriter()
+    await handler.dispatch(w2, encode_producer(Digest.random(), body))
+    assert not w2.sent  # no ACK
+    assert tx_producer.empty()
+    store.close()
 
 
 def test_verify_valid_block():
