@@ -33,6 +33,7 @@ def task_local(args) -> int:
         scheme=args.scheme,
         in_process=args.in_process,
         tx_size=args.tx_size,
+        wan=args.wan,
     )
     parser = bench.run()
     label = (
@@ -40,6 +41,8 @@ def task_local(args) -> int:
     )
     if args.in_process:
         label += "-1proc"
+    if args.wan:
+        label += "-wan"
     summary = parser.result(
         faults=args.faults, nodes=args.nodes, verifier=label
     )
@@ -179,6 +182,15 @@ def task_plot(_args) -> int:
     Print.info(f"Wrote {plot_latency_vs_throughput(groups)}")
     Print.info(f"Wrote {plot_tps_vs_committee(groups)}")
     Print.info(f"Wrote {plot_robustness(groups)}")
+    # WAN view: only the -wan series, with the reference's published WAN
+    # points overlaid (log-x; the hardware gap stays visible)
+    wan_groups = {
+        k: v for k, v in groups.items() if k[3].endswith("-wan")
+    }
+    if wan_groups:
+        Print.info(
+            f"Wrote {plot_latency_vs_throughput(wan_groups, reference_overlay=True)}"
+        )
     return 0
 
 
@@ -199,6 +211,12 @@ def main(argv=None) -> int:
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
     p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
+    p.add_argument(
+        "--wan",
+        action="store_true",
+        help="emulate the reference's 5-region WAN link delays "
+        "(network/wan.py)",
+    )
     p.add_argument("--transport", choices=["asyncio", "native"], default="asyncio")
     p.add_argument(
         "--scheme",

@@ -42,10 +42,22 @@ class LocalBench:
         scheme: str = "ed25519",
         in_process: bool = False,
         tx_size: int = 512,
+        wan: bool = False,
     ):
         self.nodes = nodes
         self.rate = rate
         self.tx_size = tx_size
+        # WAN emulation: write a 5-region link-delay spec and point the
+        # committee at it (hotstuff_tpu/network/wan.py)
+        self.wan = wan
+        if wan and transport == "native":
+            # the native reactor does its own I/O and applies no link
+            # delays — a '-wan'-labeled result from it would feed
+            # undelayed localhost numbers into the WAN comparison plot
+            raise BenchError(
+                "--wan requires the asyncio transport (the native "
+                "reactor applies no link delays)"
+            )
         self.duration = duration
         self.faults = faults
         self.timeout_delay = timeout_delay
@@ -93,6 +105,16 @@ class LocalBench:
             pops={s.name: s.pop for s in keys if s.pop is not None},
         )
         write_committee(committee, PathMaker.committee_file())
+        if self.wan:
+            import json
+
+            from hotstuff_tpu.network.wan import build_spec
+
+            spec = build_spec(
+                [("127.0.0.1", self.base_port + i) for i in range(self.nodes)]
+            )
+            with open(self._wan_spec_path(), "w") as f:
+                json.dump(spec, f)
         write_parameters(
             Parameters(
                 timeout_delay=self.timeout_delay,
@@ -103,6 +125,10 @@ class LocalBench:
         for i, secret in enumerate(keys):
             secret.write(PathMaker.key_file(i))
 
+    @staticmethod
+    def _wan_spec_path() -> str:
+        return os.path.join(PathMaker.base_path(), ".wan.json")
+
     def _spawn(self, cmd: list[str], log_file: str) -> subprocess.Popen:
         f = open(log_file, "w")
         # repo root (the directory holding hotstuff_tpu/), NOT cwd — the
@@ -110,12 +136,16 @@ class LocalBench:
         import hotstuff_tpu
 
         root = os.path.dirname(os.path.dirname(os.path.abspath(hotstuff_tpu.__file__)))
+        wan_env = (
+            {"HOTSTUFF_WAN_SPEC": self._wan_spec_path()} if self.wan else {}
+        )
         proc = subprocess.Popen(
             cmd,
             stdout=f,
             stderr=subprocess.STDOUT,
             env={
                 **os.environ,
+                **wan_env,
                 # PREPEND the repo root — clobbering an existing
                 # PYTHONPATH can drop site dirs that register jax
                 # backend plugins (the tunneled-TPU rig loads its

@@ -39,14 +39,25 @@ def _series_by_config(groups: dict, value_fn) -> dict[tuple, list]:
 
 
 def plot_latency_vs_throughput(
-    groups: dict | None = None, out_path: str | None = None
+    groups: dict | None = None,
+    out_path: str | None = None,
+    reference_overlay: bool = False,
 ) -> str:
-    """One line per (nodes, verifier): consensus latency vs achieved TPS."""
+    """One line per (nodes, verifier): consensus latency vs achieved TPS.
+
+    ``reference_overlay=True`` adds the reference's published WAN points
+    (benchmark/baseline.py) on log-x so WAN-emulated runs can be
+    compared against the reference's latency SHAPE — the ~100x absolute
+    throughput gap (10-50 server-class hosts vs this one-core rig) stays
+    visible instead of hidden."""
     plt = _plt()
     groups = groups if groups is not None else aggregate()
     os.makedirs(PathMaker.plot_path(), exist_ok=True)
     out_path = out_path or os.path.join(
-        PathMaker.plot_path(), "latency-vs-throughput.png"
+        PathMaker.plot_path(),
+        "latency-vs-throughput-wan.png"
+        if reference_overlay
+        else "latency-vs-throughput.png",
     )
 
     series = _series_by_config(
@@ -68,9 +79,17 @@ def plot_latency_vs_throughput(
             xs, ys, yerr=es, marker="o", capsize=3,
             label=_label(nodes, faults, verifier),
         )
+    if reference_overlay:
+        from .baseline import REFERENCE_WAN_POINTS
+
+        for label, tps, lat_ms in REFERENCE_WAN_POINTS:
+            ax.scatter([tps], [lat_ms], marker="*", s=120, zorder=5)
+            ax.annotate(label, (tps, lat_ms), fontsize=7,
+                        xytext=(4, 4), textcoords="offset points")
+        ax.set_xscale("log")
     ax.set_xlabel("Throughput (payloads/s)")
     ax.set_ylabel("Consensus latency (ms)")
-    ax.legend()
+    ax.legend(fontsize=8)
     ax.grid(True, alpha=0.3)
     fig.tight_layout()
     fig.savefig(out_path, dpi=150)

@@ -158,6 +158,25 @@ class Consensus:
         # (consensus.rs:61-73 rewrites the IP to 0.0.0.0).
         # transport="native": the C++ epoll reactor (network/native.py)
         # carries the framed TCP I/O; the actor graph is unchanged.
+        # WAN emulation (HOTSTUFF_WAN_SPEC, network/wan.py): per-link
+        # propagation delay on every node->node sender — the committee
+        # experiences the reference's 5-region topology on localhost.
+        # asyncio transport only (the native reactor does its own I/O).
+        import os
+
+        link_delay = None
+        wan_spec = os.environ.get("HOTSTUFF_WAN_SPEC")
+        if wan_spec and transport != "native":
+            from ..network.wan import WanModel
+
+            model = WanModel.load(wan_spec, address)
+            log.info(
+                "WAN emulation active: region %s", model.self_region
+            )
+
+            def link_delay(dst, _model=model):  # noqa: E731 — closure
+                return lambda: _model.delay(dst)
+
         if transport == "native":
             from ..network.native import (
                 NativeReceiver,
@@ -172,8 +191,12 @@ class Consensus:
             from ..network import ReliableSender, SimpleSender
 
             receiver_cls = NetworkReceiver
-            make_sender = SimpleSender
-            make_reliable = ReliableSender
+
+            def make_sender():
+                return SimpleSender(link_delay=link_delay)
+
+            def make_reliable():
+                return ReliableSender(link_delay=link_delay)
         self.receiver = receiver_cls(
             bind_host,
             address[1],

@@ -224,6 +224,15 @@ class Core:
         self._timeout_exponent = 0
         # TC advances since the last QC advance (see _advance_round)
         self._consecutive_tcs = 0
+        # Did the current round show any sign of life (a proposal for
+        # it)?  An IDLE timeout — no proposal seen and no uncommitted
+        # payload block in flight — is the committee waiting for
+        # payloads (the proposer defers empty makes), NOT a liveness
+        # failure: growing the view-change backoff there compounds into
+        # multi-second timers before the first transaction arrives
+        # (measured: a WAN f=3 committee wedged to zero commits because
+        # boot-time idle rounds pushed the timer to 16 s+).
+        self._saw_proposal = False
         self.aggregator = Aggregator(committee, verifier, self_key=name)
         # Async claim preverifier (crypto/async_service.py): device
         # backends get a coalescing off-loop dispatch service (shared
@@ -400,6 +409,7 @@ class Core:
             self.timer.set_duration_ms(self._timeout_base_ms)
         self.timer.reset()
         self.round = round_ + 1
+        self._saw_proposal = False
         self.state_changed = True
         self.log.debug("Moved to round %d", self.round)
         self.aggregator.cleanup(self.round)
@@ -500,15 +510,24 @@ class Core:
         self.log.debug("Created %r", timeout)
         # one more consecutive view change -> stretch the next round's
         # timer (a dead-leader round costs ~one base delay; a genuinely
-        # slow network backs off geometrically instead of storming)
-        self._timeout_exponent += 1
-        self.timer.set_duration_ms(
-            min(
-                self._timeout_base_ms
-                * self._timeout_backoff**self._timeout_exponent,
-                self._timeout_cap_ms,
-            )
+        # slow network backs off geometrically instead of storming).
+        # IDLE timeouts — no proposal seen for the round and nothing
+        # uncommitted in flight — keep the base timer: that's the
+        # committee pacing itself to payload arrival (deferred makes),
+        # not a liveness failure (see _saw_proposal).
+        active = (
+            self._saw_proposal
+            or self.last_payload_round > self.last_committed_round
         )
+        if active:
+            self._timeout_exponent += 1
+            self.timer.set_duration_ms(
+                min(
+                    self._timeout_base_ms
+                    * self._timeout_backoff**self._timeout_exponent,
+                    self._timeout_cap_ms,
+                )
+            )
         self.timer.reset()
 
         addresses = [
@@ -521,6 +540,11 @@ class Core:
 
     async def _process_block(self, block: Block) -> None:
         self.log.debug("Processing %r", block)
+        if block.round >= self.round:
+            # a (verified or self-made) proposal for the current round:
+            # the committee is live — timeouts from here on are real
+            # liveness signals, not idle pacing (_saw_proposal)
+            self._saw_proposal = True
 
         # b0 <- |qc0; b1| <- |qc1; block|: suspend if ancestors are missing
         # (the synchronizer will re-inject the block via loopback).

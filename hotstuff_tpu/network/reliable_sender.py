@@ -38,16 +38,26 @@ CancelHandler = asyncio.Future  # resolves to the ACK payload (bytes)
 
 
 class _Connection:
-    def __init__(self, address: Address):
+    def __init__(self, address: Address, delay_fn=None):
         self.address = address
-        self.queue: asyncio.Queue[tuple[bytes, CancelHandler]] = asyncio.Queue(
-            maxsize=CHANNEL_CAPACITY
-        )
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         # un-ACKed in-flight messages, FIFO-paired with incoming ACKs
         self.pending: deque[tuple[bytes, CancelHandler]] = deque()
+        # WAN emulation (network/wan.py): outbound frames wait for their
+        # deliver-at time; ACK futures resolve one return-leg later, so
+        # the proposer's quorum-ACK back-pressure sees full RTTs.
+        self._delay_fn = delay_fn
+        self._scheduler = None
+        if delay_fn is not None:
+            from .wan import LinkScheduler
+
+            self._scheduler = LinkScheduler(delay_fn)
         self.task = asyncio.get_running_loop().create_task(
             self._run(), name=f"reliable-conn-{address}"
         )
+
+    def deliver_at(self) -> float:
+        return 0.0 if self._scheduler is None else self._scheduler.deliver_at()
 
     async def _run(self) -> None:
         delay = RETRY_DELAY_S
@@ -87,11 +97,26 @@ class _Connection:
 
         async def writer_loop():
             while True:
-                data, fut = await self.queue.get()
+                at, data, fut = await self.queue.get()
                 if fut.cancelled():
                     continue
+                # join `pending` BEFORE any await: a connection drop
+                # during the WAN wait must leave the message where the
+                # reconnect path retransmits it (and close() cancels
+                # its future) — never in limbo with a forever-pending
+                # ACK future.  Retransmits after a reconnect skip the
+                # emulated delay; the reconnect backoff (>= 200 ms)
+                # already exceeds any link delay.
                 self.pending.append((data, fut))
+                if at:
+                    from .wan import LinkScheduler
+
+                    await LinkScheduler.wait_until(at)
                 await send_frame(writer, data)
+
+        def _resolve(fut, ack):
+            if not fut.cancelled():
+                fut.set_result(ack)
 
         async def reader_loop():
             while True:
@@ -100,7 +125,12 @@ class _Connection:
                 # whose caller cancelled still consumed this ACK slot
                 if self.pending:
                     _, fut = self.pending.popleft()
-                    if not fut.cancelled():
+                    if self._delay_fn is not None:
+                        # the ACK's return leg crosses the same link
+                        asyncio.get_running_loop().call_later(
+                            self._delay_fn(), _resolve, fut, ack
+                        )
+                    elif not fut.cancelled():
                         fut.set_result(ack)
 
         wtask = asyncio.ensure_future(writer_loop())
@@ -121,7 +151,7 @@ class _Connection:
         self.task.cancel()
         # fail every outstanding ACK future so no caller hangs
         while not self.queue.empty():
-            _, fut = self.queue.get_nowait()
+            _, _, fut = self.queue.get_nowait()
             if not fut.done():
                 fut.cancel()
         for _, fut in self.pending:
@@ -131,13 +161,17 @@ class _Connection:
 
 
 class ReliableSender:
-    def __init__(self):
+    def __init__(self, link_delay=None):
         self._connections: dict[Address, _Connection] = {}
+        self._link_delay = link_delay
 
     def _connection(self, address: Address) -> _Connection:
         conn = self._connections.get(address)
         if conn is None or conn.task.done():
-            conn = _Connection(address)
+            delay_fn = (
+                self._link_delay(address) if self._link_delay else None
+            )
+            conn = _Connection(address, delay_fn=delay_fn)
             self._connections[address] = conn
         return conn
 
@@ -145,7 +179,8 @@ class ReliableSender:
         """Queue ``data`` for reliable delivery; the returned future resolves
         with the peer's ACK payload."""
         fut: CancelHandler = asyncio.get_running_loop().create_future()
-        await self._connection(address).queue.put((data, fut))
+        conn = self._connection(address)
+        await conn.queue.put((conn.deliver_at(), data, fut))
         return fut
 
     async def broadcast(

@@ -23,18 +23,38 @@ Address = tuple[str, int]
 
 
 class _Connection:
-    """Owns one persistent best-effort TCP connection."""
+    """Owns one persistent best-effort TCP connection.
 
-    def __init__(self, address: Address):
+    ``delay_fn`` (WAN emulation, network/wan.py): each queued message
+    carries a deliver-at time; the send loop waits until then before
+    writing — per-message propagation delay, pipelined (never a
+    head-of-line rate limit)."""
+
+    def __init__(self, address: Address, delay_fn=None):
         self.address = address
-        self.queue: asyncio.Queue[bytes] = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        self._scheduler = None
+        if delay_fn is not None:
+            from .wan import LinkScheduler
+
+            self._scheduler = LinkScheduler(delay_fn)
         self.task = asyncio.get_running_loop().create_task(
             self._run(), name=f"simple-conn-{address}"
         )
 
+    def put_nowait(self, data: bytes) -> None:
+        at = 0.0 if self._scheduler is None else self._scheduler.deliver_at()
+        self.queue.put_nowait((at, data))
+
+    async def _wait(self, at: float) -> None:
+        if at:
+            from .wan import LinkScheduler
+
+            await LinkScheduler.wait_until(at)
+
     async def _run(self) -> None:
         while True:
-            data = await self.queue.get()
+            at, data = await self.queue.get()
             try:
                 reader, writer = await asyncio.open_connection(*self.address)
             except OSError as e:
@@ -45,8 +65,9 @@ class _Connection:
             sink = asyncio.get_running_loop().create_task(self._sink_acks(reader))
             try:
                 while True:
+                    await self._wait(at)
                     await send_frame(writer, data)
-                    data = await self.queue.get()
+                    at, data = await self.queue.get()
             except (ConnectionError, OSError) as e:
                 log.warning("Failed to send message to %s: %s", self.address, e)
             finally:
@@ -68,22 +89,30 @@ class _Connection:
 
 
 class SimpleSender:
-    """Fire-and-forget sends; keeps one connection per peer."""
+    """Fire-and-forget sends; keeps one connection per peer.
 
-    def __init__(self):
+    ``link_delay``: optional WAN-emulation hook — a callable
+    ``(address) -> (() -> float)`` returning the per-link delay sampler
+    (None for an undelayed link)."""
+
+    def __init__(self, link_delay=None):
         self._connections: dict[Address, _Connection] = {}
+        self._link_delay = link_delay
 
     def _connection(self, address: Address) -> _Connection:
         conn = self._connections.get(address)
         if conn is None or conn.task.done():
-            conn = _Connection(address)
+            delay_fn = (
+                self._link_delay(address) if self._link_delay else None
+            )
+            conn = _Connection(address, delay_fn=delay_fn)
             self._connections[address] = conn
         return conn
 
     async def send(self, address: Address, data: bytes) -> None:
         conn = self._connection(address)
         try:
-            conn.queue.put_nowait(data)
+            conn.put_nowait(data)
         except asyncio.QueueFull:
             log.warning("Dropping message to %s: channel full", address)
 

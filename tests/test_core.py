@@ -287,6 +287,10 @@ async def test_timeout_backoff_grows_and_resets_on_progress(tmp_path):
         core = h.core
         base = 0.1
         assert core.timer.duration == base
+        # mark the committee ACTIVE (uncommitted payload block in
+        # flight): idle timeouts deliberately never grow the backoff
+        # (see test_idle_timeouts_keep_base_timer)
+        core.last_payload_round = 1
         from hotstuff_tpu.consensus.errors import ConsensusError
 
         async def fire_timer():
@@ -327,6 +331,41 @@ async def test_timeout_backoff_grows_and_resets_on_progress(tmp_path):
         assert core._timeout_exponent == 0
         assert core._consecutive_tcs == 0
         assert core.timer.duration == base
+    finally:
+        teardown(h)
+
+
+@async_test
+async def test_idle_timeouts_keep_base_timer(tmp_path):
+    """An IDLE committee (no proposals seen, nothing uncommitted in
+    flight — e.g. waiting for the first client payload) must not grow
+    the view-change backoff: a WAN f=3 committee was measured wedging
+    to ZERO commits because boot-time idle rounds compounded the timer
+    to 16 s+ before the first transaction arrived."""
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=100)
+    try:
+        core = h.core
+        base = 0.1
+        from hotstuff_tpu.consensus.errors import ConsensusError
+
+        async def fire_timer():
+            try:
+                await core._local_timeout_round()
+            except ConsensusError:
+                pass
+
+        for _ in range(4):  # idle spin: timer must stay at base
+            await fire_timer()
+            core._advance_round(core.round, via_tc=True)
+        assert core._timeout_exponent == 0
+        assert core.timer.duration == base
+
+        # a verified proposal for the current round marks it active:
+        # the NEXT timeout is a real liveness signal and backs off
+        core._saw_proposal = True
+        await fire_timer()
+        assert core._timeout_exponent == 1
+        assert core.timer.duration == base * 2
     finally:
         teardown(h)
 
