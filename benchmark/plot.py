@@ -80,12 +80,23 @@ def plot_latency_vs_throughput(
             label=_label(nodes, faults, verifier),
         )
     if reference_overlay:
-        from .baseline import REFERENCE_WAN_POINTS
+        from .baseline import REFERENCE_WAN_FAULTS, REFERENCE_WAN_POINTS
 
         for label, tps, lat_ms in REFERENCE_WAN_POINTS:
             ax.scatter([tps], [lat_ms], marker="*", s=120, zorder=5)
             ax.annotate(label, (tps, lat_ms), fontsize=7,
                         xytext=(4, 4), textcoords="offset points")
+        for faults, (tps_lo, tps_hi), (lat_lo, lat_hi) in REFERENCE_WAN_FAULTS:
+            # the published fault runs are ranges: draw the box
+            ax.fill_betweenx(
+                [lat_lo, lat_hi], tps_lo, tps_hi, alpha=0.15, zorder=1
+            )
+            ax.annotate(
+                f"ref f={faults} (10 nodes)",
+                ((tps_lo * tps_hi) ** 0.5, lat_hi),
+                fontsize=7, ha="center",
+                xytext=(0, 3), textcoords="offset points",
+            )
         ax.set_xscale("log")
     ax.set_xlabel("Throughput (payloads/s)")
     ax.set_ylabel("Consensus latency (ms)")

@@ -53,6 +53,7 @@ def run_scaling(
     rate: int = 1_000,
     duration: float = 20.0,
     timeout_delay: int = 5_000,
+    verifier: str = "cpu",
 ) -> str:
     os.environ["HOTSTUFF_WORK_STATS"] = "1"
     rows = []
@@ -64,6 +65,7 @@ def run_scaling(
                 duration=duration,
                 timeout_delay=timeout_delay,
                 in_process=True,
+                verifier=verifier,
             )
             parser: LogParser = bench.run()
             stats = scrape_workstats(PathMaker.logs_path())
@@ -137,8 +139,10 @@ def format_report(rows: list[dict], rate: int, duration: float) -> str:
     return "\n".join(lines)
 
 
-def main(sizes, rate, duration) -> int:
-    report = run_scaling(sizes=sizes, rate=rate, duration=duration)
+def main(sizes, rate, duration, verifier="cpu") -> int:
+    report = run_scaling(
+        sizes=sizes, rate=rate, duration=duration, verifier=verifier
+    )
     print(report)
     os.makedirs(PathMaker.results_path(), exist_ok=True)
     path = os.path.join(PathMaker.results_path(), "scaling-decomposition.txt")

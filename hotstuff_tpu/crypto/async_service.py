@@ -253,6 +253,11 @@ class AsyncVerifyService:
             return "cpu"
         if self._device_busy:
             return "cpu"
+        if getattr(self.backend, "always_offload", False):
+            # backends whose offload frees the loop unconditionally
+            # (BLS native pairings: ctypes releases the GIL) — no
+            # cost-model routing needed
+            return "device"
         if self._device_ewma_s is None:
             return "device"  # optimistic first dispatch
         cpu_est = n_sigs * CPU_US_PER_SIG * 1e-6
@@ -369,8 +374,14 @@ class AsyncVerifyService:
                     # Deadline: a tunnel stall mid-dispatch must not
                     # stall the committee — on overrun, serve this batch
                     # from the CPU and let the stuck dispatch land as a
-                    # (bad) EWMA measurement.
-                    deadline = max(0.1, 4 * (self._device_ewma_s or 0.1))
+                    # (bad) EWMA measurement.  Backends may raise the
+                    # floor (BLS: an adversarial storm legitimately
+                    # takes ~0.4 s off-loop; re-running it inline would
+                    # BE the stall).
+                    deadline = max(
+                        getattr(self.backend, "dispatch_deadline_s", 0.1),
+                        4 * (self._device_ewma_s or 0.1),
+                    )
                     done, _ = await asyncio.wait({exec_fut}, timeout=deadline)
                     if exec_fut in done:
                         results = exec_fut.result()

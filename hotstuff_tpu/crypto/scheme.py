@@ -164,6 +164,11 @@ class DualSchemeVerifier:
     backend it lands."""
 
     name = "dual"
+    # Shared-message claims must route through verify_shared_msg so the
+    # BLS side keeps its one-pairing aggregate (flattening a BLS QC into
+    # per-item checks costs two pairings per SIGNATURE); the ed25519
+    # side's verify_shared_msg is the same per-signature work either way.
+    prefers_aggregate = True
 
     def __init__(self, backends: dict[str, "VerifierBackend"]):
         self.backends = backends

@@ -26,6 +26,7 @@ import random
 from collections import deque
 
 from .framing import FramingError, read_frame, send_frame, set_nodelay
+from .wan import LinkScheduler
 
 log = logging.getLogger(__name__)
 
@@ -47,11 +48,9 @@ class _Connection:
         # deliver-at time; ACK futures resolve one return-leg later, so
         # the proposer's quorum-ACK back-pressure sees full RTTs.
         self._delay_fn = delay_fn
-        self._scheduler = None
-        if delay_fn is not None:
-            from .wan import LinkScheduler
-
-            self._scheduler = LinkScheduler(delay_fn)
+        self._scheduler = (
+            None if delay_fn is None else LinkScheduler(delay_fn)
+        )
         self.task = asyncio.get_running_loop().create_task(
             self._run(), name=f"reliable-conn-{address}"
         )
@@ -109,8 +108,6 @@ class _Connection:
                 # already exceeds any link delay.
                 self.pending.append((data, fut))
                 if at:
-                    from .wan import LinkScheduler
-
                     await LinkScheduler.wait_until(at)
                 await send_frame(writer, data)
 

@@ -14,6 +14,7 @@ import logging
 import random
 
 from .framing import read_frame, send_frame, set_nodelay
+from .wan import LinkScheduler
 
 log = logging.getLogger(__name__)
 
@@ -33,11 +34,9 @@ class _Connection:
     def __init__(self, address: Address, delay_fn=None):
         self.address = address
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
-        self._scheduler = None
-        if delay_fn is not None:
-            from .wan import LinkScheduler
-
-            self._scheduler = LinkScheduler(delay_fn)
+        self._scheduler = (
+            None if delay_fn is None else LinkScheduler(delay_fn)
+        )
         self.task = asyncio.get_running_loop().create_task(
             self._run(), name=f"simple-conn-{address}"
         )
@@ -48,8 +47,6 @@ class _Connection:
 
     async def _wait(self, at: float) -> None:
         if at:
-            from .wan import LinkScheduler
-
             await LinkScheduler.wait_until(at)
 
     async def _run(self) -> None:

@@ -1,0 +1,118 @@
+"""Latency-floor decomposition at 4 nodes / 1k tx/s (VERDICT r3 item 6).
+
+Runs the in-process committee three ways and reports consensus latency:
+  1. normal CPU verification;
+  2. null verification (every signature check monkeypatched to True —
+     measurement only, never a production mode): bounds the crypto
+     share of the round;
+  3. null verification AND null codec digests... (skipped: digests are
+     protocol-critical; crypto is the one cleanly removable stage).
+
+    python scripts/floor_decomposition.py
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, ".")
+
+
+async def run_committee(nodes: int, rate: int, duration: float) -> str:
+    from benchmark.logs import LogParser
+    from benchmark.utils import PathMaker
+    from hotstuff_tpu.node.node import Node
+
+    committee = []
+    for i in range(nodes):
+        committee.append(
+            await Node.new(
+                committee_file=PathMaker.committee_file(),
+                key_file=PathMaker.key_file(i),
+                store_path=PathMaker.db_path(i),
+                parameters_file=PathMaker.parameters_file(),
+                bind_host="127.0.0.1",
+            )
+        )
+    drain = asyncio.gather(*(n.analyze_block() for n in committee))
+    await asyncio.sleep(duration + 4)
+    drain.cancel()
+    for n in committee:
+        try:
+            await n.shutdown()
+        except Exception:
+            pass
+    parser = LogParser.process(PathMaker.logs_path())
+    tps, _ = parser.consensus_throughput()
+    lat = parser.consensus_latency()
+    return f"TPS={tps:.0f}/s latency={lat*1e3:.1f}ms blocks={len(parser.commits)}"
+
+
+def drive(label: str, nodes: int, rate: int, duration: float) -> None:
+    import logging
+
+    from benchmark.local import LocalBench
+    from benchmark.utils import PathMaker
+    from hotstuff_tpu.node.main import setup_logging
+
+    bench = LocalBench(nodes=nodes, rate=rate, duration=duration)
+    bench._cleanup_files()
+    bench._config()
+    setup_logging(2)
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        if isinstance(h, logging.FileHandler):
+            root.removeHandler(h)
+    handler = logging.FileHandler(PathMaker.node_log_file(0))
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s.%(msecs)03dZ [%(levelname)s] %(name)s %(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        )
+    )
+    root.addHandler(handler)
+
+    client = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "hotstuff_tpu.node.client",
+            "--committee",
+            PathMaker.committee_file(),
+            "--rate",
+            str(rate),
+            "--duration",
+            str(duration),
+            "--warmup",
+            "1",
+        ],
+        stdout=open(PathMaker.client_log_file(), "w"),
+        stderr=subprocess.STDOUT,
+        env={**os.environ, "PYTHONPATH": "."},
+    )
+    out = asyncio.run(run_committee(nodes, rate, duration))
+    client.wait(timeout=15)
+    print(f"{label}: {out}")
+
+
+def main() -> int:
+    nodes, rate, duration = 4, 1000, 12.0
+
+    drive("cpu-verify ", nodes, rate, duration)
+
+    # null verification: bound the crypto share of the round
+    from hotstuff_tpu.crypto import service, signature
+
+    service.CpuVerifier.verify_one = lambda self, d, pk, s: True
+    service.CpuVerifier.verify_shared_msg = lambda self, d, v: True
+    service.CpuVerifier.verify_many = (
+        lambda self, d, p, s, aggregate_ok=False: [True] * len(d)
+    )
+    signature.batch_verify_arrays = lambda d, p, s: [True] * len(d)
+    drive("null-verify", nodes, rate, duration)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

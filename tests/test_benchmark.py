@@ -72,6 +72,22 @@ def test_log_parser_matches_real_client_format():
     assert "AbCd+/==" in parser.samples
 
 
+def test_consensus_latency_excludes_empty_blocks():
+    """Latency population parity with the reference (its latency is per
+    batch digest): deliberately-EMPTY 2-chain-driver blocks wait for the
+    producer's next burst before their successor commits them — pacing,
+    not consensus work — and must not inflate the mean."""
+    node_log = (
+        "2026-01-01T00:00:01.000Z [INFO] x Created block 2 (payloads PAY1) -> BLK1\n"
+        "2026-01-01T00:00:01.010Z [INFO] x Committed block 2 -> BLK1\n"
+        "2026-01-01T00:00:01.020Z [INFO] x Created block 3 (payloads ) -> EMPTY1\n"
+        "2026-01-01T00:00:01.500Z [INFO] x Committed block 3 -> EMPTY1\n"
+    )
+    parser = LogParser([node_log], [])
+    # only BLK1 (10 ms) counts; EMPTY1's 480 ms pacing lag is excluded
+    assert abs(parser.consensus_latency() - 0.010) < 1e-6
+
+
 def test_bps_reported_from_tx_size():
     """Byte-throughput parity (VERDICT r3 item 4): the client logs the
     transaction size; the SUMMARY reports consensus/e2e BPS like the
