@@ -94,13 +94,15 @@ def run_scaling(
             )
     finally:
         os.environ.pop("HOTSTUFF_WORK_STATS", None)
-    return format_report(rows, rate, duration)
+    return format_report(rows, rate, duration, verifier=verifier)
 
 
-def format_report(rows: list[dict], rate: int, duration: float) -> str:
+def format_report(
+    rows: list[dict], rate: int, duration: float, verifier: str = "cpu"
+) -> str:
     lines = [
         "COMMITTEE-SCALING DECOMPOSITION (in-process, one core, "
-        f"{rate}/s input, {duration:.0f}s)",
+        f"{rate}/s input, {duration:.0f}s, verifier={verifier})",
         "",
         f"{'nodes':>6} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
         f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'pred 1-core/node':>17}",
@@ -122,6 +124,14 @@ def format_report(rows: list[dict], rate: int, duration: float) -> str:
     lines += [
         "",
         "READING THE TABLE",
+    ]
+    if verifier != "cpu":
+        lines += [
+            "- sigs/crypto read 0 under --verifier tpu: the async claims "
+            "path runs verification OFF the counted loop (that is the "
+            "point); use verifier=cpu for on-loop crypto accounting;"
+        ]
+    lines += [
         "- tps/lat: the starved single-core measurement (NOT protocol "
         "capability beyond ~8 nodes);",
         "- lag ms: mean event-loop scheduling lag — starvation onset is "

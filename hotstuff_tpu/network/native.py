@@ -79,6 +79,12 @@ def _load_lib() -> ctypes.CDLL:
     lib.ht_start.restype = ctypes.c_void_p
     lib.ht_notify_fd.restype = ctypes.c_int
     lib.ht_notify_fd.argtypes = [ctypes.c_void_p]
+    lib.ht_set_read_paused.restype = ctypes.c_int
+    lib.ht_set_read_paused.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_long,
+        ctypes.c_int,
+    ]
     lib.ht_listen.restype = ctypes.c_long
     lib.ht_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.ht_connect.restype = ctypes.c_long
@@ -229,7 +235,19 @@ class NativeReceiver:
     Frames are dispatched by ONE persistent worker task per accepted
     connection consuming an ordered queue — the same serial-per-
     connection discipline as the asyncio Receiver's runner loop (a task
-    per frame would churn the loop under bursts and allow reordering)."""
+    per frame would churn the loop under bursts and allow reordering).
+
+    Flow control: the asyncio receiver gets backpressure for free (its
+    reader task blocks on a full handler queue, closing the TCP
+    window); here the reactor reads frames regardless, so the dispatch
+    queue is watermarked — past HIGH_WATER the connection's reads are
+    PAUSED in the reactor (ht_set_read_paused) and resumed below
+    LOW_WATER.  Without this, an overload run (8k tx/s at 4 nodes)
+    buffered everything in unbounded queues and collapsed throughput
+    30x vs asyncio."""
+
+    HIGH_WATER = 256
+    LOW_WATER = 64
 
     def __init__(self, host: str, port: int, handler):
         self.host = host
@@ -239,6 +257,7 @@ class NativeReceiver:
         self._listener = -1
         self._queues: dict[int, asyncio.Queue] = {}
         self._workers: dict[int, asyncio.Task] = {}
+        self._paused: set[int] = set()
 
     async def spawn(self) -> None:
         self.reactor.ensure_reader()
@@ -255,6 +274,7 @@ class NativeReceiver:
         if kind == KIND_ACCEPTED_CLOSED:
             q = self._queues.pop(conn_id, None)
             worker = self._workers.pop(conn_id, None)
+            self._paused.discard(conn_id)
             if q is not None:
                 q.put_nowait(None)  # drain sentinel; worker exits
             del worker  # cancelled implicitly by the sentinel
@@ -269,6 +289,11 @@ class NativeReceiver:
                 self._worker(conn_id, q), name=f"native-conn-{conn_id}"
             )
         q.put_nowait(payload)
+        if q.qsize() >= self.HIGH_WATER and conn_id not in self._paused:
+            self._paused.add(conn_id)
+            self.reactor.lib.ht_set_read_paused(
+                self.reactor.handle, conn_id, 1
+            )
 
     async def _worker(self, conn_id: int, q: asyncio.Queue) -> None:
         writer = NativeWriter(self.reactor, conn_id)
@@ -277,6 +302,15 @@ class NativeReceiver:
             if payload is None:
                 return
             await self.handler.dispatch(writer, payload)
+            if (
+                conn_id in self._paused
+                and q.qsize() <= self.LOW_WATER
+                and self.reactor.handle
+            ):
+                self._paused.discard(conn_id)
+                self.reactor.lib.ht_set_read_paused(
+                    self.reactor.handle, conn_id, 0
+                )
 
     async def shutdown(self) -> None:
         for t in list(self._workers.values()):

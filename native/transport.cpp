@@ -73,6 +73,12 @@ struct Conn {
   std::deque<std::string> outbox;  // framed messages not yet in wbuf
   bool closed = false;
   bool pending_close = false;  // Python asked; reactor thread executes
+  // Flow control: Python pauses reads when its dispatch queue for this
+  // connection crosses the high-water mark, so TCP backpressure reaches
+  // the sender instead of frames piling up in unbounded Python queues
+  // (measured: an 8k tx/s overload collapsed throughput 30x without it).
+  bool read_paused = false;
+  bool pending_rearm = false;  // pause state changed off-thread
 };
 
 int set_nonblock(int fd) {
@@ -118,9 +124,9 @@ struct Reactor {
     }
   }
 
-  void arm(int fd, bool want_write) {
+  void arm(int fd, bool want_write, bool want_read = true) {
     epoll_event ev{};
-    ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0u);
+    ev.events = (want_read ? EPOLLIN : 0u) | (want_write ? EPOLLOUT : 0u);
     ev.data.fd = fd;
     epoll_ctl(epfd, EPOLL_CTL_MOD, fd, &ev);
   }
@@ -252,7 +258,8 @@ struct Reactor {
           }
         }
       }
-      if (!broken) arm(c.fd, !c.wbuf.empty() || !c.outbox.empty());
+      if (!broken)
+        arm(c.fd, !c.wbuf.empty() || !c.outbox.empty(), !c.read_paused);
     }
     if (broken) close_conn(id, true);
   }
@@ -370,6 +377,26 @@ struct Reactor {
             // consumed; outbound handles are being discarded entirely
             if (it != conns.end() && it->second.outbound) conns.erase(it);
           }
+          // apply read-pause changes requested off-thread: snapshot
+          // (fd, want_write, want_read) under the lock, re-arm after
+          {
+            struct Rearm { int fd; bool w; bool r; };
+            std::vector<Rearm> rearm;
+            {
+              std::lock_guard<std::mutex> g(mu);
+              for (auto& [id, c] : conns) {
+                (void)id;
+                if (c.pending_rearm && c.fd >= 0 && !c.connecting) {
+                  c.pending_rearm = false;
+                  rearm.push_back(Rearm{
+                      c.fd,
+                      !c.wbuf.empty() || !c.outbox.empty(),
+                      !c.read_paused});
+                }
+              }
+            }
+            for (const Rearm& a : rearm) arm(a.fd, a.w, a.r);
+          }
           // flush every outbound conn with pending frames; start
           // connections for peers that are down
           std::vector<long> want;
@@ -394,7 +421,7 @@ struct Reactor {
             auto it = conns.find(id);
             if (it != conns.end() && it->second.fd >= 0 &&
                 !it->second.connecting) {
-              arm(it->second.fd, true);
+              arm(it->second.fd, true, !it->second.read_paused);
             }
           }
           continue;
@@ -582,6 +609,23 @@ int ht_next(void* rp, long* src, int* kind, uint8_t* buf, int cap) {
 // and forget it.  Deferred to the reactor: only it may ::close() an fd
 // it could concurrently be reading/writing (an off-thread close would
 // race with recv/send and could hit a recycled fd number).
+// Flow control from Python: pause/resume reading a connection.  The
+// reactor re-arms the fd on the next wake; while paused, the kernel
+// receive buffer fills and TCP backpressure reaches the sender.
+int ht_set_read_paused(void* rp, long conn, int paused) {
+  auto* r = static_cast<Reactor*>(rp);
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->conns.find(conn);
+    if (it == r->conns.end()) return -1;
+    if (it->second.read_paused == static_cast<bool>(paused)) return 0;
+    it->second.read_paused = paused;
+    it->second.pending_rearm = true;
+  }
+  r->wake();
+  return 0;
+}
+
 int ht_close_conn(void* rp, long conn) {
   auto* r = static_cast<Reactor*>(rp);
   {
