@@ -39,6 +39,8 @@ def task_local(args) -> int:
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
     )
+    if args.transport != "asyncio":
+        label += f"-{args.transport}"
     if args.in_process:
         label += "-1proc"
     if args.wan:

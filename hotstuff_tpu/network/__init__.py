@@ -5,12 +5,26 @@ Parity map (SURVEY.md §2.3): Receiver/MessageHandler/Writer, SimpleSender
 reference crate ``network/``.
 """
 
+from .errors import (
+    AckError,
+    ConnectError,
+    ListenError,
+    NetworkError,
+    ReceiveError,
+    SendError,
+)
 from .framing import FramingError, read_frame, send_frame, write_frame
 from .receiver import MessageHandler, Receiver, Writer
 from .reliable_sender import CancelHandler, ReliableSender
 from .simple_sender import SimpleSender
 
 __all__ = [
+    "NetworkError",
+    "ConnectError",
+    "ListenError",
+    "SendError",
+    "ReceiveError",
+    "AckError",
     "FramingError",
     "read_frame",
     "send_frame",

@@ -65,17 +65,30 @@ def _load_lib() -> ctypes.CDLL:
     if os.environ.get("HOTSTUFF_TRANSPORT_NATIVE") == "0":
         raise ImportError("native transport disabled")
     path = os.path.join(_native_dir(), "build", _LIB_NAME)
-    if not os.path.exists(path):
-        try:
-            subprocess.run(
-                ["make", "-C", _native_dir()],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError) as e:
+    # Run make unconditionally BEFORE the first dlopen: it is an mtime
+    # no-op when the library is fresh, and it rebuilds a stale prebuilt
+    # .so (e.g. one predating an added entry point) — rebuilding after
+    # dlopen wouldn't help, since dlopen dedups by pathname and would
+    # keep returning the old mapping.
+    try:
+        subprocess.run(
+            ["make", "-C", _native_dir(), f"build/{_LIB_NAME}"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        if not os.path.exists(path):
             raise ImportError(f"cannot build {_LIB_NAME}: {e}") from e
+        # no toolchain but a prebuilt library exists: try it
     lib = ctypes.CDLL(path)
+    if not hasattr(lib, "ht_set_read_paused"):
+        # keep the documented contract (ImportError, so importorskip /
+        # try-except fallbacks behave instead of AttributeError at bind)
+        raise ImportError(
+            f"stale {_LIB_NAME}: missing ht_set_read_paused; "
+            f"rebuild with `make -C native`"
+        )
     lib.ht_start.restype = ctypes.c_void_p
     lib.ht_notify_fd.restype = ctypes.c_int
     lib.ht_notify_fd.argtypes = [ctypes.c_void_p]
@@ -266,7 +279,9 @@ class NativeReceiver:
             self.reactor.handle, host.encode(), self.port
         )
         if self._listener < 0:
-            raise OSError(f"native listen failed on {host}:{self.port}")
+            from .errors import ListenError
+
+            raise ListenError((host, self.port), "native listen failed")
         self.reactor._routers[self._listener] = self._route
         log.debug("Native listener on %s:%d", host, self.port)
 
@@ -301,7 +316,25 @@ class NativeReceiver:
             payload = await q.get()
             if payload is None:
                 return
-            await self.handler.dispatch(writer, payload)
+            try:
+                await self.handler.dispatch(writer, payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — a handler bug must not
+                # leave the connection read-paused forever (a silent,
+                # reconnect-less stall): close it like the asyncio
+                # Receiver does when dispatch raises, so the peer's
+                # reconnect logic recovers.  The close event cleans up
+                # _queues/_paused via _route.
+                log.exception(
+                    "handler.dispatch failed on native conn %d; closing",
+                    conn_id,
+                )
+                if self.reactor.handle:
+                    self.reactor.lib.ht_close_conn(
+                        self.reactor.handle, conn_id
+                    )
+                return
             if (
                 conn_id in self._paused
                 and q.qsize() <= self.LOW_WATER

@@ -48,9 +48,14 @@ class Receiver:
         self._writers: set[asyncio.StreamWriter] = set()
 
     async def spawn(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_connection, self.host, self.port
-        )
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_connection, self.host, self.port
+            )
+        except OSError as e:
+            from .errors import classify
+
+            raise classify(e, "listen", (self.host, self.port)) from e
         log.debug("Listening on %s:%d", self.host, self.port)
 
     async def _handle_connection(

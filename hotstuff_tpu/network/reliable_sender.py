@@ -25,6 +25,7 @@ import logging
 import random
 from collections import deque
 
+from .errors import classify
 from .framing import FramingError, read_frame, send_frame, set_nodelay
 from .wan import LinkScheduler
 
@@ -64,7 +65,7 @@ class _Connection:
             try:
                 reader, writer = await asyncio.open_connection(*self.address)
             except OSError as e:
-                log.debug("Failed to connect to %s: %s", self.address, e)
+                log.debug("%s", classify(e, "connect", self.address))
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, RETRY_CAP_S)
                 continue
@@ -79,7 +80,11 @@ class _Connection:
                 asyncio.IncompleteReadError,
                 FramingError,
             ) as e:
-                log.warning("Connection to %s dropped: %s", self.address, e)
+                # classify by what broke: the ACK pairing (un-ACKed
+                # frames in flight -> retransmitted on reconnect) vs a
+                # plain receive failure
+                op = "ack" if self.pending else "receive"
+                log.warning("%s", classify(e, op, self.address))
             finally:
                 writer.close()
 

@@ -13,6 +13,7 @@ import asyncio
 import logging
 import random
 
+from .errors import classify
 from .framing import read_frame, send_frame, set_nodelay
 from .wan import LinkScheduler
 
@@ -55,7 +56,7 @@ class _Connection:
             try:
                 reader, writer = await asyncio.open_connection(*self.address)
             except OSError as e:
-                log.warning("Failed to connect to %s: %s", self.address, e)
+                log.warning("%s", classify(e, "connect", self.address))
                 continue  # drop this message, wait for the next
             set_nodelay(writer)
             log.debug("Outgoing connection established with %s", self.address)
@@ -66,7 +67,7 @@ class _Connection:
                     await send_frame(writer, data)
                     at, data = await self.queue.get()
             except (ConnectionError, OSError) as e:
-                log.warning("Failed to send message to %s: %s", self.address, e)
+                log.warning("%s", classify(e, "send", self.address))
             finally:
                 sink.cancel()
                 writer.close()

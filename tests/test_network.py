@@ -180,3 +180,46 @@ def test_reliable_retransmits_unacked_on_reconnect():
         sender.close()
 
     asyncio.run(body())
+
+
+def test_network_error_taxonomy():
+    """Typed connect/listen/send/receive/ACK errors (reference
+    network/src/error.rs:6-25): classifiable, address-carrying, and
+    OSError-compatible so existing raw-tuple handlers keep working."""
+    from hotstuff_tpu.network import (
+        AckError,
+        ConnectError,
+        ListenError,
+        NetworkError,
+    )
+    from hotstuff_tpu.network.errors import classify
+
+    err = classify(ConnectionRefusedError(111, "refused"), "connect",
+                   ("10.0.0.1", 9999))
+    assert isinstance(err, ConnectError)
+    assert isinstance(err, NetworkError)
+    assert isinstance(err, OSError)  # raw-tuple handlers still catch it
+    assert "10.0.0.1:9999" in str(err)
+    assert isinstance(classify(OSError(), "ack"), AckError)
+    assert isinstance(classify(OSError(), "listen"), ListenError)
+
+
+def test_listen_failure_is_typed():
+    """Binding a port twice raises the taxonomy's ListenError."""
+    from hotstuff_tpu.network import ListenError
+
+    async def body():
+        port = BASE_PORT + 90
+
+        class NullHandler:
+            async def dispatch(self, writer, message):
+                pass
+
+        a = Receiver("127.0.0.1", port, NullHandler())
+        await a.spawn()
+        b = Receiver("127.0.0.1", port, NullHandler())
+        with pytest.raises(ListenError):
+            await b.spawn()
+        await a.shutdown()
+
+    asyncio.run(body())
