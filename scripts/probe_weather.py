@@ -1,0 +1,38 @@
+"""One-line tunnel weather check: median dispatch+fetch time of a tiny
+resident-arg jit call.  <5 ms = good window (device routing will win);
+>50 ms = degraded (the adaptive service will serve waves from the CPU).
+
+    python scripts/probe_weather.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    @jax.jit
+    def f(x):
+        return (x * 2 + 1).sum()
+
+    x = jax.device_put(np.ones((128, 20), np.int32))
+    jax.block_until_ready(f(x))
+    times = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2] * 1e3
+    verdict = "good" if p50 < 5 else ("fair" if p50 < 50 else "degraded")
+    print(f"tunnel dispatch p50 {p50:.2f} ms ({verdict})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
