@@ -44,6 +44,18 @@ def setup_logging(verbosity: int) -> None:
     )
 
 
+def _freeze_boot_objects() -> None:
+    """Move boot-time immortals (committee state, caches, and — with a
+    device verifier — the whole jax runtime) out of the GC's collected
+    generations: steady-state collections otherwise scan megabytes of
+    permanent objects every pass, which a one-core rig feels directly in
+    round latency (measured ~2x consensus-latency cut at 16 nodes)."""
+    import gc
+
+    gc.collect()
+    gc.freeze()
+
+
 async def _run_node(args) -> None:
     node = await Node.new(
         committee_file=args.committee,
@@ -53,6 +65,7 @@ async def _run_node(args) -> None:
         verifier_backend=args.verifier,
         transport=args.transport,
     )
+    _freeze_boot_objects()
     await node.analyze_block()
 
 
@@ -82,6 +95,7 @@ async def _run_many(args) -> None:
                 bind_host="127.0.0.1",
             )
         )
+    _freeze_boot_objects()
     await asyncio.gather(*(n.analyze_block() for n in nodes))
 
 
@@ -103,6 +117,16 @@ async def _deploy_testbed(nodes: int, base_port: int, scheme: str) -> None:
     for i, secret in enumerate(keys):
         secret.write(f".node_{i}.json")
 
+    # The testbed's keypairs are FRESH every run, so a leftover .db_*
+    # from an earlier deployment can never belong to this committee —
+    # recovering its consensus state would boot the new committee at a
+    # stale round with another committee's high_qc (observed: a fresh
+    # testbed "recovering" to round ~800).  Wipe before boot.
+    import shutil
+
+    for i in range(nodes):
+        shutil.rmtree(f".db_{i}", ignore_errors=True)
+
     booted = []
     for i in range(nodes):
         node = await Node.new(
@@ -114,6 +138,7 @@ async def _deploy_testbed(nodes: int, base_port: int, scheme: str) -> None:
         )
         booted.append(node)
     log.info("Deployed %d-node local testbed on base port %d", nodes, base_port)
+    _freeze_boot_objects()
     await asyncio.gather(*(n.analyze_block() for n in booted))
 
 

@@ -35,6 +35,9 @@ async def run_committee(nodes: int, rate: int, duration: float) -> str:
                 bind_host="127.0.0.1",
             )
         )
+    from hotstuff_tpu.node.main import _freeze_boot_objects
+
+    _freeze_boot_objects()  # match the production run-many GC shape
     drain = asyncio.gather(*(n.analyze_block() for n in committee))
     await asyncio.sleep(duration + 4)
     drain.cancel()

@@ -90,6 +90,9 @@ def main() -> int:
                     bind_host="127.0.0.1",
                 )
             )
+        from hotstuff_tpu.node.main import _freeze_boot_objects
+
+        _freeze_boot_objects()  # match the production run-many GC shape
         drain = asyncio.gather(*(n.analyze_block() for n in nodes))
         await asyncio.sleep(args.duration + 3)
         drain.cancel()
