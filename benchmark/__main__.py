@@ -34,11 +34,14 @@ def task_local(args) -> int:
         in_process=args.in_process,
         tx_size=args.tx_size,
         wan=args.wan,
+        payload_homes=args.payload_homes,
     )
     parser = bench.run()
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
     )
+    if args.payload_homes != 1:
+        label += f"-homes{args.payload_homes}"
     if args.transport != "asyncio":
         label += f"-{args.transport}"
     if args.in_process:
@@ -217,6 +220,14 @@ def main(argv=None) -> int:
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
     p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
+    p.add_argument(
+        "--payload-homes",
+        type=int,
+        default=1,
+        help="nodes receiving each payload (client --homes): 1 = "
+        "disjoint queues; more trades duplicate-proposal slack for "
+        "earlier proposal (lower e2e latency at large committees)",
+    )
     p.add_argument(
         "--wan",
         action="store_true",

@@ -43,10 +43,12 @@ class LocalBench:
         in_process: bool = False,
         tx_size: int = 512,
         wan: bool = False,
+        payload_homes: int = 1,
     ):
         self.nodes = nodes
         self.rate = rate
         self.tx_size = tx_size
+        self.payload_homes = payload_homes
         # WAN emulation: write a 5-region link-delay spec and point the
         # committee at it (hotstuff_tpu/network/wan.py)
         self.wan = wan
@@ -244,6 +246,8 @@ class LocalBench:
                     str(self.rate),
                     "--size",
                     str(self.tx_size),
+                    "--homes",
+                    str(self.payload_homes),
                     "--duration",
                     str(self.duration),
                     "--warmup",

@@ -4,10 +4,11 @@ The reference's client (node/src/client.rs:40-153) still speaks the
 deleted mempool's "front" port and can't drive the fork (SURVEY.md §2.5
 stale-fork caveat). This client speaks the fork's actual ingest path:
 ``Producer(Digest)`` messages on the consensus port
-(consensus/src/consensus.rs:151-160), round-robining each payload to ONE
-live node — the single-client equivalent of the reference harness's
-one-client-per-node topology (local.py:79-91), keeping proposer queues
-disjoint so concurrent leaders never fill blocks with duplicates.
+(consensus/src/consensus.rs:151-160), round-robining each payload to
+``--homes`` live nodes (default 1 — the single-client equivalent of the
+reference harness's one-client-per-node topology, local.py:79-91,
+keeping proposer queues disjoint so concurrent leaders never fill
+blocks with duplicates).
 
 Kept from the reference's methodology (client.rs:103-152):
 - wait for every node's port to be listening, then an extra warm-up;
@@ -181,17 +182,26 @@ async def run_client(
     warmup: float = 0.0,
     expect_faults: int = 0,
     size: int = 512,
+    homes: int = 1,
 ) -> int:
     """Send ``rate`` producer payloads/s for ``duration`` seconds,
-    round-robining each payload to ONE live node (disjoint proposer
-    queues — see the comment at the send loop).  Returns the TOTAL
-    number of payloads sent across all nodes.
+    round-robining each payload to ``homes`` live nodes (see the
+    comment at the send loop).  Returns the TOTAL number of payloads
+    sent across all nodes.
 
     ``size``: payload BODY bytes per transaction (default 512, the
     reference's WAN tx size, data/2-chain/README.md:42-57) — the body
     rides the producer message and is stored by the ingest node, so the
     harness measures real byte throughput.  ``size=0`` sends bare
-    digests (the fork's original digest-only producer contract)."""
+    digests (the fork's original digest-only producer contract).
+
+    ``homes``: how many (consecutive round-robin) nodes receive each
+    payload.  1 (default) keeps proposer queues disjoint — maximum
+    block capacity, but a payload waits for ITS node's leader turn
+    (~half a committee lap of e2e latency at large n).  2+ trades a
+    bounded duplicate-proposal window (the proposers prune committed
+    digests on every commit signal) for proportionally earlier
+    proposal."""
     import os
 
     from ..consensus.wire import encode_producer
@@ -260,15 +270,18 @@ async def run_client(
             # drain is an await even when the buffer has room).  Send
             # errors mark THAT connection dead (handled inside
             # _NodeConn); the burst continues to the rest.
-            # Round-robin each payload to ONE live node (the reference
-            # runs one client per node feeding only it, local.py:79-91;
-            # this is the single-client equivalent).  Broadcasting every
-            # payload to every node makes all proposer queues identical,
-            # so concurrent leaders fill blocks with the same digests —
-            # measured 3/4 of committed-block capacity wasted on
-            # duplicates at 4 nodes.  Disjoint queues keep every block
-            # slot unique; orphaned proposals are re-buffered by the
-            # proposer (orphan recovery), so single-homing is safe.
+            # Round-robin each payload to ``homes`` live nodes
+            # (default 1: the reference runs one client per node feeding
+            # only it, local.py:79-91; this is the single-client
+            # equivalent).  Broadcasting every payload to EVERY node
+            # makes all proposer queues identical, so concurrent leaders
+            # fill blocks with the same digests — measured 3/4 of
+            # committed-block capacity wasted on duplicates at 4 nodes;
+            # homes=2 measured strictly worse on a one-core host too
+            # (docs/ROUND4.md).  With homes=1 queues are disjoint and
+            # every block slot unique; orphaned proposals are
+            # re-buffered by the proposer (orphan recovery), so
+            # single-homing is safe.
             live = [c for c in conns if c.alive]
             # with zero live peers nothing is transmitted: neither the
             # sent counter nor the sample log line may claim otherwise
@@ -288,9 +301,9 @@ async def run_client(
                 if i == 0:
                     # NOTE: this log entry is used to compute performance.
                     log.info("Sending sample payload %s", digest)
-                live[sent % len(live)].send_frame(
-                    encode_producer(digest, body)
-                )
+                frame = encode_producer(digest, body)
+                for h in range(min(homes, len(live))):
+                    live[(sent + h) % len(live)].send_frame(frame)
                 sent += 1
             for c in conns:
                 await c.drain()
@@ -327,6 +340,13 @@ def main(argv=None) -> int:
         help="payload body bytes (0 = digest-only producer contract)",
     )
     parser.add_argument(
+        "--homes",
+        type=int,
+        default=1,
+        help="nodes receiving each payload (1 = disjoint queues; more "
+        "trades duplicate-proposal slack for earlier proposal)",
+    )
+    parser.add_argument(
         "--duration", type=float, default=20.0, help="send window (s)"
     )
     parser.add_argument(
@@ -349,6 +369,10 @@ def main(argv=None) -> int:
 
     from ..consensus.wire import MAX_PAYLOAD_BODY
 
+    if args.homes < 1:
+        # fail FAST: homes=0 would count and sample-log payloads that
+        # never hit the wire, reporting a silent zero-commit run
+        parser.error("--homes must be >= 1")
     if not 0 <= args.size <= MAX_PAYLOAD_BODY:
         # fail FAST: an oversized body would be dropped by every node's
         # wire decoder and the run would silently report zero commits
@@ -366,6 +390,7 @@ def main(argv=None) -> int:
             args.warmup,
             expect_faults=args.faults,
             size=args.size,
+            homes=args.homes,
         )
     )
     log.info("Sent %d payloads", sent)
