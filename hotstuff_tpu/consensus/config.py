@@ -46,6 +46,12 @@ class Parameters:
     # None = derived: max(60 s, timeout_delay) — so a large base delay
     # never collides with the fixed default cap.
     timeout_cap_ms: int | None = None
+    # Byte budget for UNCOMMITTED producer payload bodies persisted by
+    # the receiver (advisor r4): without it, any peer reaching the open
+    # consensus port could fill the disk with unique content-addressed
+    # bodies.  Oldest uncommitted bodies are evicted when the budget
+    # overflows; committed bodies are history and never evicted.
+    payload_body_budget: int = 256 * 1024 * 1024
 
     def __post_init__(self) -> None:
         # A backoff below 1 would make consecutive timeouts geometrically
@@ -62,6 +68,15 @@ class Parameters:
             raise InvalidParameters(
                 f"timeout_cap_ms ({self.timeout_cap_ms}) must be >= "
                 f"timeout_delay ({self.timeout_delay})"
+            )
+        # must admit at least one maximum-size body or every producer
+        # submission with a body would be silently rejected
+        from .wire import MAX_PAYLOAD_BODY  # noqa: PLC0415 — cycle guard
+
+        if self.payload_body_budget < MAX_PAYLOAD_BODY:
+            raise InvalidParameters(
+                f"payload_body_budget ({self.payload_body_budget}) must "
+                f"be >= one maximum body ({MAX_PAYLOAD_BODY})"
             )
 
     def log(self) -> None:
@@ -84,6 +99,7 @@ class Parameters:
             "sync_retry_delay": self.sync_retry_delay,
             "timeout_backoff": self.timeout_backoff,
             "timeout_cap_ms": self.timeout_cap_ms,
+            "payload_body_budget": self.payload_body_budget,
         }
 
     @classmethod
@@ -101,6 +117,9 @@ class Parameters:
                 int(data["timeout_cap_ms"])
                 if data.get("timeout_cap_ms") is not None
                 else None
+            ),
+            payload_body_budget=int(
+                data.get("payload_body_budget", default.payload_body_budget)
             ),
         )
 

@@ -53,6 +53,69 @@ def payload_key(digest) -> bytes:
     return PAYLOAD_KEY_PREFIX + digest.to_bytes()
 
 
+class PayloadBodies:
+    """Budgeted store-backed cache of producer payload bodies.
+
+    Advisor finding (r4): the receiver persisted arbitrary
+    unauthenticated bodies with no quota — any peer reaching the open
+    consensus port could fill the disk with unique content-addressed
+    bodies.  Bodies are now admitted against a byte budget
+    (``Parameters.payload_body_budget``); while a body's digest is
+    uncommitted it stays evictable (oldest first, FIFO — the shape an
+    honest backlog drains in), and once the digest appears in a
+    committed block the body is history and leaves the evictable set.
+    A restarted node starts with an empty evictable set: bodies
+    persisted by a previous process are treated as history (the budget
+    bounds what one process lifetime can be tricked into writing).
+    """
+
+    def __init__(self, store: Store, budget: int):
+        self.store = store
+        self.budget = budget
+        self._pending: dict[bytes, int] = {}  # digest bytes -> body size
+        self._pending_bytes = 0
+        self.evicted = 0
+
+    async def admit(self, digest, body: bytes) -> None:
+        key = digest.to_bytes()
+        if key in self._pending:
+            return  # same content, already stored and accounted
+        # A body already in the store is history (committed earlier, or
+        # persisted by a previous process lifetime): a replayed producer
+        # frame must NOT re-enter it into the evictable set — that would
+        # let an attacker replay a committed payload and then flood the
+        # budget until its committed body was deleted.
+        if await self.store.read(payload_key(digest)) is not None:
+            return
+        if key in self._pending:
+            return  # re-check: a concurrent admit won the race
+        # Reserve before mutating the store so accounting can never
+        # double-count.  (Store operations complete without yielding to
+        # the event loop today — the awaits above/below are synchronous
+        # — but this ordering stays correct if the store ever parks.)
+        self._pending[key] = len(body)
+        self._pending_bytes += len(body)
+        while self._pending_bytes > self.budget and len(self._pending) > 1:
+            oldest = next(iter(self._pending))
+            if oldest == key:
+                # never evict the body being admitted: the budget floor
+                # (>= one maximum body, config validation) makes a sole
+                # pending entry always fit
+                break
+            self._pending_bytes -= self._pending.pop(oldest)
+            await self.store.delete(PAYLOAD_KEY_PREFIX + oldest)
+            self.evicted += 1
+        await self.store.write(payload_key(digest), body)
+
+    def mark_committed(self, digests) -> None:
+        """Bodies of committed payloads stop counting against (and being
+        evictable under) the budget."""
+        for d in digests:
+            size = self._pending.pop(d.to_bytes(), None)
+            if size is not None:
+                self._pending_bytes -= size
+
+
 class ConsensusReceiverHandler:
     def __init__(
         self,
@@ -60,7 +123,7 @@ class ConsensusReceiverHandler:
         tx_helper: asyncio.Queue,
         tx_producer: asyncio.Queue,
         scheme: str | None = None,
-        store: Store | None = None,
+        bodies: PayloadBodies | None = None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
@@ -69,7 +132,7 @@ class ConsensusReceiverHandler:
         if scheme is not None and scheme not in SCHEME_WIRE_SIZES:
             raise ValueError(f"unknown committee scheme '{scheme}'")
         self.scheme = scheme
-        self.store = store
+        self.bodies = bodies
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         try:
@@ -98,8 +161,8 @@ class ConsensusReceiverHandler:
                         "match its digest"
                     )
                     return
-                if self.store is not None:
-                    await self.store.write(payload_key(digest), body)
+                if self.bodies is not None:
+                    await self.bodies.admit(digest, body)
             try:
                 await writer.send(ACK)
             except (ConnectionError, OSError):
@@ -144,6 +207,7 @@ class Consensus:
         if verifier is None:
             verifier = CpuVerifier()
 
+        payload_bodies = PayloadBodies(store, parameters.payload_body_budget)
         tx_producer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         tx_consensus: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         tx_loopback: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
@@ -204,7 +268,7 @@ class Consensus:
                 tx_consensus, tx_helper, tx_producer,
                 # mixed-scheme schedules accept the union on the wire
                 scheme=committee.wire_scheme(),
-                store=store,
+                bodies=payload_bodies,
             ),
         )
         await self.receiver.spawn()
@@ -241,6 +305,7 @@ class Consensus:
             tx_proposer=tx_proposer,
             tx_commit=tx_commit,
             network=make_sender(),
+            payload_bodies=payload_bodies,
         )
         self._tasks.append(self.core.spawn())
 

@@ -32,7 +32,7 @@ from ..crypto.service import VerifierBackend
 from ..network import SimpleSender
 from ..store import Store
 from ..utils.codec import Decoder, Encoder
-from .aggregator import Aggregator
+from .aggregator import ROUND_LOOKAHEAD, Aggregator
 from .config import Committee
 from .errors import ConsensusError, SerializationError, WrongLeader
 from .leader import LeaderElector
@@ -193,6 +193,7 @@ class Core:
         network: SimpleSender | None = None,
         timeout_backoff: float = 2.0,
         timeout_cap_ms: int = 60_000,
+        payload_bodies=None,
     ):
         self.name = name
         self.committee = committee
@@ -205,6 +206,9 @@ class Core:
         self.rx_loopback = rx_loopback
         self.tx_proposer = tx_proposer
         self.tx_commit = tx_commit
+        # consensus.PayloadBodies: committed payload bodies leave the
+        # receiver's eviction budget (they became history)
+        self.payload_bodies = payload_bodies
         self.round: Round = 1
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
@@ -366,6 +370,8 @@ class Core:
         # payloads of orphaned blocks return to the buffer (orphan
         # recovery; the reference instead drops whole per-round buckets
         # on cleanup, proposer.rs:164-173, losing them entirely).
+        if self.payload_bodies is not None:
+            self.payload_bodies.mark_committed(committed_payloads)
         await self.tx_proposer.put(
             ProposerMessage.cleanup(
                 [],
@@ -702,7 +708,13 @@ class Core:
 
         def collect_vote(idx, payload) -> None:
             if (
-                payload.round >= self.round
+                # mirror Aggregator.add_vote's bounds: a far-future vote
+                # is rejected there with ZERO crypto (AggregationBounds)
+                # — collecting its claim here would convert that free
+                # rejection into attacker-priced signature work
+                self.round
+                <= payload.round
+                <= self.round + ROUND_LOOKAHEAD
                 and self.committee.for_round(payload.round).stake(
                     payload.author
                 )
@@ -730,7 +742,11 @@ class Core:
         for idx, (tag, payload) in enumerate(burst):
             if tag == TAG_TIMEOUT:
                 if (
-                    payload.round >= self.round
+                    # same lookahead bound as add_timeout: far-future
+                    # timeouts are a free rejection, not crypto work
+                    self.round
+                    <= payload.round
+                    <= self.round + ROUND_LOOKAHEAD
                     # committee membership BEFORE aggregation — the
                     # soundness precondition above
                     and self.committee.for_round(payload.round).stake(

@@ -185,6 +185,19 @@ class AsyncVerifyService:
             # a private service — coalescing across cores is lost but
             # nothing binds to a wrong loop
             return cls(backend, device=True)
+        # prune entries bound to closed loops (repeated benchmark runs /
+        # test loops in one process): each would otherwise pin its loop
+        # object plus an idle single-thread executor forever
+        stale = [
+            (k, svc)
+            for k, (stored, svc) in cls._registry.items()
+            if stored.is_closed()
+        ]
+        for k, svc in stale:
+            cls._registry.pop(k, None)
+            if svc._executor is not None:
+                svc._executor.shutdown(wait=False)
+                svc._executor = None
         key = (id(loop), kind)
         hit = cls._registry.get(key)
         # the stored loop is compared by identity and liveness: an id()

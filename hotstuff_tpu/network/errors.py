@@ -58,6 +58,14 @@ class AckError(NetworkError):
     op = "receive an ack"
 
 
+class UnexpectedAckError(NetworkError):
+    """An ACK arrived with no sent frame awaiting one (error.rs
+    UnexpectedAck) — a protocol desync the reliable sender surfaces as
+    a diagnostic rather than silently consuming."""
+
+    op = "pair an unexpected ack"
+
+
 def classify(
     exc: BaseException, op: str, address: Address | None = None
 ) -> NetworkError:
@@ -81,6 +89,7 @@ __all__ = [
     "SendError",
     "ReceiveError",
     "AckError",
+    "UnexpectedAckError",
     "FramingError",
     "classify",
 ]

@@ -25,7 +25,7 @@ import logging
 import random
 from collections import deque
 
-from .errors import classify
+from .errors import UnexpectedAckError, classify
 from .framing import FramingError, read_frame, send_frame, set_nodelay
 from .wan import LinkScheduler
 
@@ -134,6 +134,13 @@ class _Connection:
                         )
                     elif not fut.cancelled():
                         fut.set_result(ack)
+                else:
+                    # protocol desync the reference surfaces as
+                    # UnexpectedAck (error.rs): keep the connection (the
+                    # peer may just have double-ACKed) but say so
+                    log.warning(
+                        "%s", UnexpectedAckError(self.address, "no frame in flight")
+                    )
 
         wtask = asyncio.ensure_future(writer_loop())
         rtask = asyncio.ensure_future(reader_loop())

@@ -380,6 +380,12 @@ def main(argv=None) -> int:
             f"--size must be in [0, {MAX_PAYLOAD_BODY}] "
             "(the wire decoder's payload-body cap)"
         )
+    if 0 < args.size < 8:
+        # the body always carries the 8-byte uniqueness counter, so a
+        # 1..7-byte request would silently send 8-byte bodies while the
+        # harness reports BPS from the requested size — refuse the
+        # misreporting configuration instead
+        parser.error("--size must be 0 (digest-only) or >= 8 (counter width)")
     committee = read_committee(args.committee)
     addresses = [a.address for a in committee.authorities.values()]
     sent = asyncio.run(

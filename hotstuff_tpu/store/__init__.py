@@ -72,6 +72,13 @@ class Store:
         self._check_open()
         return self.engine.get(key)
 
+    async def delete(self, key: bytes) -> None:
+        """Remove a key (no obligation wake-up — deletes never resolve a
+        parked notify_read).  Used by the payload-body budget's eviction
+        of uncommitted producer bodies."""
+        self._check_open()
+        self.engine.delete(key)
+
     async def notify_read(self, key: bytes) -> bytes:
         """Read that resolves when the key exists (possibly immediately)."""
         self._check_open()

@@ -396,3 +396,37 @@ async def test_preverified_proposal_skips_sync_crypto(tmp_path):
         assert blocks[1].qc._cache_key() in h.core._verified_qcs
     finally:
         teardown(h)
+
+
+def test_registry_prunes_closed_loops():
+    """Advisor r4: the per-(loop, kind) registry must not pin closed
+    loops (and their idle executors) forever — stale entries are pruned
+    on the next for_backend lookup."""
+
+    class DeviceBackend(CpuVerifier):
+        async_kind = "test-kind"
+        device_ready = False
+
+    backend = DeviceBackend()
+
+    async def acquire():
+        return AsyncVerifyService.for_backend(backend)
+
+    loop1 = asyncio.new_event_loop()
+    svc1 = loop1.run_until_complete(acquire())
+    loop1.close()
+    assert any(s is svc1 for _, s in AsyncVerifyService._registry.values())
+
+    loop2 = asyncio.new_event_loop()
+    svc2 = loop2.run_until_complete(acquire())
+    try:
+        # the closed loop's entry is gone; only the live one remains
+        assert not any(
+            s is svc1 for _, s in AsyncVerifyService._registry.values()
+        )
+        assert any(
+            s is svc2 for _, s in AsyncVerifyService._registry.values()
+        )
+    finally:
+        svc2.close()
+        loop2.close()

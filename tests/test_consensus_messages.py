@@ -107,6 +107,7 @@ async def test_receiver_handler_stores_body_and_rejects_mismatch(tmp_path):
 
     from hotstuff_tpu.consensus.consensus import (
         ConsensusReceiverHandler,
+        PayloadBodies,
         payload_key,
     )
     from hotstuff_tpu.store import Store
@@ -121,7 +122,10 @@ async def test_receiver_handler_stores_body_and_rejects_mismatch(tmp_path):
     store = Store(str(tmp_path / "db"))
     tx_producer: asyncio.Queue = asyncio.Queue()
     handler = ConsensusReceiverHandler(
-        asyncio.Queue(), asyncio.Queue(), tx_producer, store=store
+        asyncio.Queue(),
+        asyncio.Queue(),
+        tx_producer,
+        bodies=PayloadBodies(store, 1 << 20),
     )
     body = b"\xcd" * 512
     digest = Digest.of(body)
@@ -136,6 +140,45 @@ async def test_receiver_handler_stores_body_and_rejects_mismatch(tmp_path):
     await handler.dispatch(w2, encode_producer(Digest.random(), body))
     assert not w2.sent  # no ACK
     assert tx_producer.empty()
+    store.close()
+
+
+@async_test
+async def test_payload_body_budget_evicts_uncommitted(tmp_path):
+    """Advisor r4 (medium): unauthenticated producer bodies are admitted
+    against a byte budget — overflow evicts the OLDEST uncommitted body
+    from the store; committed bodies become history and are never
+    evicted."""
+    from hotstuff_tpu.consensus.consensus import PayloadBodies, payload_key
+    from hotstuff_tpu.store import Store
+
+    store = Store(str(tmp_path / "db"))
+    bodies = PayloadBodies(store, budget=1024)
+
+    def make(i):
+        body = bytes([i]) * 400
+        return Digest.of(body), body
+
+    d0, b0 = make(0)
+    d1, b1 = make(1)
+    d2, b2 = make(2)
+    await bodies.admit(d0, b0)
+    # committed bodies leave the budget: d0 no longer counts or evicts
+    bodies.mark_committed([d0])
+    await bodies.admit(d1, b1)
+    await bodies.admit(d2, b2)  # 800 uncommitted bytes — fits
+    assert bodies.evicted == 0
+    d3, b3 = make(3)
+    await bodies.admit(d3, b3)  # would be 1200 > 1024: evicts d1 (oldest)
+    assert bodies.evicted == 1
+    assert await store.read(payload_key(d1)) is None
+    # committed d0 and newer uncommitted bodies survive
+    assert await store.read(payload_key(d0)) == b0
+    assert await store.read(payload_key(d2)) == b2
+    assert await store.read(payload_key(d3)) == b3
+    # duplicate admit of an already-pending digest is a no-op
+    await bodies.admit(d3, b3)
+    assert bodies.evicted == 1
     store.close()
 
 
@@ -331,3 +374,27 @@ def test_decode_narrows_keysig_sizes_to_committee_scheme():
     decode_message(data_bls, scheme="bls")
     with pytest.raises(SerializationError):
         decode_message(data_bls, scheme="ed25519")
+
+
+@async_test
+async def test_payload_body_replay_after_commit_not_evictable(tmp_path):
+    """A replayed producer frame for an already-committed (stored) body
+    must not re-enter it into the evictable set — flooding the budget
+    after the replay may never delete committed history."""
+    from hotstuff_tpu.consensus.consensus import PayloadBodies, payload_key
+    from hotstuff_tpu.store import Store
+
+    store = Store(str(tmp_path / "db"))
+    bodies = PayloadBodies(store, budget=1024)
+    body0 = b"\x01" * 400
+    d0 = Digest.of(body0)
+    await bodies.admit(d0, body0)
+    bodies.mark_committed([d0])
+    # replay: must be a no-op (history is not evictable)
+    await bodies.admit(d0, body0)
+    # flood with unique bodies well past the budget
+    for i in range(2, 8):
+        b = bytes([i]) * 400
+        await bodies.admit(Digest.of(b), b)
+    assert await store.read(payload_key(d0)) == body0
+    store.close()

@@ -493,3 +493,57 @@ async def test_timeout_burst_mixed_rounds_group_separately(tmp_path):
         assert AggregateCountingVerifier.shared == 2  # one aggregate per round
     finally:
         teardown(h)
+
+
+@async_test
+async def test_preverify_skips_far_future_votes(tmp_path):
+    """Advisor r4: votes beyond the aggregator's ROUND_LOOKAHEAD bound
+    are rejected by add_vote with ZERO crypto — the preverify batch must
+    not convert that free rejection into signature work."""
+    from hotstuff_tpu.consensus.aggregator import ROUND_LOOKAHEAD
+    from hotstuff_tpu.consensus.messages import Vote
+    from hotstuff_tpu.crypto import Signature
+
+    class Counting(CpuVerifier):
+        calls = 0
+
+        def verify_many(self, d, p, s, aggregate_ok=False):
+            Counting.calls += len(d)
+            return super().verify_many(d, p, s)
+
+        def verify_one(self, d, pk, sig):
+            Counting.calls += 1
+            return super().verify_one(d, pk, sig)
+
+        def verify_shared_msg(self, d, votes):
+            Counting.calls += len(votes)
+            return super().verify_shared_msg(d, votes)
+
+    h = make_core(tmp_path, fresh_base_port(), 0, timeout_ms=60_000)
+    try:
+        h.core.verifier = Counting()
+        pk, sk = keys()[1]
+        far = Vote(
+            hash=__import__("hotstuff_tpu.crypto", fromlist=["Digest"])
+            .Digest.random(),
+            round=h.core.round + ROUND_LOOKAHEAD + 1,
+            author=pk,
+        )
+        far.signature = Signature.new(far.digest(), sk)
+        pre = await h.core._preverify_burst([(TAG_VOTE, far)])
+        assert pre == set()
+        assert Counting.calls == 0
+
+        # same bound for timeouts
+        from .common import qc_for_block, signed_timeout
+
+        t = signed_timeout(
+            h.core.high_qc, h.core.round + ROUND_LOOKAHEAD + 1, pk, sk
+        )
+        from hotstuff_tpu.consensus.wire import TAG_TIMEOUT
+
+        pre = await h.core._preverify_burst([(TAG_TIMEOUT, t)])
+        assert pre == set()
+        assert Counting.calls == 0
+    finally:
+        teardown(h)

@@ -152,3 +152,23 @@ async def test_client_conn_connect_is_cancellation_safe(monkeypatch):
     await asyncio.sleep(0)  # let the reaper done-callback run
     assert closed == [True]
     assert conn.writer is None and not conn.alive
+
+
+def test_client_rejects_sub_counter_size(tmp_path):
+    """Advisor r4: 0 < --size < 8 would silently send 8-byte bodies (the
+    uniqueness counter) while the harness reports BPS from the requested
+    size — the client refuses the misreporting configuration."""
+    import pytest
+
+    from hotstuff_tpu.node.client import main as client_main
+
+    com_path = str(tmp_path / "committee.json")
+    committee = Committee.new(
+        [(pk, 1, ("127.0.0.1", 9900 + i)) for i, (pk, _) in enumerate(keys())]
+    )
+    write_committee(committee, com_path)
+    for bad in (1, 7):
+        with pytest.raises(SystemExit):
+            client_main(
+                ["--committee", com_path, "--size", str(bad), "--duration", "0"]
+            )
