@@ -19,14 +19,12 @@ Prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", "qc_verify_ms": {...}}
 vs_baseline > 1 means the TPU path beats the CPU baseline.
 
-Baseline honesty note (VERDICT r1): the CPU number is this framework's
-own production CPU path (an OpenSSL per-signature loop).  The
-reference's dalek ``verify_batch`` is ~2x faster than a per-signature
-loop on comparable hardware (SURVEY §2.7), so to compare against a
-dalek-parity CPU batch, read vs_baseline as roughly HALF the printed
-value.  No such batch implementation exists in this image to measure
-directly; the factor-of-two derating is stated here rather than
-silently flattering the ratio.
+Baseline (r5, replacing r1-r4's derating footnote): the CPU number is
+a TRUE dalek-parity batch verification — the random-linear-combination
+equation over a Pippenger multiscalar, implemented in C++
+(native/ed25519_batch.cpp) and measured directly on the same batches.
+Provenance (backend + per-signature-loop rate for drift tracking) is
+pinned in the "baseline" field of the output each run.
 """
 
 from __future__ import annotations
@@ -234,19 +232,52 @@ def bench_tc(verifier) -> dict:
     }
 
 
-def bench_cpu(msgs, pks, sigs) -> float:
-    """CPU baseline throughput (sigs/s) over the same batches — the
-    framework's own cpu backend (OpenSSL per-signature verify)."""
+def bench_cpu(msgs, pks, sigs) -> tuple[float, dict]:
+    """True batched CPU baseline (VERDICT r4 item 5).
+
+    The reference's ``Signature::verify_batch`` is dalek batch
+    verification (crypto/src/lib.rs:213-226); the parity implementation
+    is native/ed25519_batch.cpp (random-linear-combination equation,
+    Pippenger multiscalar).  vs_baseline is computed against it
+    directly — no estimated derating.  Provenance is pinned in the
+    output: which backend was measured, plus the per-signature-loop
+    rate for drift tracking across rounds (the r3→r4 ratio drift came
+    from an unpinned baseline)."""
+    from hotstuff_tpu.crypto import native_ed25519
     from hotstuff_tpu.crypto.signature import batch_verify_arrays
 
-    assert all(batch_verify_arrays(msgs, pks, sigs))
-    t0 = time.perf_counter()
+    n = len(msgs)
     rounds = 3
-    for _ in range(rounds):
-        ok = batch_verify_arrays(msgs, pks, sigs)
-    dt = time.perf_counter() - t0
-    assert all(ok)
-    return rounds * len(msgs) / dt
+
+    def timed(fn) -> float:
+        assert fn()
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            ok = fn()
+        dt = time.perf_counter() - t0
+        assert ok
+        return rounds * n / dt
+
+    loop_rate = timed(lambda: all(batch_verify_arrays(msgs, pks, sigs)))
+    provenance = {
+        "batch": n,
+        "loop_sigs_per_s": round(loop_rate),
+        "loop_backend": "openssl-per-signature",
+    }
+    if native_ed25519.available():
+        shared, pkb, sgb = msgs[0], b"".join(pks), b"".join(sigs)
+        batch_rate = timed(
+            lambda: native_ed25519.batch_verify(
+                shared, 32, pkb, sgb, n, shared=True
+            )
+        )
+        provenance["backend"] = "native-batch-pippenger (dalek parity)"
+        provenance["batch_sigs_per_s"] = round(batch_rate)
+        baseline = max(batch_rate, loop_rate)
+    else:
+        provenance["backend"] = "openssl-per-signature (native batch unavailable)"
+        baseline = loop_rate
+    return baseline, provenance
 
 
 def bench_sharded(msgs, pks, sigs) -> dict:
@@ -281,7 +312,7 @@ def main() -> int:
     platform = jax.devices()[0].platform
 
     tpu_tput, qc_latency, device_tput = bench_tpu(msgs, pks, sigs)
-    cpu_tput = bench_cpu(msgs, pks, sigs)
+    cpu_tput, cpu_provenance = bench_cpu(msgs, pks, sigs)
 
     from hotstuff_tpu.tpu.ed25519 import BatchVerifier
 
@@ -295,6 +326,7 @@ def main() -> int:
                 "value": round(tpu_tput),
                 "unit": "sigs/s",
                 "vs_baseline": round(tpu_tput / cpu_tput, 3),
+                "baseline": cpu_provenance,
                 "device_throughput": device_tput,
                 "qc_verify_ms": qc_latency,
                 "tc_verify_ms": tc_latency,

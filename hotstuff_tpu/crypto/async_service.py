@@ -48,6 +48,8 @@ import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from .native_ed25519 import NATIVE_BATCH_MIN
+
 log = logging.getLogger(__name__)
 
 # Measured single-signature CPU verify cost on this class of host
@@ -55,6 +57,11 @@ log = logging.getLogger(__name__)
 # Only used as the device-vs-CPU routing threshold — an order-of-
 # magnitude estimate is enough.
 CPU_US_PER_SIG = 130.0
+
+# Amortized native-batch cost at committee-scale waves (measured r5:
+# ~46 us/sig at 128, ~36 at 256).  Used by the routing cost model when
+# the wave is big enough for the batched CPU path.
+CPU_BATCH_US_PER_SIG = 45.0
 
 # EWMA smoothing for device dispatch wall time.
 _EWMA_ALPHA = 0.3
@@ -127,6 +134,29 @@ def eval_claims_sync(backend, claims: list) -> list[bool]:
         # certificate with no signatures proves nothing — vacuous truth
         # (all() over an empty span) would verify a votes=[] forgery
         return [False] * len(claims)
+    # Wave-level fast path (CPU backend): ONE dalek-parity batch
+    # equation over the whole flattened wave — in the common all-valid
+    # case this replaces len(digests) OpenSSL verifies with a single
+    # Pippenger multiscalar (measured 2-3.5x).  Sound because every
+    # claim's verdict here is all(span): a passing batch implies every
+    # span passes.  On a failing batch fall through to per-item
+    # attribution (the adversary pays for that path, not us).
+    if (
+        len(digests) >= NATIVE_BATCH_MIN
+        and getattr(backend, "supports_flat_batch", False)
+        and all(len(d) == 32 for d in digests)
+    ):
+        from . import native_ed25519
+
+        if native_ed25519.available() and native_ed25519.batch_verify(
+            b"".join(digests),
+            32,
+            b"".join(pks),
+            b"".join(sigs),
+            len(digests),
+            shared=False,
+        ):
+            return [e > s for s, e in spans]
     ok = backend.verify_many(digests, pks, sigs)
     return [all(ok[s:e]) if e > s else False for s, e in spans]
 
@@ -273,7 +303,17 @@ class AsyncVerifyService:
             return "device"
         if self._device_ewma_s is None:
             return "device"  # optimistic first dispatch
-        cpu_est = n_sigs * CPU_US_PER_SIG * 1e-6
+        # the CPU alternative is the batched equation for large waves
+        # (eval_claims_sync flat fast path) — but only when that path
+        # actually exists on this host; else the per-sig loop
+        from .native_ed25519 import available as _native_available
+
+        per_sig = (
+            CPU_BATCH_US_PER_SIG
+            if n_sigs >= NATIVE_BATCH_MIN and _native_available()
+            else CPU_US_PER_SIG
+        )
+        cpu_est = n_sigs * per_sig * 1e-6
         if self._device_ewma_s <= cpu_est:
             return "device"
         now = time.monotonic()

@@ -50,10 +50,19 @@ class VerifierBackend(Protocol):
         ...
 
 
+from .native_ed25519 import NATIVE_BATCH_MIN
+
+
 class CpuVerifier:
-    """Default backend: per-signature OpenSSL verification."""
+    """Default backend: OpenSSL per-signature verification, with the
+    native dalek-parity batch equation (crypto/native_ed25519.py) as
+    the fast path for large same-digest batches — the QC-verify shape,
+    reference crypto/src/lib.rs:213-226."""
 
     name = "cpu"
+    # eval_claims_sync may collapse a whole claim wave into one native
+    # batch equation (all-or-nothing, per-item attribution on failure)
+    supports_flat_batch = True
 
     def verify_one(self, digest: Digest, pk: PublicKey, sig: Signature) -> bool:
         try:
@@ -65,6 +74,17 @@ class CpuVerifier:
     def verify_shared_msg(
         self, digest: Digest, votes: list[tuple[PublicKey, Signature]]
     ) -> bool:
+        if len(votes) >= NATIVE_BATCH_MIN:
+            from . import native_ed25519
+
+            if native_ed25519.available():
+                # cofactored batch acceptance — dalek-batch parity; the
+                # certificate verdict is all-or-nothing, same as the
+                # reference's QC::verify
+                return native_ed25519.batch_verify_shared(
+                    digest.to_bytes(),
+                    [(pk.to_bytes(), sig.to_bytes()) for pk, sig in votes],
+                )
         try:
             Signature.verify_batch(digest, votes)
             return True
@@ -80,6 +100,27 @@ class CpuVerifier:
     ) -> list[bool]:
         from .signature import batch_verify_arrays
 
+        n = len(digests)
+        if aggregate_ok and n >= NATIVE_BATCH_MIN:
+            # Certificate-shaped call (TC verify): the all-pass verdict
+            # may be established collectively.  One batch equation
+            # replaces n verifies; on a failure fall through to the
+            # loop for per-item attribution.
+            from . import native_ed25519
+
+            if (
+                native_ed25519.available()
+                and all(len(d) == Digest.SIZE for d in digests)
+                and native_ed25519.batch_verify(
+                    b"".join(digests),
+                    Digest.SIZE,
+                    b"".join(pks),
+                    b"".join(sigs),
+                    n,
+                    shared=False,
+                )
+            ):
+                return [True] * n
         return batch_verify_arrays(digests, pks, sigs)
 
 

@@ -196,15 +196,16 @@ async def test_device_service_adapts_to_slow_device():
     await service.verify_claims([claim])
     assert host.dispatched_batches == [1]
     # a huge batch's CPU estimate exceeds the EWMA -> device again
-    # (distinct claims — identical ones would dedup to a single check)
+    # (distinct claims — identical ones would dedup to a single check;
+    # 1500 sigs x CPU_BATCH_US_PER_SIG 45 us = 67.5 ms > the 50 ms EWMA)
     big = [
         ("one", bytes([i % 256, i // 256]) + b"\x00" * 30,
          pk.to_bytes(), sig.to_bytes())
-        for i in range(600)
+        for i in range(1500)
     ]
     out = await service.verify_claims(big)
-    assert len(out) == 600
-    assert host.dispatched_batches == [1, 600]
+    assert len(out) == 1500
+    assert host.dispatched_batches == [1, 1500]
     service.close()
 
 
