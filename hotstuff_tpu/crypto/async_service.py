@@ -48,6 +48,7 @@ import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from .digest import DIGEST_SIZE
 from .native_ed25519 import NATIVE_BATCH_MIN
 
 log = logging.getLogger(__name__)
@@ -58,10 +59,16 @@ log = logging.getLogger(__name__)
 # magnitude estimate is enough.
 CPU_US_PER_SIG = 130.0
 
-# Amortized native-batch cost at committee-scale waves (measured r5:
-# ~46 us/sig at 128, ~36 at 256).  Used by the routing cost model when
-# the wave is big enough for the batched CPU path.
+# Native-batch cost model: per-sig cost ~ asymptote + fixed/n (the
+# Pippenger bucket cost amortizes with n).  Fit to the r5 measurements
+# (~108 us/sig at 11, ~54 at 32, ~46 at 128, ~36 at 256).
 CPU_BATCH_US_PER_SIG = 45.0
+CPU_BATCH_FIXED_US = 700.0
+
+
+def cpu_batch_estimate_s(n_sigs: int) -> float:
+    """Estimated batched-CPU wall seconds for an n_sigs wave."""
+    return n_sigs * (CPU_BATCH_US_PER_SIG + CPU_BATCH_FIXED_US / n_sigs) * 1e-6
 
 # EWMA smoothing for device dispatch wall time.
 _EWMA_ALPHA = 0.3
@@ -144,13 +151,13 @@ def eval_claims_sync(backend, claims: list) -> list[bool]:
     if (
         len(digests) >= NATIVE_BATCH_MIN
         and getattr(backend, "supports_flat_batch", False)
-        and all(len(d) == 32 for d in digests)
+        and all(len(d) == DIGEST_SIZE for d in digests)
     ):
         from . import native_ed25519
 
         if native_ed25519.available() and native_ed25519.batch_verify(
             b"".join(digests),
-            32,
+            DIGEST_SIZE,
             b"".join(pks),
             b"".join(sigs),
             len(digests),
@@ -204,10 +211,21 @@ class AsyncVerifyService:
         """The service for ``backend`` on the running loop.  Device-host
         backends (``async_kind`` set) share one service per (loop, kind)
         pair — in-process committees all submit into the same dispatch
-        stream; everything else gets a private inline service."""
+        stream; everything else gets a private inline service.
+
+        ``HOTSTUFF_NO_CLAIM_DEDUP=1`` gives every core a PRIVATE device
+        service instead: no cross-core claim coalescing or dedup.  This
+        is the honesty knob for in-process scale results (VERDICT r4
+        weak #2) — a real one-node-per-host deployment gets zero dedup,
+        and the per-node capability must be measurable without the
+        co-location artifact."""
+        import os
+
         kind = getattr(backend, "async_kind", None)
         if kind is None:
             return cls(backend, device=False)
+        if os.environ.get("HOTSTUFF_NO_CLAIM_DEDUP"):
+            return cls(backend, device=True)
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
@@ -308,12 +326,10 @@ class AsyncVerifyService:
         # actually exists on this host; else the per-sig loop
         from .native_ed25519 import available as _native_available
 
-        per_sig = (
-            CPU_BATCH_US_PER_SIG
-            if n_sigs >= NATIVE_BATCH_MIN and _native_available()
-            else CPU_US_PER_SIG
-        )
-        cpu_est = n_sigs * per_sig * 1e-6
+        if n_sigs >= NATIVE_BATCH_MIN and _native_available():
+            cpu_est = cpu_batch_estimate_s(n_sigs)
+        else:
+            cpu_est = n_sigs * CPU_US_PER_SIG * 1e-6
         if self._device_ewma_s <= cpu_est:
             return "device"
         now = time.monotonic()

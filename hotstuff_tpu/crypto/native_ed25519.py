@@ -50,15 +50,24 @@ def _load_lib() -> ctypes.CDLL:
     path = os.path.join(_native_dir(), "build", _LIB_NAME)
     if not os.path.exists(path):
         try:
+            # build the SPECIFIC target: a compile failure in an
+            # unrelated native TU must not disable this fast path (the
+            # Makefile's mktemp+rename keeps concurrent builders from
+            # exposing a partially-written .so)
             subprocess.run(
-                ["make", "-C", _native_dir()],
+                ["make", "-C", _native_dir(), f"build/{_LIB_NAME}"],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
         except (OSError, subprocess.SubprocessError) as e:
             raise ImportError(f"cannot build {_LIB_NAME}: {e}") from e
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        # corrupt/truncated/ABI-mismatched .so: degrade to the OpenSSL
+        # path instead of letting the load error escape into QC verify
+        raise ImportError(f"cannot load {_LIB_NAME}: {e}") from e
     lib.hs_ed25519_batch_verify.restype = ctypes.c_int
     lib.hs_ed25519_batch_verify.argtypes = [
         ctypes.c_char_p,
@@ -104,7 +113,17 @@ def batch_verify(
     """
     if n == 0:
         return True
-    assert _lib is not None, "call available() first"
+    assert _lib is not None and _lib is not False, "call available() first"
+    # Buffer-length validation BEFORE crossing into C: a short component
+    # (e.g. a 48-byte BLS-sized signature smuggled into an ed25519
+    # batch) must be an invalid-signature verdict, not an out-of-bounds
+    # read.
+    if (
+        len(msgs) != (msg_len if shared else n * msg_len)
+        or len(pks) != n * 32
+        or len(sigs) != n * 64
+    ):
+        return False
     return (
         _lib.hs_ed25519_batch_verify(
             msgs, msg_len, pks, sigs, n, 1 if shared else 0

@@ -295,3 +295,24 @@ def test_cpu_verifier_uses_native_batch_for_large_qcs():
     sigs[4] = bytes(64)
     out = v.verify_many(msgs, pks, sigs, aggregate_ok=True)
     assert out == [True] * 4 + [False] + [True] * (n - 5)
+
+
+@nativebatch
+def test_native_batch_rejects_short_buffers():
+    """Length mismatches (e.g. a 48-byte BLS-sized signature smuggled
+    into an ed25519 batch) must verdict False, never reach C with an
+    out-of-bounds read."""
+    from hotstuff_tpu.crypto import native_ed25519
+
+    d = Digest.of(b"short")
+    pk, sk = generate_keypair(b"\x16" * 32, 0)
+    good = Signature.new(d, sk).to_bytes()
+    assert not native_ed25519.batch_verify(
+        d.to_bytes(), 32, pk.to_bytes(), good[:48], 1, shared=True
+    )
+    assert not native_ed25519.batch_verify(
+        d.to_bytes(), 32, pk.to_bytes()[:16], good, 1, shared=True
+    )
+    assert not native_ed25519.batch_verify(
+        d.to_bytes()[:8], 32, pk.to_bytes(), good, 1, shared=True
+    )
