@@ -37,6 +37,8 @@ def task_local(args) -> int:
         payload_homes=args.payload_homes,
         no_claim_dedup=args.no_claim_dedup,
     )
+    if args.wait_weather is not None:
+        bench.wait_weather(threshold_ms=args.wait_weather)
     parser = bench.run()
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
@@ -249,6 +251,15 @@ def main(argv=None) -> int:
         action="store_true",
         help="co-locate the whole committee in one node process "
         "(run-many; removes OS scheduling noise on few-core hosts)",
+    )
+    p.add_argument(
+        "--wait-weather",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="block until the tunnel dispatch p50 drops below MS "
+        "milliseconds before running (a good-weather window lets the "
+        "adaptive router actually choose the device)",
     )
     p.add_argument(
         "--no-claim-dedup",

@@ -12,6 +12,7 @@ step — the C++ store engine, when built, is picked up automatically).
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import signal
 import subprocess
@@ -176,6 +177,53 @@ class LocalBench:
         return proc
 
     # ---- the run -----------------------------------------------------------
+
+    def wait_weather(
+        self, threshold_ms: float = 5.0, max_wait_s: float = 1_800.0
+    ) -> bool:
+        """Block until the tunnel dispatch p50 drops below
+        ``threshold_ms`` (VERDICT r5 item 1: capture the device-routed
+        live win in a good-weather window).  Probes in a subprocess
+        (the harness itself must not import jax); returns False when
+        the window never arrived (caller proceeds and the run records
+        whatever routing the weather allowed)."""
+        import hotstuff_tpu
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(hotstuff_tpu.__file__))
+        )
+        deadline = time.time() + max_wait_s
+        while True:
+            try:
+                proc = subprocess.run(
+                    [
+                        sys.executable,
+                        os.path.join(root, "scripts/probe_weather.py"),
+                    ],
+                    capture_output=True,
+                    text=True,
+                    cwd=root,
+                    timeout=300,
+                )
+                line = (proc.stdout or "").strip()
+            except subprocess.TimeoutExpired:
+                # a probe that cannot even finish IS degraded weather —
+                # treat as a failed reading, never abort the bench
+                line = ""
+            Print.info(f"weather gate: {line or 'probe failed'}")
+            ms = None
+            m = re.search(r"p50 ([\d.]+) ms", line)
+            if m:
+                ms = float(m.group(1))
+            if ms is not None and ms < threshold_ms:
+                return True
+            if time.time() >= deadline:
+                Print.warn(
+                    f"weather gate timed out after {max_wait_s:.0f}s "
+                    f"(last p50 {ms} ms >= {threshold_ms} ms); running anyway"
+                )
+                return False
+            time.sleep(60)
 
     def run(self) -> LogParser:
         Print.heading(

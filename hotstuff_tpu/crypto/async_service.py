@@ -181,8 +181,22 @@ class AsyncVerifyService:
     """
 
     _registry: dict[tuple, tuple] = {}  # (loop id, kind) -> (loop, service)
+    _serial = 0  # distinguishes private services' cumulative stat lines
 
     def __init__(self, backend, device: bool = False):
+        AsyncVerifyService._serial += 1
+        # stable tag for the scraped stats line: kind#pid.serial —
+        # cumulative counters from different service instances must be
+        # separable in MERGED logs: the serial separates private
+        # per-core services (--no-claim-dedup) within one process, the
+        # pid separates processes (every node process restarts the
+        # class counter at 1, and the parser sums the last line per tag)
+        import os
+
+        self._stats_tag = (
+            f"{getattr(backend, 'async_kind', None) or getattr(backend, 'name', 'cpu')}"
+            f"#{os.getpid()}.{AsyncVerifyService._serial}"
+        )
         # For inline services ``backend`` is the VerifierBackend itself.
         # For device services it is the HOST (node.LazyDeviceVerifier):
         # ``host.device_ready`` gates routing (never materialize jax or
@@ -498,9 +512,10 @@ class AsyncVerifyService:
             # split and the measured dispatch EWMA.
             self._next_stats_log = now + 5.0
             log.info(
-                "Verify service stats: dispatches=%d device=%d "
+                "Verify service stats [%s]: dispatches=%d device=%d "
                 "device_sigs=%d cpu_sigs=%d deadline_misses=%d "
                 "ewma_ms=%.1f",
+                self._stats_tag,
                 self.dispatches,
                 self.device_dispatches,
                 self.device_sigs,

@@ -94,6 +94,18 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_char_p,
             ctypes.c_int,
         ]
+        for name in ("hs_bls_g1_decompress_many", "hs_bls_hash_base_many"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        lib.hs_bls_verify_batch_points.restype = ctypes.c_int
+        lib.hs_bls_verify_batch_points.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
         if lib.hs_bls_selftest() != 1:
             raise ImportError(f"{_LIB_NAME} failed its bilinearity selftest")
         return lib
@@ -170,5 +182,52 @@ def verify_batch(
             n,
             weights,
             1 if check_pk_subgroup else 0,
+        )
+    )
+
+
+# ---- TPU-offload split (VERDICT r5 item 8) ---------------------------------
+# The per-entry G1 ladders of the distinct-digest batch run on device
+# (tpu/bls.py); these are the host ends.
+
+
+def g1_decompress_many(sigs48: list[bytes]) -> bytes | None:
+    """Compressed signatures -> uncompressed affine (96 B each,
+    on-curve checked; subgroup membership is the device ladder's job).
+    None on malformed input."""
+    n = len(sigs48)
+    if n == 0 or any(len(s) != 48 for s in sigs48):
+        return None
+    out = ctypes.create_string_buffer(96 * n)
+    if not _lib.hs_bls_g1_decompress_many(b"".join(sigs48), n, out):
+        return None
+    return out.raw
+
+
+def hash_base_many(digests32: list[bytes]) -> bytes | None:
+    """Digests -> PRE-cofactor hash base points, uncompressed affine."""
+    n = len(digests32)
+    if n == 0 or any(len(d) != 32 for d in digests32):
+        return None
+    out = ctypes.create_string_buffer(96 * n)
+    if not _lib.hs_bls_hash_base_many(b"".join(digests32), n, out):
+        return None
+    return out.raw
+
+
+def verify_batch_points(
+    whm96: bytes, pks96: list[bytes], agg96: bytes,
+    check_pk_subgroup: bool = True,
+) -> bool:
+    """Pairing product over device-computed points: whm96 = n contiguous
+    uncompressed (r_i * h_eff) * H_base(m_i); agg96 = sum r_i * sig_i."""
+    n = len(pks96)
+    if n == 0 or len(whm96) != 96 * n or len(agg96) != 96:
+        return False
+    if any(len(p) != 96 for p in pks96):
+        return False
+    return bool(
+        _lib.hs_bls_verify_batch_points(
+            whm96, b"".join(pks96), n, agg96, 1 if check_pk_subgroup else 0
         )
     )

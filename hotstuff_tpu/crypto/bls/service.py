@@ -62,6 +62,7 @@ class BlsVerifier:
         except ImportError:
             self._native = None
             self._native_verify = None
+        self._storm = None  # TpuStormOffload (device ladders), warmed on demand
         if aggregator == "tpu":
             from ...tpu.bls import TpuG1Aggregator
 
@@ -91,6 +92,65 @@ class BlsVerifier:
             # ~0.4 s of (off-loop) pairing work — never deadline it back
             # onto the loop
             self.dispatch_deadline_s = 30.0
+
+    def warmup_storm_offload(self, n: int = 171) -> None:
+        """Compile the device ladder/aggregation shapes for an n-entry
+        distinct-digest storm (VERDICT r5 item 8).  Only meaningful on
+        the device-aggregation variants; call at node boot, never
+        mid-consensus."""
+        if self._tpu_agg is None or self._native is None:
+            return
+        from ...tpu.bls import TpuStormOffload
+
+        if self._storm is None:
+            self._storm = TpuStormOffload()
+        self._storm.warmup(n)
+
+    def _storm_verify(self, db, pb, sb) -> bool:
+        """Device-offloaded all-distinct batch: host hashes/decompresses
+        (native), device runs all 3n G1 ladders + the wsig aggregation,
+        host runs the pairing product over the returned points.  False
+        verdicts (or any malformed input) fall back to the caller's
+        per-item attribution path."""
+        import secrets
+
+        from ...tpu.bls import from_mont_int  # noqa: F401 — doc pointer
+        from .curve import G1Point
+        from .fields import P as FIELD_P
+
+        n = len(db)
+        bases_raw = self._native.hash_base_many(db)
+        sigs_raw = self._native.g1_decompress_many(sb)
+        if bases_raw is None or sigs_raw is None:
+            return False
+
+        def parse(points_raw, count):
+            out = []
+            for i in range(count):
+                x = int.from_bytes(points_raw[96 * i : 96 * i + 48], "big")
+                y = int.from_bytes(points_raw[96 * i + 48 : 96 * i + 96], "big")
+                if x >= FIELD_P or y >= FIELD_P:
+                    return None
+                out.append(G1Point(x, y))
+            return out
+
+        bases = parse(bases_raw, n)
+        sigs = parse(sigs_raw, n)
+        if bases is None or sigs is None:
+            return False
+        weights = [secrets.randbits(128) | 1 for _ in range(n)]
+        whm, agg, subgroup_ok = self._storm.batch_points(weights, bases, sigs)
+        if not subgroup_ok:
+            return False
+
+        def ser(pt) -> bytes:
+            if pt.inf:
+                return bytes(96)
+            return pt.x.to_bytes(48, "big") + pt.y.to_bytes(48, "big")
+
+        return self._native.verify_batch_points(
+            b"".join(ser(p) for p in whm), pb, ser(agg)
+        )
 
     def _pk(self, pk_bytes: bytes) -> BlsPublicKey | None:
         if pk_bytes not in self._pk_cache:
@@ -273,6 +333,17 @@ class BlsVerifier:
                     )
                     if ok:
                         return [True] * n
+                elif (
+                    aggregate_ok
+                    and self._storm is not None
+                    and self._storm.ready
+                    and n >= 16
+                    and self._storm_verify(db, pb, sb)
+                ):
+                    # all-distinct worst case with the G1 ladders on
+                    # device (VERDICT r5 item 8); False verdicts fall
+                    # through to per-item attribution below
+                    return [True] * n
                 elif self._native.verify_batch(db, pb, sb):
                     return [True] * n
                 # re-check per item to pinpoint the invalid entries

@@ -141,9 +141,14 @@ class ShardedBatchVerifier(BatchVerifier):
         if self._shard_pallas:
             from ..tpu import pallas_dsm
 
-            # per-shard batches must be lane-tile multiples
+            # Per-shard batches must be lane-tile multiples.  The grid
+            # must include the intermediate multiples: (128, 128, 1024)
+            # made a 256-vote QC pad to 1024 — 4x the work — which was
+            # the whole "sharded route pays ~4x at mesh 1" anomaly
+            # (VERDICT r4 weak #4; BENCH_r04 sharded_route 2.008 ms vs
+            # 0.526 single-device).
             self.pad_sizes = tuple(
-                m * p for p in (pallas_dsm.LANE_TILE, pallas_dsm.BT, 1024)
+                m * k * pallas_dsm.LANE_TILE for k in (1, 2, 4, 8)
             )
         else:
             # equal per-device slices: multiples of the mesh size on the

@@ -359,3 +359,203 @@ class TpuG1Aggregator:
         yi = from_mont_int(y)
         z_inv = pow(zi, Q - 2, Q)
         return G1Point(xi * z_inv % Q, yi * z_inv % Q)
+
+
+# ---- batched variable-base scalar multiplication ----------------------------
+# The per-entry G1 work of distinct-digest TC verification (VERDICT r5
+# item 8): r_i·H(m_i) for every entry plus the Σ r_i·sig_i aggregate.
+# One MSB-first double-and-add ladder, BRANCHLESS (the conditional add
+# is a jnp.where select — complete addition makes the "add" leg valid
+# even when it is discarded), vectorized over the batch.  Cost:
+# 2·NBITS point adds of [B]-wide batches; for 128-bit weights that is
+# 256 adds regardless of batch size — the device eats the whole storm's
+# ladder work in one dispatch.
+
+
+SCALAR_BITS = 128  # random small-exponent weights (service.py)
+
+_ONE_MONT = to_mont_limbs(1)
+
+
+def _freshen(a):
+    """Re-normalize a signed-loose value to magnitude < ~1.3q by one
+    Montgomery multiply with the form of 1 (REDC divides by R, and
+    R/q > 500 crushes any accumulated growth).  The SEQUENTIAL ladder
+    needs this every iteration: point_add's per-op outputs can reach
+    ~10q, and feeding them straight back in compounds (~x2.5 per
+    round) until the CIOS columns overflow int32 — measured as wrong
+    results after ~40-50 chained doublings.  The aggregation tree
+    (log-depth, fresh 1.5q leaves) never chains deep enough to need it."""
+    one = jnp.broadcast_to(jnp.asarray(_ONE_MONT), a.shape).astype(jnp.int32)
+    return mont_mul(a, one)
+
+
+@partial(jax.jit, static_argnames=("nbits",))
+def _scalar_mult_kernel(bits, xs, ys, zs, nbits: int = SCALAR_BITS):
+    """bits: [nbits, B] int32 (MSB first); points [B, NLIMBS] loose
+    Montgomery projective.  Returns k_i·P_i, [B, NLIMBS] each."""
+    b = xs.shape[0]
+    acc = (
+        jnp.zeros((b, NLIMBS), jnp.int32),
+        jnp.broadcast_to(jnp.asarray(to_mont_limbs(1)), (b, NLIMBS)).astype(
+            jnp.int32
+        ),
+        jnp.zeros((b, NLIMBS), jnp.int32),
+    )
+
+    def body(i, acc):
+        acc = point_add(acc, acc)
+        added = point_add(acc, (xs, ys, zs))
+        take = bits[i][:, None] != 0
+        acc = tuple(
+            jnp.where(take, ad, ac) for ac, ad in zip(acc, added)
+        )
+        return tuple(_freshen(c) for c in acc)
+
+    return jax.lax.fori_loop(0, nbits, body, acc)
+
+
+class TpuG1ScalarMul:
+    """Batched k_i·P_i on device (the TC-storm per-entry ladders).
+
+    The host packs scalars into MSB-first bit planes and points into
+    Montgomery limbs; the device runs one branchless ladder over the
+    whole batch; affine conversion happens on the host (one modular
+    inversion per point, ~30 us — noise next to the ladder).
+    """
+
+    PAD_SIZES = (8, 32, 128, 512)
+
+    def __init__(self, nbits: int = SCALAR_BITS):
+        self.nbits = nbits
+
+    def _padded(self, n: int) -> int:
+        return next(
+            (s for s in self.PAD_SIZES if s >= n), 1 << (n - 1).bit_length()
+        )
+
+    def mul_arrays(self, scalars: list[int], points: list[G1Point]):
+        """Device ladder; returns the raw projective result as DEVICE
+        arrays (x, y, z of shape [padded, NLIMBS]) — callers chain
+        further device work (the storm offload feeds the wsig segment
+        straight into the aggregation kernel) or convert on host."""
+        n = len(points)
+        assert len(scalars) == n and n > 0
+        padded = self._padded(n)
+        nbytes = (self.nbits + 7) // 8
+        sbytes = np.zeros((padded, nbytes), np.uint8)
+        packed = b"".join(k.to_bytes(nbytes, "little") for k in scalars)
+        sbytes[:n] = np.frombuffer(packed, np.uint8).reshape(n, nbytes)
+        lsb_first = np.unpackbits(sbytes, axis=1, bitorder="little")
+        # MSB-first planes: [nbits, padded]
+        bits = lsb_first[:, : self.nbits][:, ::-1].T.astype(np.int32)
+        xs = np.zeros((padded, NLIMBS), np.int32)
+        ys = np.zeros((padded, NLIMBS), np.int32)
+        zs = np.zeros((padded, NLIMBS), np.int32)
+        one = to_mont_limbs(1)
+        ys[:] = one  # identity rows by default (0 : 1 : 0)
+        for i, pt in enumerate(points):
+            if not pt.inf:
+                xs[i] = to_mont_limbs(pt.x)
+                ys[i] = to_mont_limbs(pt.y)
+                zs[i] = one
+        return _scalar_mult_kernel(
+            jnp.asarray(np.ascontiguousarray(bits)),
+            jnp.asarray(xs),
+            jnp.asarray(ys),
+            jnp.asarray(zs),
+            nbits=self.nbits,
+        )
+
+    def mul(
+        self, scalars: list[int], points: list[G1Point]
+    ) -> list[G1Point]:
+        """[k_i·P_i] — scalars must fit in ``nbits``."""
+        if not points:
+            return []
+        for k in scalars:
+            assert 0 <= k < (1 << self.nbits)
+        x, y, z = (np.asarray(a) for a in self.mul_arrays(scalars, points))
+        return [
+            TpuG1Aggregator._projective_to_affine(x[i], y[i], z[i])
+            for i in range(len(points))
+        ]
+
+
+# ---- distinct-digest storm offload ------------------------------------------
+# The device side of VERDICT r5 item 8: for an all-distinct TC batch,
+# every per-entry G1 ladder — signature subgroup check (order·sig),
+# weighted signature (r_i·sig_i), and weighted cofactor-cleared hash
+# ((r_i·h_eff)·H_base(m_i)) — runs as ONE batched device ladder of
+# 3n points, followed by an on-device aggregation of the weighted
+# signatures.  The host (native/bls_pairing.cpp) keeps decompression,
+# hashing, and the pairing product over the returned points.
+
+
+class TpuStormOffload:
+    """Batched G1 ladders for distinct-digest TC verification."""
+
+    def __init__(self):
+        self._mul = TpuG1ScalarMul(nbits=256)
+        self.ready = False
+
+    def warmup(self, n: int = 171) -> None:
+        """Compile/cache the ladder + aggregation shapes for an n-entry
+        storm (3n-point ladder batch) before the consensus hot path."""
+        from ..crypto.bls.curve import G1Point
+
+        g = G1Point.generator()
+        pts = [g] * 2
+        self._mul.mul([3, 5], pts)  # tiny shape sanity + trace cache
+        batch = 3 * n
+        padded = self._mul._padded(batch)
+        self._mul.mul([1] * padded, [g] * padded)  # the storm shape
+        # aggregation shape for the wsig segment
+        agg_pad = 1 << (n - 1).bit_length()
+        xs = np.zeros((agg_pad, NLIMBS), np.int32)
+        ys = np.tile(to_mont_limbs(1), (agg_pad, 1)).astype(np.int32)
+        zs = np.zeros((agg_pad, NLIMBS), np.int32)
+        _aggregate_kernel(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
+        self.ready = True
+
+    def batch_points(self, weights: list[int], bases, sigs):
+        """(whm_points, agg_point, subgroup_ok) for the native pairing
+        product.  ``bases`` are PRE-cofactor hash points, ``sigs``
+        on-curve (subgroup membership checked HERE via the order
+        ladder).  whm_i = (w_i·h_eff)·base_i; agg = Σ w_i·sig_i."""
+        from ..crypto.bls.curve import H1
+        from ..crypto.bls.fields import R as ORDER
+
+        n = len(bases)
+        assert len(weights) == n and len(sigs) == n
+        scalars = (
+            [w * H1 for w in weights] + list(weights) + [ORDER] * n
+        )
+        x, y, z = self._mul.mul_arrays(scalars, list(bases) + list(sigs) * 2)
+        x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
+        # subgroup: order·sig must be the identity (z == 0 mod q).
+        # The G1 cofactor has SMALL prime factors, so a small-order
+        # component must be caught per signature — aggregate-only
+        # checking is unsound here (see native verify_batch's comment).
+        subgroup_ok = all(
+            from_mont_int(z[2 * n + i]) == 0 for i in range(n)
+        )
+        whm = [
+            TpuG1Aggregator._projective_to_affine(x[i], y[i], z[i])
+            for i in range(n)
+        ]
+        # aggregate the wsig segment on device
+        agg_pad = 1 << (n - 1).bit_length()
+        xs = np.zeros((agg_pad, NLIMBS), np.int32)
+        ys = np.tile(to_mont_limbs(1), (agg_pad, 1)).astype(np.int32)
+        zs = np.zeros((agg_pad, NLIMBS), np.int32)
+        xs[:n], ys[:n], zs[:n] = x[n : 2 * n], y[n : 2 * n], z[n : 2 * n]
+        ax, ay, az = _aggregate_kernel(
+            jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs)
+        )
+        agg = TpuG1Aggregator._projective_to_affine(
+            np.asarray(ax).reshape(NLIMBS),
+            np.asarray(ay).reshape(NLIMBS),
+            np.asarray(az).reshape(NLIMBS),
+        )
+        return whm, agg, subgroup_ok

@@ -31,7 +31,9 @@ def main() -> int:
     p50 = times[len(times) // 2] * 1e3
     verdict = "good" if p50 < 5 else ("fair" if p50 < 50 else "degraded")
     print(f"tunnel dispatch p50 {p50:.2f} ms ({verdict})")
-    return 0
+    # machine-readable exit for the harness weather gate
+    # (benchmark/local.py --wait-weather): 0 good, 3 fair, 4 degraded
+    return 0 if p50 < 5 else (3 if p50 < 50 else 4)
 
 
 if __name__ == "__main__":

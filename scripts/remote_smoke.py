@@ -1,0 +1,92 @@
+"""Smoke the remote harness end-to-end over a real transport (VERDICT
+r5 item 9): install (real `git clone` of this repo) -> config keygen +
+upload -> detached nohup node/client launch -> log download -> parsed
+SUMMARY.  The gcloud CLI surface is served by scripts/fake_gcloud (a
+localhost sandbox executor — no sshd exists in this image and nothing
+may be installed; see that file's docstring), so every harness command
+string, file transfer, and log artifact is real; only the SSH hop is a
+local shell.
+
+    python scripts/remote_smoke.py [--nodes 4] [--rate 500] [--duration 15]
+"""
+
+import argparse
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+SMOKE_ROOT = "/tmp/hotstuff-remote-smoke"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rate", type=int, default=500)
+    ap.add_argument("--duration", type=float, default=15.0)
+    args = ap.parse_args()
+
+    # one sandbox "host": the remote layout co-locates extra nodes on a
+    # host with sequential ports, and distinct sandboxes share this
+    # machine's loopback, so a single host is the collision-free shape
+    shutil.rmtree(SMOKE_ROOT, ignore_errors=True)
+    os.makedirs(os.path.join(SMOKE_ROOT, "smoke-0"))
+
+    shim_dir = os.path.abspath("scripts/fake_gcloud")
+    os.environ["PATH"] = shim_dir + os.pathsep + os.environ["PATH"]
+    os.environ["GCLOUD_SHIM_ROOT"] = SMOKE_ROOT
+
+    from benchmark.remote import RemoteBench
+    from benchmark.settings import Settings
+
+    settings = Settings(
+        testbed="smoke",
+        key_path="unused",
+        consensus_port=27_100,
+        repo_name="hotstuff_tpu_repo",
+        repo_url=os.path.abspath("."),
+        branch="main",
+        zone="localhost-a",
+        accelerator_type="local-sandbox",
+        runtime_version="local",
+        instances=1,
+    )
+    bench = RemoteBench(settings)
+
+    print("== install (real git clone into the sandbox) ==", flush=True)
+    bench.install()
+    clone = os.path.join(SMOKE_ROOT, "smoke-0", settings.repo_name)
+    assert os.path.isdir(os.path.join(clone, ".git")), "clone missing"
+    # the sandbox runs nodes from the clone: build its native libs once
+    # up front so first-use builds don't race inside the run window
+    bench._ssh("smoke-0", f"make -C {settings.repo_name}/native || true")
+
+    print("== kill + config + run + logs ==", flush=True)
+    t0 = time.time()
+    bench.run(
+        nodes_list=[args.nodes],
+        rate_list=[args.rate],
+        duration=args.duration,
+        runs=1,
+        faults=0,
+        verifier="cpu",
+    )
+    print(f"remote smoke completed in {time.time() - t0:.0f}s", flush=True)
+    # relabel the results file so remote-smoke runs never mix into the
+    # local-bench aggregates under the same name
+    src = f"results/bench-0-{args.nodes}-{args.rate}-cpu.txt"
+    dst = f"results/remote-smoke-0-{args.nodes}-{args.rate}-cpu.txt"
+    if os.path.exists(src) and os.path.getmtime(src) >= t0:
+        with open(src) as f:
+            content = f.read()
+        with open(dst, "a") as f:
+            f.write(content)
+        os.remove(src)
+        print(f"summary moved to {dst}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

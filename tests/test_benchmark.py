@@ -187,3 +187,30 @@ def test_plots_render_from_synthetic_groups(tmp_path):
     ):
         out = fn(groups, str(tmp_path / name))
         assert (tmp_path / name).exists() and (tmp_path / name).stat().st_size > 0
+
+
+def test_log_parser_verify_stats_routing_split():
+    """Cumulative per-service routing counters: the LAST line per
+    service tag wins, tags sum across logs — the device-routing proof
+    lines in the SUMMARY (VERDICT r5 item 1)."""
+    node_log = (
+        "2026-01-01T00:00:01.000Z [INFO] Verify service stats [tpu#1]: "
+        "dispatches=5 device=3 device_sigs=100 cpu_sigs=50 "
+        "deadline_misses=0 ewma_ms=1.5\n"
+        "2026-01-01T00:00:06.000Z [INFO] Verify service stats [tpu#1]: "
+        "dispatches=20 device=15 device_sigs=900 cpu_sigs=100 "
+        "deadline_misses=1 ewma_ms=2.0\n"
+        "2026-01-01T00:00:06.200Z [INFO] Verify service stats [tpu#2]: "
+        "dispatches=4 device=0 device_sigs=0 cpu_sigs=300 "
+        "deadline_misses=0 ewma_ms=120.0\n"
+        + NODE_LOG
+    )
+    parser = LogParser([node_log], [CLIENT_LOG])
+    assert parser.device_sigs == 900  # last tpu#1 line only
+    assert parser.cpu_route_sigs == 400  # 100 (tpu#1) + 300 (tpu#2)
+    assert parser.deadline_misses == 1
+    assert parser.verify_ewma_ms == 120.0
+    out = parser.result(nodes=2, verifier="tpu")
+    assert "Verify sigs device-routed: 900 of 1,300 (69%)" in out
+    # runs without async services print no routing lines
+    assert "device-routed" not in LogParser([NODE_LOG], [CLIENT_LOG]).result()

@@ -195,3 +195,80 @@ def test_sharded_bls_verifier_end_to_end():
     assert v.verify_shared_msg(msg, votes)
     forged = votes[:4] + [(votes[4][0], votes[0][1])]
     assert not v.verify_shared_msg(msg, forged)
+
+
+def test_scalar_mult_ladder_matches_oracle():
+    """The batched variable-base ladder (TpuG1ScalarMul) against the
+    Python oracle, including chain depths past the ~40-add magnitude
+    drift the per-iteration freshen exists for (a 48-bit ladder runs 96
+    sequential point adds)."""
+    from hotstuff_tpu.crypto.bls.curve import G1Point
+    from hotstuff_tpu.tpu.bls import TpuG1ScalarMul
+
+    g = G1Point.generator()
+    g2 = g + g
+    m = TpuG1ScalarMul(nbits=48)
+    ks = [5, (1 << 40) + 1, (1 << 47) + (1 << 23) + 9, 0]
+    pts = [g, g, g2, g]
+    out = m.mul(ks, pts)
+    for k, p, r in zip(ks, pts, out):
+        want = p._mul_raw(k)
+        assert r == want or (r.inf and want.inf)
+
+
+def test_native_offload_split_apis():
+    """The host ends of the storm offload: hash_base_many gives the
+    PRE-cofactor map (base * h_eff == hash_to_g1), g1_decompress_many
+    round-trips signatures, and verify_batch_points accepts the pairing
+    product over correctly weighted points and rejects a corruption."""
+    import secrets
+
+    pytest.importorskip("hotstuff_tpu.crypto.bls.native")
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.crypto.bls import keygen as bls_keygen, native
+    from hotstuff_tpu.crypto.bls.curve import H1, G1Point, hash_to_g1
+    from hotstuff_tpu.crypto.bls.service import BlsSigningService
+
+    n = 6
+    db, pb, sb = [], [], []
+    for i in range(n):
+        pk, sk = bls_keygen(bytes([77, i]) + b"\x00" * 30)
+        svc = BlsSigningService(sk)
+        d = Digest.of(bytes([i]) * 7)
+        db.append(d.to_bytes())
+        pb.append(pk.to_bytes())
+        sb.append(svc.sign_sync(d).to_bytes())
+
+    def parse(raw, count):
+        return [
+            G1Point(
+                int.from_bytes(raw[96 * i : 96 * i + 48], "big"),
+                int.from_bytes(raw[96 * i + 48 : 96 * i + 96], "big"),
+            )
+            for i in range(count)
+        ]
+
+    bases = parse(native.hash_base_many(db), n)
+    for d, base in zip(db, bases):
+        assert base._mul_raw(H1) == hash_to_g1(d)
+    sigs = parse(native.g1_decompress_many(sb), n)
+
+    ws = [secrets.randbits(128) | 1 for _ in range(n)]
+    whm = [bases[i]._mul_raw(ws[i] * H1) for i in range(n)]
+    agg = G1Point.identity()
+    for i in range(n):
+        agg = agg + sigs[i]._mul_raw(ws[i])
+
+    def ser(pt):
+        return (
+            bytes(96)
+            if pt.inf
+            else pt.x.to_bytes(48, "big") + pt.y.to_bytes(48, "big")
+        )
+
+    whm_bytes = b"".join(ser(p) for p in whm)
+    assert native.verify_batch_points(whm_bytes, pb, ser(agg))
+    # corrupt one weighted-hash point: product must fail
+    bad = bytearray(whm_bytes)
+    bad[50] ^= 1
+    assert not native.verify_batch_points(bytes(bad), pb, ser(agg))
