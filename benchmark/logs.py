@@ -103,12 +103,15 @@ class LogParser:
         # This is the device-routing PROOF for tpu-verifier runs
         # (VERDICT r5 item 1): device_sigs vs cpu_sigs says where
         # claims were actually served.
-        per_tag: dict[str, tuple[int, int, int, int, float]] = {}
-        for content in node_logs:
+        # keyed by (log file, tag): tags embed pid+serial, which is
+        # unique within a host but can collide across hosts in a remote
+        # sweep — the log file disambiguates
+        per_tag: dict[tuple, tuple[int, int, int, int, float]] = {}
+        for log_idx, content in enumerate(node_logs):
             for tag, disp, dev, dsig, csig, miss, ewma in (
                 RE_VERIFY_STATS.findall(content)
             ):
-                per_tag[tag] = (
+                per_tag[(log_idx, tag)] = (
                     int(disp), int(dsig), int(csig), int(miss), float(ewma)
                 )
         self.device_sigs = sum(v[1] for v in per_tag.values())
@@ -286,5 +289,5 @@ class LogParser:
             f" Verify sigs device-routed: {self.device_sigs:,} of {total:,}"
             f" ({pct:.0f}%)\n"
             f" Verify deadline misses: {self.deadline_misses}\n"
-            f" Device dispatch EWMA (last): {ewma}\n"
+            f" Verify dispatch EWMA (worst service): {ewma}\n"
         )

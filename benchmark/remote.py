@@ -64,8 +64,10 @@ class RemoteBench:
     def install(self) -> None:
         """Clone the repo on every instance (reference remote.py:58-83)."""
         s = self.settings
+        # clone into the CONFIGURED directory name — relying on the URL
+        # basename matching repo_name breaks the first time they differ
         cmd = (
-            f"git clone {s.repo_url} || "
+            f"git clone {s.repo_url} {s.repo_name} || "
             f"(cd {s.repo_name} && git fetch origin)"
         )
         for h in self.manager.hosts():
@@ -147,24 +149,34 @@ class RemoteBench:
         """Boot clients then nodes in detached remote shells
         (reference remote.py:177-219)."""
         repo = self.settings.repo_name
+        # Detached-launch shape matters: `mkdir && cd && nohup CMD &`
+        # backgrounds the ENTIRE and-list, so the background shell's own
+        # un-redirected stdout/stderr keep the ssh channel open until
+        # the node exits — every launch "hangs" for the node's lifetime
+        # (caught by the localhost transport smoke, scripts/
+        # remote_smoke.py).  Background exactly ONE subshell with ALL
+        # three fds redirected on it; mkdir runs in a separate command.
+        for h in {hosts[i % len(hosts)]["name"] for i in range(nodes)}:
+            self._ssh(h, f"mkdir -p {repo}/logs")
         for i in range(nodes - faults):
             host = hosts[i % len(hosts)]
             node_cmd = (
-                f"cd {repo} && nohup python3 -m hotstuff_tpu.node -vv run"
+                f"( cd {repo} && exec nohup python3 -m hotstuff_tpu.node"
+                f" -vv run"
                 f" --keys {PathMaker.key_file(i)}"
                 f" --committee {PathMaker.committee_file()}"
                 f" --store .db_{i}"
                 f" --parameters {PathMaker.parameters_file()}"
                 f" --verifier {verifier}"
-                f" > logs/node-{i}.log 2>&1 &"
+                f" ) > {repo}/logs/node-{i}.log 2>&1 < /dev/null &"
             )
-            self._ssh(host["name"], f"mkdir -p {repo}/logs && {node_cmd}")
+            self._ssh(host["name"], node_cmd)
         client_host = hosts[0]
         client_cmd = (
-            f"cd {repo} && nohup python3 -m hotstuff_tpu.node.client"
+            f"( cd {repo} && exec nohup python3 -m hotstuff_tpu.node.client"
             f" --committee {PathMaker.committee_file()}"
             f" --rate {rate} --duration {duration} --faults {faults}"
-            f" > logs/client.log 2>&1 &"
+            f" ) > {repo}/logs/client.log 2>&1 < /dev/null &"
         )
         self._ssh(client_host["name"], client_cmd)
 

@@ -258,6 +258,19 @@ def run_storm(
             results["bls-cpu"].update(
                 _measure_offloop_tc(committee, tc[1], bls_verifier)
             )
+        if device:
+            # the opt-in TPU ladder offload for the all-distinct storm
+            # (VERDICT r5 item 8): measured honestly next to the host
+            # route — on this rig it LOSES (per-op-overhead-bound VPU
+            # shape, docs/ROUND5.md), which is why it is opt-in
+            from hotstuff_tpu.crypto.scheme import make_device_verifier
+
+            v = make_device_verifier("bls", "tpu")
+            v.warmup_storm_offload(quorum)
+            if v._storm is not None and v._storm.ready:
+                results["bls-tpu-storm-offload"] = _measure(
+                    committee, timeouts, tc, v
+                )
     return results
 
 

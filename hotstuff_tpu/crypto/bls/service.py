@@ -266,8 +266,9 @@ class BlsVerifier:
                 return None
             g_db.append(d)
             # sum of subgroup-checked cached points stays in-subgroup
-            # (closure), so the native strict pk ladder is safe to pay —
-            # and with G small it costs ~2 ms/group at most
+            # (closure) — the native layer is told so
+            # (check_pk_subgroup=False), which also keeps these one-shot
+            # aggregate keys out of its prepared-coefficient cache
             g_pb.append(aggregate_public_keys(pubs).to_bytes())
             g_sb.append(agg_sig)
         return g_db, g_pb, g_sb
@@ -323,8 +324,15 @@ class BlsVerifier:
                 )
                 if grouped is not None:
                     g_db, g_pb, g_sb = grouped
+                    # check_pk_subgroup=False: the aggregates are sums
+                    # of subgroup-checked cached committee points
+                    # (closure), and the flag also tells the native
+                    # layer these one-shot keys must not enter the
+                    # prepared-line-coefficient cache
                     ok = (
-                        self._native.verify_batch(g_db, g_pb, g_sb)
+                        self._native.verify_batch(
+                            g_db, g_pb, g_sb, check_pk_subgroup=False
+                        )
                         if len(g_db) > 1
                         else self._native.verify_one(
                             g_db[0], g_pb[0], g_sb[0],
@@ -337,6 +345,7 @@ class BlsVerifier:
                     aggregate_ok
                     and self._storm is not None
                     and self._storm.ready
+                    and self._storm.shape_ready(n)
                     and n >= 16
                     and self._storm_verify(db, pb, sb)
                 ):

@@ -497,25 +497,35 @@ class TpuStormOffload:
 
     def __init__(self):
         self._mul = TpuG1ScalarMul(nbits=256)
+        # compiled (ladder_pad, agg_pad) shape pairs: batch_points
+        # REFUSES un-warmed shapes (shape_ready) so a differently-sized
+        # storm can never trigger a cold jit compile mid-consensus —
+        # the caller falls back to the host route instead
+        self._warm_shapes: set[tuple[int, int]] = set()
         self.ready = False
+
+    def _shapes_for(self, n: int) -> tuple[int, int]:
+        return self._mul._padded(3 * n), 1 << max(0, (n - 1).bit_length())
+
+    def shape_ready(self, n: int) -> bool:
+        return self._shapes_for(n) in self._warm_shapes
 
     def warmup(self, n: int = 171) -> None:
         """Compile/cache the ladder + aggregation shapes for an n-entry
-        storm (3n-point ladder batch) before the consensus hot path."""
+        storm (3n-point ladder batch) before the consensus hot path.
+        Call once per storm size of interest; other sizes fall back to
+        the host route rather than compiling under a round timer."""
         from ..crypto.bls.curve import G1Point
 
         g = G1Point.generator()
-        pts = [g] * 2
-        self._mul.mul([3, 5], pts)  # tiny shape sanity + trace cache
-        batch = 3 * n
-        padded = self._mul._padded(batch)
-        self._mul.mul([1] * padded, [g] * padded)  # the storm shape
+        ladder_pad, agg_pad = self._shapes_for(n)
+        self._mul.mul([1] * ladder_pad, [g] * ladder_pad)  # the storm shape
         # aggregation shape for the wsig segment
-        agg_pad = 1 << (n - 1).bit_length()
         xs = np.zeros((agg_pad, NLIMBS), np.int32)
         ys = np.tile(to_mont_limbs(1), (agg_pad, 1)).astype(np.int32)
         zs = np.zeros((agg_pad, NLIMBS), np.int32)
         _aggregate_kernel(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
+        self._warm_shapes.add((ladder_pad, agg_pad))
         self.ready = True
 
     def batch_points(self, weights: list[int], bases, sigs):

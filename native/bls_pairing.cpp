@@ -1657,6 +1657,11 @@ int hs_bls_aggregate_sigs(const uint8_t *sigs, size_t n, uint8_t *out48) {
 int hs_bls_verify_batch(const uint8_t *msgs32, const uint8_t *pks96,
                         const uint8_t *sigs48, size_t n,
                         const uint8_t *weights16, int check_pk_subgroup) {
+  // check_pk_subgroup == 0 marks per-batch AGGREGATE keys (the grouped
+  // TC path): they never repeat, so caching their ~20 KB prepared line
+  // coefficients would only pollute (and eventually flush) the
+  // committee-key cache — prepare them on the stack instead
+  const bool cache_pks = check_pk_subgroup != 0;
   if (n == 0) return 0;
   static const uint8_t DST[] = "HOTSTUFF_TPU_BLS_G1";
   G1Jac sig_acc = {fp_one(), fp_one(), fp_zero()};
@@ -1693,7 +1698,13 @@ int hs_bls_verify_batch(const uint8_t *msgs32, const uint8_t *pks96,
     Fp12 fi;
     // committee keys are fixed per epoch: cached line coefficients
     // halve the per-entry Miller cost
-    miller_loop_prepared(fi, whm, *g2_prepared_cached(pks96 + 96 * i, pk));
+    if (cache_pks) {
+      miller_loop_prepared(fi, whm, *g2_prepared_cached(pks96 + 96 * i, pk));
+    } else {
+      G2Prepared prep;
+      g2_prepare(prep, pk);
+      miller_loop_prepared(fi, whm, prep);
+    }
     fp12_mul(f, f, fi);
   }
   G1 agg = g1_from_jac(sig_acc);

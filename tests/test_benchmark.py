@@ -212,5 +212,6 @@ def test_log_parser_verify_stats_routing_split():
     assert parser.verify_ewma_ms == 120.0
     out = parser.result(nodes=2, verifier="tpu")
     assert "Verify sigs device-routed: 900 of 1,300 (69%)" in out
+    assert "Verify dispatch EWMA (worst service): 120.0 ms" in out
     # runs without async services print no routing lines
     assert "device-routed" not in LogParser([NODE_LOG], [CLIENT_LOG]).result()
