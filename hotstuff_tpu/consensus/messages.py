@@ -106,6 +106,13 @@ class QC:
     hash: Digest = field(default_factory=Digest)
     round: Round = 0
     votes: list[tuple[PublicKey, Signature]] = field(default_factory=list)
+    # memoized wire encoding (same contract as Block._wire): the
+    # committee's current high_qc is re-encoded on every ConsensusState
+    # persist (once-plus per round per node) and in every block carrying
+    # it; certificates never mutate after construction/decode.
+    _wire: bytes | None = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     @classmethod
     def genesis(cls) -> "QC":
@@ -222,18 +229,27 @@ class QC:
         return hash((self.hash, self.round))
 
     def encode(self, enc: Encoder) -> None:
-        enc.raw(self.hash.to_bytes()).u64(self.round).u32(len(self.votes))
-        for pk, sig in self.votes:
-            encode_pk(enc, pk)
-            encode_sig(enc, sig)
+        w = self._wire
+        if w is None:
+            e = Encoder()
+            e.raw(self.hash.to_bytes()).u64(self.round).u32(len(self.votes))
+            for pk, sig in self.votes:
+                encode_pk(e, pk)
+                encode_sig(e, sig)
+            w = e.finish()
+            self._wire = w
+        enc.raw(w)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "QC":
+        start = dec.mark()
         h = Digest(dec.raw(Digest.SIZE))
         rnd = dec.u64()
         n = dec.u32()
         votes = [(decode_pk(dec), decode_sig(dec)) for _ in range(n)]
-        return cls(hash=h, round=rnd, votes=votes)
+        qc = cls(hash=h, round=rnd, votes=votes)
+        qc._wire = dec.since(start)
+        return qc
 
     def __repr__(self) -> str:
         return f"QC({self.hash}, {self.round})"
@@ -361,6 +377,14 @@ class Block:
     _digest: Digest | None = field(
         default=None, init=False, repr=False, compare=False
     )
+    # memoized wire encoding — a received block is decoded from wire
+    # bytes and then re-serialized for the store write (core store_block
+    # path); capturing the decode slice makes serialize() a cached
+    # return.  Safe for the same reason _digest is: blocks never mutate
+    # after construction.
+    _wire: bytes | None = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     @classmethod
     def genesis(cls) -> "Block":
@@ -444,6 +468,7 @@ class Block:
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Block":
+        start = dec.mark()
         qc = QC.decode(dec)
         tc = TC.decode(dec) if dec.flag() else None
         author = decode_pk(dec)
@@ -451,14 +476,20 @@ class Block:
         n = dec.u32()
         payloads = tuple(Digest(dec.raw(Digest.SIZE)) for _ in range(n))
         sig = decode_sig(dec)
-        return cls(
+        block = cls(
             qc=qc, tc=tc, author=author, round=rnd, payloads=payloads, signature=sig
         )
+        block._wire = dec.since(start)
+        return block
 
     def serialize(self) -> bytes:
-        enc = Encoder()
-        self.encode(enc)
-        return enc.finish()
+        w = self._wire
+        if w is None:
+            enc = Encoder()
+            self.encode(enc)
+            w = enc.finish()
+            self._wire = w
+        return w
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Block":

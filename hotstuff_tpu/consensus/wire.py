@@ -28,10 +28,14 @@ ACK = b"Ack"
 SCHEME_WIRE_SIZES = {"ed25519": (32, 64), "bls": (96, 48)}
 
 
+_PROPOSE_PREFIX = bytes([TAG_PROPOSE])
+
+
 def encode_propose(block: Block) -> bytes:
-    enc = Encoder().u8(TAG_PROPOSE)
-    block.encode(enc)
-    return enc.finish()
+    # serialize() is wire-cached on the block (messages.py), so the
+    # helper/synchronizer re-sends and the store write share one
+    # encoding with the original broadcast
+    return _PROPOSE_PREFIX + block.serialize()
 
 
 def encode_vote(vote: Vote) -> bytes:

@@ -29,10 +29,14 @@ import subprocess
 _LIB_NAME = "libhs_ed25519.so"
 
 # Measured crossover on the dev rig where the batch equation beats the
-# per-signature OpenSSL loop (r5: 1.2x at 11 sigs, 2.2x at 22, 3.5x at
-# 256).  The single source of truth — the verifier backend and the
-# async router both import it.
-NATIVE_BATCH_MIN = 11
+# per-signature OpenSSL loop.  With the Straus small-batch path in the
+# native MSM (r5) the batch wins from n=2 up (n=2: 0.13 vs 0.24 ms;
+# n=4: 0.21 vs 0.49; n=11: 0.50 vs 1.46; n=256: 8.5 vs 31.4).  n=1
+# stays on OpenSSL: a lone signature gets the cofactorless
+# verify_strict-style semantics the reference uses for singles.  The
+# single source of truth — the verifier backend and the async router
+# both import it.
+NATIVE_BATCH_MIN = 2
 
 
 def _native_dir() -> str:

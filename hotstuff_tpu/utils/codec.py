@@ -106,6 +106,17 @@ class Decoder:
             raise CodecError(f"invalid option flag {v}")
         return v == 1
 
+    def mark(self) -> int:
+        """Current position, for ``since`` wire-slice capture."""
+        return self._pos
+
+    def since(self, mark: int) -> bytes:
+        """The raw bytes consumed since ``mark`` — lets message decoders
+        retain their exact wire encoding so a later serialize() is a
+        cached-bytes return instead of a re-encode (the store path
+        re-serialized every received block)."""
+        return self._data[mark : self._pos]
+
     def finish(self) -> None:
         """Assert the input was fully consumed."""
         if self._pos != len(self._data):

@@ -501,9 +501,46 @@ static unsigned sc_window(const sc& s, int w, int c) {
   return (unsigned)(d & ((1u << c) - 1));
 }
 
+// Straus (simultaneous windows, per-point tables) for small point sets.
+// Pippenger's bucket reduction costs 2*(2^c-1) adds per window whatever
+// k is — for a handful of points that fixed cost dominates (a 3-point
+// MSM spent ~2k adds reducing 15 buckets 64 times).  Straus instead
+// pays 15 adds per point ONCE (the d*P_i table, d=1..15) and then one
+// add per nonzero digit: ~253 doubles + ~74 adds per point, no
+// reduction term.  In add-units: Straus(4) = 253 + 74.3k vs
+// Pippenger(4) = 253 + 59.3k + 1898, so Straus wins below k ~ 127; vs
+// Pippenger(6) = 258 + 42.3k + 5418 the model crossover is k ~ 169 and
+// the measured one ~200-257 (head-to-head sweep, docs/ROUND5.md).
+static ge ge_msm_straus(const std::vector<sc>& scalars,
+                        const std::vector<ge>& points) {
+  size_t k = points.size();
+  std::vector<ge> table(k * 15);  // table[i*15 + (d-1)] = d * P_i
+  for (size_t i = 0; i < k; i++) {
+    table[i * 15] = points[i];
+    for (int d = 1; d < 15; d++)
+      table[i * 15 + d] = ge_add(table[i * 15 + d - 1], points[i]);
+  }
+  ge result = ge_identity();
+  for (int w = 63; w >= 0; w--) {  // 64 4-bit windows cover bits 0..255
+    if (w != 63)
+      for (int i = 0; i < 4; i++) result = ge_double(result);
+    for (size_t i = 0; i < k; i++) {
+      unsigned d = sc_window(scalars[i], w, 4);
+      if (d) result = ge_add(result, table[i * 15 + d - 1]);
+    }
+  }
+  return result;
+}
+
 static ge ge_msm(const std::vector<sc>& scalars, const std::vector<ge>& points) {
   size_t k = scalars.size();
-  int c = k < 16 ? 4 : k < 128 ? 6 : k < 1024 ? 8 : 10;
+  if (k < 200) return ge_msm_straus(scalars, points);
+  // Bucket thresholds from the cost model windows*(c + k + 2*2^c):
+  // c=6 beats c=4 above k ~ 207 (moot — Straus owns that range) and
+  // c=8 beats c=6 above k ~ 1050.  The old thresholds (c=6 from k=16)
+  // made an 8-signature batch SLOWER than 6 (the n=8 step measured in
+  // docs/ROUND5.md).
+  int c = k < 1024 ? 6 : k < 8192 ? 8 : 10;
   int windows = (253 + c - 1) / c;
   std::vector<ge> buckets((size_t)1 << c);
   ge result = ge_identity();

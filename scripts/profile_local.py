@@ -21,12 +21,26 @@ import time
 sys.path.insert(0, ".")
 
 BUCKETS = {
-    "crypto": ("crypto/", "tpu/", "_verify", "sign"),
-    "store": ("store/",),
-    "network": ("framing", "network/", "streams.py", "selector_events"),
-    "serialization": ("codec", "wire.py", "messages.py"),
-    "consensus": ("core.py", "proposer.py", "aggregator.py", "synchronizer"),
-    "asyncio": ("asyncio/",),
+    # NB: patterns match against full file paths; "hotstuff_tpu/tpu/"
+    # (not "tpu/") — a bare "tpu/" matches every hotstuff_tpu/ path and
+    # swallows all buckets into crypto.
+    "crypto": ("hotstuff_tpu/crypto/", "hotstuff_tpu/tpu/", "hashlib", "_hashlib"),
+    "store": ("hotstuff_tpu/store/",),
+    "network": ("hotstuff_tpu/network/", "streams.py", "selector_events"),
+    "serialization": ("utils/codec", "consensus/wire.py", "consensus/messages.py"),
+    "consensus": (
+        "consensus/core.py",
+        "consensus/proposer.py",
+        "consensus/aggregator.py",
+        "consensus/synchronizer",
+        "consensus/helper.py",
+        "consensus/consensus.py",
+        "consensus/leader.py",
+        "consensus/timer.py",
+        "consensus/config.py",
+    ),
+    "logging": ("logging/",),
+    "asyncio": ("asyncio/", "selectors.py"),
 }
 
 
@@ -114,6 +128,9 @@ def main() -> int:
     stats.sort_stats("cumulative")
     print(f"=== wall: {wall:.1f}s ===")
     stats.print_stats(25)
+    stats.sort_stats("tottime")
+    print("=== top self time ===")
+    stats.print_stats(30)
 
     # bucket tottime by module
     totals: dict[str, float] = {k: 0.0 for k in BUCKETS}
