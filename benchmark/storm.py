@@ -267,9 +267,19 @@ def run_storm(
 
             v = make_device_verifier("bls", "tpu")
             v.warmup_storm_offload(quorum)
-            if v._storm is not None and v._storm.ready:
+            # only publish the row when the offload will actually serve
+            # this quorum size — a declined offload (e.g. quorum < 16)
+            # would silently measure the host route under the
+            # offload label
+            if v.storm_offload_engaged(quorum):
                 results["bls-tpu-storm-offload"] = _measure(
                     committee, timeouts, tc, v
+                )
+            else:
+                print(
+                    f" storm offload declined for quorum={quorum} "
+                    "(not warmed or below the n>=16 floor); "
+                    "bls-tpu-storm-offload row skipped"
                 )
     return results
 

@@ -106,6 +106,20 @@ class BlsVerifier:
             self._storm = TpuStormOffload()
         self._storm.warmup(n)
 
+    def storm_offload_engaged(self, n: int) -> bool:
+        """True iff an n-entry all-distinct TC batch would actually run
+        through the device ladder offload in ``verify_many`` — the same
+        gate that method applies (warmed shapes AND the n >= 16 floor
+        below which the dispatch fixed cost can't amortize).  Public so
+        the storm harness can refuse to label a host-route measurement
+        as the offload row."""
+        return (
+            self._storm is not None
+            and self._storm.ready
+            and self._storm.shape_ready(n)
+            and n >= 16
+        )
+
     def _storm_verify(self, db, pb, sb) -> bool:
         """Device-offloaded all-distinct batch: host hashes/decompresses
         (native), device runs all 3n G1 ladders + the wsig aggregation,
@@ -343,10 +357,7 @@ class BlsVerifier:
                         return [True] * n
                 elif (
                     aggregate_ok
-                    and self._storm is not None
-                    and self._storm.ready
-                    and self._storm.shape_ready(n)
-                    and n >= 16
+                    and self.storm_offload_engaged(n)
                     and self._storm_verify(db, pb, sb)
                 ):
                     # all-distinct worst case with the G1 ladders on

@@ -554,8 +554,11 @@ class TpuStormOffload:
             TpuG1Aggregator._projective_to_affine(x[i], y[i], z[i])
             for i in range(n)
         ]
-        # aggregate the wsig segment on device
-        agg_pad = 1 << (n - 1).bit_length()
+        # aggregate the wsig segment on device; the pad MUST come from
+        # _shapes_for — the shape_ready gate compares against it, and an
+        # independently computed pad could drift and defeat the
+        # no-cold-compile-mid-consensus guarantee
+        _, agg_pad = self._shapes_for(n)
         xs = np.zeros((agg_pad, NLIMBS), np.int32)
         ys = np.tile(to_mont_limbs(1), (agg_pad, 1)).astype(np.int32)
         zs = np.zeros((agg_pad, NLIMBS), np.int32)

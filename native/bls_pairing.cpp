@@ -1668,8 +1668,16 @@ int hs_bls_verify_batch(const uint8_t *msgs32, const uint8_t *pks96,
   Fp12 f = fp12_one();
   for (size_t i = 0; i < n; i++) {
     G2 pk;
-    if (!g2_from_bytes_cached(pk, pks96 + 96 * i, check_pk_subgroup != 0))
-      return 0;
+    if (cache_pks) {
+      if (!g2_from_bytes_cached(pk, pks96 + 96 * i, /*subgroup=*/true))
+        return 0;
+    } else {
+      // one-shot aggregate keys: plain decode, no subgroup ladder (the
+      // flag's contract), and no decode-cache insertion — the cached
+      // path would run the ladder on every miss anyway and grow the
+      // cache toward the clear() that evicts the real committee keys
+      if (!g2_from_bytes(pk, pks96 + 96 * i, /*subgroup=*/false)) return 0;
+    }
     if (pk.inf) return 0;
     G1 sig;
     // per-signature subgroup check: the G1 cofactor has SMALL factors
@@ -1835,18 +1843,32 @@ int hs_bls_hash_base_many(const uint8_t *msgs32, size_t n,
 int hs_bls_verify_batch_points(const uint8_t *whm96, const uint8_t *pks96,
                                size_t n, const uint8_t *agg96,
                                int check_pk_subgroup) {
+  // same cache discipline as hs_bls_verify_batch: check_pk_subgroup==0
+  // marks caller-validated one-shot keys that must stay out of both the
+  // decode cache and the prepared-coefficient cache
+  const bool cache_pks = check_pk_subgroup != 0;
   if (n == 0) return 0;
   Fp12 f = fp12_one();
   for (size_t i = 0; i < n; i++) {
     G2 pk;
-    if (!g2_from_bytes_cached(pk, pks96 + 96 * i, check_pk_subgroup != 0))
-      return 0;
+    if (cache_pks) {
+      if (!g2_from_bytes_cached(pk, pks96 + 96 * i, /*subgroup=*/true))
+        return 0;
+    } else {
+      if (!g2_from_bytes(pk, pks96 + 96 * i, /*subgroup=*/false)) return 0;
+    }
     if (pk.inf) return 0;
     G1 whm;
     if (!g1_from_uncompressed(whm, whm96 + 96 * i)) return 0;
     if (whm.inf) return 0;  // zero weight/hash defeats the check
     Fp12 fi;
-    miller_loop_prepared(fi, whm, *g2_prepared_cached(pks96 + 96 * i, pk));
+    if (cache_pks) {
+      miller_loop_prepared(fi, whm, *g2_prepared_cached(pks96 + 96 * i, pk));
+    } else {
+      G2Prepared prep;
+      g2_prepare(prep, pk);
+      miller_loop_prepared(fi, whm, prep);
+    }
     fp12_mul(f, f, fi);
   }
   G1 agg;

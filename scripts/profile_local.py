@@ -24,7 +24,13 @@ BUCKETS = {
     # NB: patterns match against full file paths; "hotstuff_tpu/tpu/"
     # (not "tpu/") — a bare "tpu/" matches every hotstuff_tpu/ path and
     # swallows all buckets into crypto.
-    "crypto": ("hotstuff_tpu/crypto/", "hotstuff_tpu/tpu/", "hashlib", "_hashlib"),
+    "crypto": (
+        "hotstuff_tpu/crypto/",
+        "hotstuff_tpu/tpu/",
+        "hashlib",
+        "_hashlib",
+        "openssl",  # cryptography's Ed25519 verify/sign builtins
+    ),
     "store": ("hotstuff_tpu/store/",),
     "network": ("hotstuff_tpu/network/", "streams.py", "selector_events"),
     "serialization": ("utils/codec", "consensus/wire.py", "consensus/messages.py"),
@@ -136,10 +142,15 @@ def main() -> int:
     totals: dict[str, float] = {k: 0.0 for k in BUCKETS}
     other = 0.0
     grand = 0.0
-    for (file, _line, _fn), (_cc, _nc, tt, _ct, _callers) in stats.stats.items():
+    for (file, _line, fn), (_cc, _nc, tt, _ct, _callers) in stats.stats.items():
         grand += tt
+        # built-in methods are keyed under file '~' with the detail in
+        # the function-name field (e.g. "<method 'update' of
+        # '_hashlib.HASH' objects>") — match both fields or C digest
+        # time silently lands in 'other'
+        where = file + " " + fn
         for bucket, pats in BUCKETS.items():
-            if any(p in file for p in pats):
+            if any(p in where for p in pats):
                 totals[bucket] += tt
                 break
         else:
