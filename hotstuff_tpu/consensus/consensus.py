@@ -24,7 +24,7 @@ from ..network import Receiver as NetworkReceiver
 from ..network import Writer
 from ..store import Store
 from .config import Committee, Parameters
-from .core import Core
+from .core import Core, make_event_channels
 from .errors import SerializationError
 from .helper import Helper
 from .leader import LeaderElector
@@ -209,8 +209,14 @@ class Consensus:
 
         payload_bodies = PayloadBodies(store, parameters.payload_body_budget)
         tx_producer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
-        tx_consensus: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
-        tx_loopback: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        # The core's three select sources merge into ONE event queue
+        # (core.make_event_channels); producers keep channel-shaped
+        # facades, so the topology the reference wires (consensus.rs:
+        # 54-58) is unchanged from their side.  Capacity 2x: the merged
+        # queue carries what two channels carried.
+        rx_events, tx_consensus, tx_loopback = make_event_channels(
+            2 * CHANNEL_CAPACITY
+        )
         tx_proposer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         tx_helper: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         self.tx_producer = tx_producer
@@ -300,7 +306,7 @@ class Consensus:
             parameters.timeout_delay,
             timeout_backoff=parameters.timeout_backoff,
             timeout_cap_ms=parameters.timeout_cap_ms,
-            rx_message=tx_consensus,
+            rx_events=rx_events,
             rx_loopback=tx_loopback,
             tx_proposer=tx_proposer,
             tx_commit=tx_commit,

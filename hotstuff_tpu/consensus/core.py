@@ -54,6 +54,92 @@ log = logging.getLogger(__name__)
 CONSENSUS_STATE_KEY = b"consensus_state"
 LATEST_ROUND_KEY = b"latest_round"
 
+# Core event-queue kinds.  The reference selects over three channels
+# (core.rs:466-477); this build merges them into ONE queue of tagged
+# events: a ready item then costs a plain ``await queue.get()`` (no
+# waiter future, no Task) instead of an ``asyncio.wait`` over three
+# branch tasks with per-iteration callback add/remove — measured ~1 ms
+# of loop machinery per committed block at 4 nodes.  Arrival order
+# across kinds is preserved (one FIFO).
+EV_MSG = 0  # network message: (tag, payload) from the receiver handler
+EV_LOOP = 1  # loopback Block from the proposer/synchronizer
+EV_TIMER = 2  # round-timer expiry (from the core's own pump task)
+
+
+class TaggedEventQueue:
+    """Facade presenting one kind-tagged view of the core's merged
+    event queue — producers keep the plain ``put`` interface the
+    reference's channel topology gives them."""
+
+    __slots__ = ("_inner", "_kind")
+
+    def __init__(self, inner: asyncio.Queue, kind: int):
+        self._inner = inner
+        self._kind = kind
+
+    async def put(self, item) -> None:
+        await self._inner.put((self._kind, item))
+
+    def put_nowait(self, item) -> None:
+        self._inner.put_nowait((self._kind, item))
+
+    def qsize(self) -> int:
+        return self._inner.qsize()
+
+
+class LoopbackChannel:
+    """The proposer/synchronizer -> core loopback: its OWN bounded
+    queue, drained at the top of every core iteration, plus a
+    non-blocking wake token into the merged queue for the idle case.
+
+    Why not a tagged slot in the merged FIFO: a message flood would put
+    the node's own proposed block (and sync-resumed orphans) behind the
+    whole attacker backlog, and the producer would block awaiting a
+    slot on a queue shared with hostile traffic — the reference's
+    select loop services the loopback branch every wake-up regardless
+    of message pressure, and this preserves that bound (<= one batch)."""
+
+    __slots__ = ("_q", "_events")
+
+    def __init__(self, events: asyncio.Queue, capacity: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+        self._events = events
+
+    async def put(self, block) -> None:
+        await self._q.put(block)
+        self._wake()
+
+    def put_nowait(self, block) -> None:
+        self._q.put_nowait(block)
+        self._wake()
+
+    def _wake(self) -> None:
+        # wake an idle core; droppable when the merged queue is full —
+        # an actively-iterating core drains us every iteration anyway
+        try:
+            self._events.put_nowait((EV_LOOP, None))
+        except asyncio.QueueFull:
+            pass
+
+    def get_nowait(self):
+        return self._q.get_nowait()
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+def make_event_channels(
+    capacity: int,
+) -> tuple[asyncio.Queue, TaggedEventQueue, LoopbackChannel]:
+    """(rx_events, tx_consensus, tx_loopback): the merged core queue,
+    the network-message facade, and the priority loopback channel."""
+    rx_events: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+    return (
+        rx_events,
+        TaggedEventQueue(rx_events, EV_MSG),
+        LoopbackChannel(rx_events, capacity),
+    )
+
 
 def round_key(round_: Round) -> bytes:
     """Store key of the per-round payload-digest index (big-endian, like
@@ -186,8 +272,8 @@ class Core:
         leader_elector: LeaderElector,
         synchronizer: Synchronizer,
         timeout_delay_ms: int,
-        rx_message: asyncio.Queue,
-        rx_loopback: asyncio.Queue,
+        rx_events: asyncio.Queue,
+        rx_loopback: "LoopbackChannel",
         tx_proposer: asyncio.Queue,
         tx_commit: asyncio.Queue,
         network: SimpleSender | None = None,
@@ -202,8 +288,9 @@ class Core:
         self.store = store
         self.leader_elector = leader_elector
         self.synchronizer = synchronizer
-        self.rx_message = rx_message
+        self.rx_events = rx_events
         self.rx_loopback = rx_loopback
+        self._timer_ack = asyncio.Event()
         self.tx_proposer = tx_proposer
         self.tx_commit = tx_commit
         # consensus.PayloadBodies: committed payload bodies leave the
@@ -736,9 +823,18 @@ class Core:
         timeout_groups: dict = {}  # Digest -> [(idx, timeout)]
         collectors = {
             TAG_PROPOSE: collect_propose,
-            TAG_VOTE: collect_vote,
             TAG_TC: collect_tc,
         }
+        if self.averifier.device:
+            # Device backends: fold vote claims into the coalesced wave
+            # — marginal signatures in a device dispatch are ~free, and
+            # the off-loop await overlaps other nodes' work.  On the CPU
+            # inline path votes are deliberately NOT preverified: the
+            # aggregator accumulates them unverified and batch-verifies
+            # the whole set ONCE at quorum (QCMaker.emit), so eager
+            # per-burst checks — typically 1-2 signatures each — would
+            # run ~3 small batch equations where quorum time runs one.
+            collectors[TAG_VOTE] = collect_vote
         for idx, (tag, payload) in enumerate(burst):
             if tag == TAG_TIMEOUT:
                 if (
@@ -831,6 +927,18 @@ class Core:
         else:
             self.log.error("Unexpected protocol message tag %s in core", tag)
 
+    async def _timer_pump(self) -> None:
+        """Feeds round-timer expiries into the merged event queue.  The
+        ack handshake keeps the pump from re-firing before the core has
+        HANDLED the event (the handler resets the deadline — or a
+        message did, making the fire stale; either way the next wait()
+        sleeps)."""
+        while True:
+            await self.timer.wait()
+            self._timer_ack.clear()
+            await self.rx_events.put((EV_TIMER, None))
+            await self._timer_ack.wait()
+
     async def run(self) -> None:
         await self.load_state()
 
@@ -839,37 +947,43 @@ class Core:
         if self.name == self.leader_elector.get_leader(self.round):
             await self._generate_proposal(None)
 
-        msg_task = asyncio.ensure_future(self.rx_message.get())
-        loop_task = asyncio.ensure_future(self.rx_loopback.get())
-        timer_task = asyncio.ensure_future(self.timer.wait())
+        timer_pump = asyncio.ensure_future(self._timer_pump())
         try:
             while True:
-                done, _ = await asyncio.wait(
-                    {msg_task, loop_task, timer_task},
-                    return_when=asyncio.FIRST_COMPLETED,
-                )
-                # IMPORTANT: replace a completed branch task *before* running
-                # its handler — a handler raising (e.g. benign AuthorityReuse
-                # on a re-broadcast timeout) must not leave the completed task
-                # in the select set, or the loop would re-fire the same branch
-                # with the same payload forever.
-                if msg_task in done:
-                    # burst drain: collect whatever queued while the last
-                    # handler ran in THIS wake-up — re-arming a fresh
-                    # get() task per message costs a task create + two
-                    # switches each, which under load dominates the loop.
-                    # Bounded so a message flood cannot starve the timer
-                    # branch.  Collected FIRST so the whole wave's
-                    # signature checks discharge as ONE coalesced claim
-                    # batch (_preverify_burst) — off-loop on the device
-                    # backend — instead of per-message checks.
-                    burst = [msg_task.result()]
-                    msg_task = asyncio.ensure_future(self.rx_message.get())
-                    for _ in range(64):
-                        try:
-                            burst.append(self.rx_message.get_nowait())
-                        except asyncio.QueueEmpty:
-                            break
+                event = await self.rx_events.get()
+                # Burst drain: everything already queued is handled in
+                # this wake-up.  Network messages are collected FIRST so
+                # the whole wave's signature checks discharge as ONE
+                # coalesced claim batch (_preverify_burst) — off-loop on
+                # the device backend.  Bounded so a flood cannot starve
+                # the timer.
+                burst: list = []
+                timer_fired = False
+                while True:
+                    kind, payload = event
+                    if kind == EV_MSG:
+                        burst.append(payload)
+                    elif kind == EV_TIMER:
+                        timer_fired = True
+                    # EV_LOOP events are bare wake tokens — the blocks
+                    # live in the priority loopback queue drained below
+                    if len(burst) >= 64:
+                        break
+                    try:
+                        event = self.rx_events.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                # Priority drain of the loopback channel EVERY iteration
+                # (own proposals, sync-resumed orphans): never behind
+                # the network backlog — the reference's select services
+                # this branch on every wake-up.
+                loops: list = []
+                for _ in range(64):
+                    try:
+                        loops.append(self.rx_loopback.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                if burst:
                     preverified = await self._preverify_burst(burst)
                     for idx, message in enumerate(burst):
                         try:
@@ -878,38 +992,30 @@ class Core:
                             )
                         except ConsensusError as e:
                             self.log.warning("%s", e)
-                if loop_task in done:
-                    block = loop_task.result()
-                    loop_task = asyncio.ensure_future(self.rx_loopback.get())
+                for block in loops:
                     try:
                         await self._process_block(block)
                     except ConsensusError as e:
                         self.log.warning("%s", e)
-                    for _ in range(64):
-                        try:
-                            block = self.rx_loopback.get_nowait()
-                        except asyncio.QueueEmpty:
-                            break
-                        try:
-                            await self._process_block(block)
-                        except ConsensusError as e:
-                            self.log.warning("%s", e)
-                if timer_task in done:
-                    timer_task = asyncio.ensure_future(self.timer.wait())
-                    # skip stale fires: a message handled above may have
-                    # advanced the round and reset the deadline after this
-                    # wait completed (Timer.expired docstring)
-                    if self.timer.expired():
-                        try:
-                            await self._local_timeout_round()
-                        except ConsensusError as e:
-                            self.log.warning("%s", e)
+                # Timeout check runs EVERY iteration, not only when the
+                # pump's EV_TIMER event drains: a message flood filling
+                # the merged queue must delay the local timeout by at
+                # most one <=64-message batch (the old select loop's
+                # bound), never by the whole backlog the pump's event
+                # would sit behind.  The pump exists to wake an IDLE
+                # loop; expiry detection does not depend on it.
+                if self.timer.expired():
+                    try:
+                        await self._local_timeout_round()
+                    except ConsensusError as e:
+                        self.log.warning("%s", e)
+                if timer_fired:
+                    self._timer_ack.set()
                 if self.state_changed:
                     await self.persist_state()
                     self.state_changed = False
         finally:
-            for t in (msg_task, loop_task, timer_task):
-                t.cancel()
+            timer_pump.cancel()
 
     def spawn(self) -> asyncio.Task:
         self._task = asyncio.get_running_loop().create_task(

@@ -43,6 +43,38 @@ Round = int
 _MAX_KEYSIG = 96
 
 
+# Precompiled struct layouts for the two hottest wire shapes (per-scheme
+# pk/sig sizes).  When the decoder carries the committee's sizes
+# (wire.decode_message sets them from the scheme), QC and Vote decoding
+# collapses ~10 generic codec calls into one-or-two struct unpacks —
+# byte-identical format, just fewer interpreter frames.  The generic
+# Encoder/Decoder path remains for unpinned decoders (loopback, store
+# deserialize, mixed-size tests).
+def _qc_structs(ps: int, ss: int):
+    key = (ps, ss)
+    cached = _QC_STRUCTS.get(key)
+    if cached is None:
+        cached = (
+            struct.Struct("<32sQI"),
+            struct.Struct(f"<I{ps}sI{ss}s"),
+        )
+        _QC_STRUCTS[key] = cached
+    return cached
+
+
+def _vote_struct(ps: int, ss: int):
+    key = (ps, ss)
+    cached = _VOTE_STRUCTS.get(key)
+    if cached is None:
+        cached = struct.Struct(f"<32sQI{ps}sI{ss}s")
+        _VOTE_STRUCTS[key] = cached
+    return cached
+
+
+_QC_STRUCTS: dict = {}
+_VOTE_STRUCTS: dict = {}
+
+
 def _round_le(r: Round) -> bytes:
     return struct.pack("<Q", r)
 
@@ -242,6 +274,9 @@ class QC:
 
     @classmethod
     def decode(cls, dec: Decoder) -> "QC":
+        ps, ss = dec.pk_size, dec.sig_size
+        if ps is not None and ss is not None:
+            return cls._decode_fast(dec, ps, ss)
         start = dec.mark()
         h = Digest(dec.raw(Digest.SIZE))
         rnd = dec.u64()
@@ -249,6 +284,37 @@ class QC:
         votes = [(decode_pk(dec), decode_sig(dec)) for _ in range(n)]
         qc = cls(hash=h, round=rnd, votes=votes)
         qc._wire = dec.since(start)
+        return qc
+
+    @classmethod
+    def _decode_fast(cls, dec: Decoder, ps: int, ss: int) -> "QC":
+        # struct fast path for a scheme-pinned decoder; byte-identical
+        # wire layout to the generic path above (incl. the per-field
+        # u32 length prefixes), same CodecError semantics
+        head, entry = _qc_structs(ps, ss)
+        data, start = dec._data, dec._pos
+        try:
+            h, rnd, n = head.unpack_from(data, start)
+        except struct.error as e:
+            raise CodecError(f"truncated QC header: {e}") from e
+        pos = start + head.size
+        end = pos + n * entry.size
+        if end > len(data):
+            raise CodecError(
+                f"truncated: QC claims {n} votes past end of input"
+            )
+        votes = []
+        for off in range(pos, end, entry.size):
+            lp, pkb, ls, sgb = entry.unpack_from(data, off)
+            if lp != ps or ls != ss:
+                raise CodecError(
+                    f"key/signature sizes ({lp}/{ls}) do not match the "
+                    f"committee scheme ({ps}/{ss})"
+                )
+            votes.append((PublicKey(pkb), Signature(sgb)))
+        qc = cls(hash=Digest(h), round=rnd, votes=votes)
+        dec._pos = end
+        qc._wire = data[start:end]
         return qc
 
     def __repr__(self) -> str:
@@ -474,7 +540,14 @@ class Block:
         author = decode_pk(dec)
         rnd = dec.u64()
         n = dec.u32()
-        payloads = tuple(Digest(dec.raw(Digest.SIZE)) for _ in range(n))
+        # one bounds-checked read for the whole digest vector (a block
+        # carries up to 512 payload digests — the per-digest raw() call
+        # was the hottest decode loop in the profile)
+        raw = dec.raw(Digest.SIZE * n)
+        payloads = tuple(
+            Digest(raw[i : i + Digest.SIZE])
+            for i in range(0, Digest.SIZE * n, Digest.SIZE)
+        )
         sig = decode_sig(dec)
         block = cls(
             qc=qc, tc=tc, author=author, round=rnd, payloads=payloads, signature=sig
@@ -556,6 +629,29 @@ class Vote:
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Vote":
+        ps, ss = dec.pk_size, dec.sig_size
+        if ps is not None and ss is not None:
+            # struct fast path (scheme-pinned decoder) — same layout and
+            # CodecError semantics as the generic path below
+            s = _vote_struct(ps, ss)
+            try:
+                h, rnd, lp, pkb, ls, sgb = s.unpack_from(
+                    dec._data, dec._pos
+                )
+            except struct.error as e:
+                raise CodecError(f"truncated vote: {e}") from e
+            if lp != ps or ls != ss:
+                raise CodecError(
+                    f"key/signature sizes ({lp}/{ls}) do not match the "
+                    f"committee scheme ({ps}/{ss})"
+                )
+            dec._pos += s.size
+            return cls(
+                hash=Digest(h),
+                round=rnd,
+                author=PublicKey(pkb),
+                signature=Signature(sgb),
+            )
         return cls(
             hash=Digest(dec.raw(Digest.SIZE)),
             round=dec.u64(),

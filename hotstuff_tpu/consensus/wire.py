@@ -10,7 +10,15 @@ from __future__ import annotations
 from ..crypto import Digest, PublicKey
 from ..utils.codec import CodecError, Decoder, Encoder
 from .errors import SerializationError
-from .messages import TC, Block, Timeout, Vote, decode_pk, encode_pk
+from .messages import (
+    TC,
+    Block,
+    Timeout,
+    Vote,
+    _vote_struct,
+    decode_pk,
+    encode_pk,
+)
 
 TAG_PROPOSE = 0
 TAG_VOTE = 1
@@ -38,10 +46,18 @@ def encode_propose(block: Block) -> bytes:
     return _PROPOSE_PREFIX + block.serialize()
 
 
+_VOTE_PREFIX = bytes([TAG_VOTE])
+
+
 def encode_vote(vote: Vote) -> bytes:
-    enc = Encoder().u8(TAG_VOTE)
-    vote.encode(enc)
-    return enc.finish()
+    # packed fast path — identical bytes to Encoder + Vote.encode (the
+    # struct layouts are shared with the decode fast path)
+    pk = vote.author.data
+    sig = vote.signature.data
+    s = _vote_struct(len(pk), len(sig))
+    return _VOTE_PREFIX + s.pack(
+        vote.hash.data, vote.round, len(pk), pk, len(sig), sig
+    )
 
 
 def encode_timeout(timeout: Timeout) -> bytes:

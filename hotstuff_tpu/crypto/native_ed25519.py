@@ -52,35 +52,43 @@ def _load_lib() -> ctypes.CDLL:
     if os.environ.get("HOTSTUFF_ED25519_NATIVE") == "0":
         raise ImportError("native batch verify disabled via env")
     path = os.path.join(_native_dir(), "build", _LIB_NAME)
-    if not os.path.exists(path):
-        try:
-            # build the SPECIFIC target: a compile failure in an
-            # unrelated native TU must not disable this fast path (the
-            # Makefile's mktemp+rename keeps concurrent builders from
-            # exposing a partially-written .so)
-            subprocess.run(
-                ["make", "-C", _native_dir(), f"build/{_LIB_NAME}"],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError) as e:
+    try:
+        # ALWAYS run make for the SPECIFIC target (a no-op when the .so
+        # is current): loading only-if-absent left a stale prebuilt .so
+        # in place across source updates, and a library missing a newly
+        # added symbol crashes at bind time below.  A compile failure in
+        # an unrelated native TU must not disable this fast path (the
+        # Makefile's mktemp+rename keeps concurrent builders from
+        # exposing a partially-written .so).
+        subprocess.run(
+            ["make", "-C", _native_dir(), f"build/{_LIB_NAME}"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        if not os.path.exists(path):
             raise ImportError(f"cannot build {_LIB_NAME}: {e}") from e
+        # no toolchain but a prebuilt .so exists: try it — the symbol
+        # binding below rejects it if it is too old
     try:
         lib = ctypes.CDLL(path)
-    except OSError as e:
-        # corrupt/truncated/ABI-mismatched .so: degrade to the OpenSSL
-        # path instead of letting the load error escape into QC verify
+        lib.hs_ed25519_batch_verify.restype = ctypes.c_int
+        lib.hs_ed25519_batch_verify.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+        ]
+        lib.hs_ed25519_precompute.restype = ctypes.c_int
+        lib.hs_ed25519_precompute.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    except (OSError, AttributeError) as e:
+        # corrupt/truncated/ABI-mismatched/stale .so (AttributeError =
+        # missing symbol): degrade to the OpenSSL path instead of
+        # letting the error escape into QC verify
         raise ImportError(f"cannot load {_LIB_NAME}: {e}") from e
-    lib.hs_ed25519_batch_verify.restype = ctypes.c_int
-    lib.hs_ed25519_batch_verify.argtypes = [
-        ctypes.c_char_p,
-        ctypes.c_uint32,
-        ctypes.c_char_p,
-        ctypes.c_char_p,
-        ctypes.c_uint32,
-        ctypes.c_int,
-    ]
     return lib
 
 
@@ -134,6 +142,21 @@ def batch_verify(
         )
         == 1
     )
+
+
+def precompute(pubkeys: list[bytes]) -> int:
+    """Build the native committee-key tables (epoch setup): each 32-byte
+    key gets its decompressed negated point + Straus window table cached
+    in the C library, so every later batch only pays point work for the
+    per-signature R points.  Returns the number of keys cached (wrong-
+    size or off-curve keys are skipped — they fail at verify time)."""
+    if not available():
+        return 0
+    pks = b"".join(pk for pk in pubkeys if len(pk) == 32)
+    n = len(pks) // 32
+    if n == 0:
+        return 0
+    return int(_lib.hs_ed25519_precompute(pks, n))
 
 
 def batch_verify_shared(msg: bytes, votes) -> bool:

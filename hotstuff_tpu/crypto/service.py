@@ -71,6 +71,14 @@ class CpuVerifier:
         except CryptoError:
             return False
 
+    def precompute(self, pubkeys: list[bytes]) -> None:
+        """Warm the native committee-key tables (node boot / epoch
+        setup) so QC-shaped batches only pay point decompression for
+        the per-signature R points.  No-op without the native lib."""
+        from . import native_ed25519
+
+        native_ed25519.precompute(pubkeys)
+
     def verify_shared_msg(
         self, digest: Digest, votes: list[tuple[PublicKey, Signature]]
     ) -> bool:

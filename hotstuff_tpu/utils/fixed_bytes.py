@@ -27,6 +27,7 @@ class FixedBytes:
     SIZES: frozenset[int] | None = None  # None → exactly {SIZE}
     _VALID: frozenset[int] = frozenset((0,))
     _ZERO = b""
+    _SALT = 0x9E3779B9  # per-class hash salt, set in __init_subclass__
     __slots__ = ("data",)
 
     def __init_subclass__(cls, **kwargs):
@@ -37,6 +38,7 @@ class FixedBytes:
             else frozenset((cls.SIZE,))
         )
         cls._ZERO = b"\x00" * cls.SIZE
+        cls._SALT = hash(cls.__name__)
 
     def __init__(self, data: bytes | None = None):
         if data is None:
@@ -89,7 +91,11 @@ class FixedBytes:
             )
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self.data))
+        # hot path (dict/set keys throughout consensus): xor with a
+        # per-class salt instead of hashing a (name, data) tuple —
+        # same type-disambiguation, no tuple allocation per call
+        # (CPython caches the bytes hash on the object)
+        return hash(self.data) ^ self._SALT
 
     def __bool__(self) -> bool:
         return self.data != b"\x00" * len(self.data)

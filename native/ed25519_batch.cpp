@@ -31,6 +31,10 @@
 
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 #include <sys/random.h>
@@ -532,6 +536,99 @@ static ge ge_msm_straus(const std::vector<sc>& scalars,
   return result;
 }
 
+// Table-based Straus: same walk as ge_msm_straus but over caller-built
+// 15-entry tables (table[d-1] = d*P), so fixed points — committee keys
+// and the basepoint — can reuse PRECOMPUTED tables across calls instead
+// of paying decompression + 15 table adds per verification.  In a
+// QC-shaped batch every A point is a committee key; only the R points
+// are per-signature.
+struct StrausTable {
+  ge t[15];
+};
+
+static ge ge_msm_straus_tables(const std::vector<sc>& scalars,
+                               const std::vector<const StrausTable*>& tables) {
+  ge result = ge_identity();
+  size_t k = scalars.size();
+  for (int w = 63; w >= 0; w--) {
+    if (w != 63)
+      for (int i = 0; i < 4; i++) result = ge_double(result);
+    for (size_t i = 0; i < k; i++) {
+      unsigned d = sc_window(scalars[i], w, 4);
+      if (d) result = ge_add(result, tables[i]->t[d - 1]);
+    }
+  }
+  return result;
+}
+
+static void straus_fill(StrausTable& out, const ge& P) {
+  out.t[0] = P;
+  for (int d = 1; d < 15; d++) out.t[d] = ge_add(out.t[d - 1], P);
+}
+
+// Committee-key table cache: pk bytes -> Straus table of the NEGATED
+// point (the batch equation always subtracts A).  Entries are
+// node-based (unordered_map), so held pointers stay valid across
+// inserts; the map is never cleared (insertion stops at the cap
+// instead) so verify threads can hold entry pointers without a lock.
+struct PkTableEntry {
+  StrausTable neg_table;
+  bool on_curve;
+};
+
+static std::unordered_map<std::string, PkTableEntry> g_pk_tables;
+static std::mutex g_pk_mu;
+
+extern "C" int hs_ed25519_precompute(const uint8_t* pks, uint32_t n) {
+  int ok = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    std::string key(reinterpret_cast<const char*>(pks + 32 * (size_t)i), 32);
+    {
+      std::lock_guard<std::mutex> g(g_pk_mu);
+      if (g_pk_tables.count(key)) {
+        ok++;
+        continue;
+      }
+      if (g_pk_tables.size() >= 4096) break;  // cap: skip, never clear
+    }
+    PkTableEntry e;
+    ge A;
+    e.on_curve = ge_frombytes(A, pks + 32 * (size_t)i);
+    if (e.on_curve) straus_fill(e.neg_table, ge_neg(A));
+    std::lock_guard<std::mutex> g(g_pk_mu);
+    if (g_pk_tables.size() < 4096) {
+      g_pk_tables.emplace(std::move(key), e);
+      if (e.on_curve) ok++;
+    }
+  }
+  return ok;
+}
+
+// nullptr = not cached; otherwise a stable pointer (map is node-based
+// and never cleared) to the cached entry.
+static const PkTableEntry* pk_table_lookup(const uint8_t pk[32]) {
+  std::string key(reinterpret_cast<const char*>(pk), 32);
+  std::lock_guard<std::mutex> g(g_pk_mu);
+  auto it = g_pk_tables.find(key);
+  return it == g_pk_tables.end() ? nullptr : &it->second;
+}
+
+// Static basepoint table (positive B — its scalar coefficient is the
+// only non-negated term in the equation), built once.
+static const StrausTable* basepoint_table() {
+  static StrausTable tbl;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    ge B;
+    B.X = FE_BX;
+    B.Y = FE_BY;
+    B.Z = fe_one();
+    B.T = fe_mul(FE_BX, FE_BY);
+    straus_fill(tbl, B);
+  });
+  return &tbl;
+}
+
 static ge ge_msm(const std::vector<sc>& scalars, const std::vector<ge>& points) {
   size_t k = scalars.size();
   if (k < 200) return ge_msm_straus(scalars, points);
@@ -586,16 +683,21 @@ extern "C" int hs_ed25519_batch_verify(const uint8_t* msgs, uint32_t msg_len,
                                        const uint8_t* pks, const uint8_t* sigs,
                                        uint32_t n, int shared_msg) {
   if (n == 0) return 1;
-  ge B;
-  B.X = FE_BX;
-  B.Y = FE_BY;
-  B.Z = fe_one();
-  B.T = fe_mul(FE_BX, FE_BY);
+  const uint32_t k_expected = 2 * n + 1;
+  // Small batches run the table-based Straus MSM, which lets committee
+  // keys (hs_ed25519_precompute) and the basepoint reuse precomputed
+  // tables; large batches keep the Pippenger path on raw points.
+  const bool small = k_expected < 200;
 
   std::vector<sc> scalars;
-  std::vector<ge> points;
-  scalars.reserve(2 * n + 1);
-  points.reserve(2 * n + 1);
+  std::vector<ge> points;                  // Pippenger path
+  std::vector<const StrausTable*> tables;  // Straus path
+  std::deque<StrausTable> scratch;         // owns per-call tables
+  scalars.reserve(k_expected);
+  if (small)
+    tables.reserve(k_expected);
+  else
+    points.reserve(k_expected);
 
   std::vector<uint8_t> zbytes(16 * (size_t)n);
   if (!fill_random(zbytes.data(), zbytes.size())) return -1;
@@ -606,9 +708,17 @@ extern "C" int hs_ed25519_batch_verify(const uint8_t* msgs, uint32_t msg_len,
     const uint8_t* pk = pks + (size_t)i * 32;
     const uint8_t* msg = shared_msg ? msgs : msgs + (size_t)i * msg_len;
 
-    ge R, A;
+    ge R;
     if (!ge_frombytes(R, sig)) return -1;
-    if (!ge_frombytes(A, pk)) return -1;
+    // A: cached committee-key table when available (skips the point
+    // decompression — an Fq sqrt — and the 15 table adds)
+    const PkTableEntry* cached = small ? pk_table_lookup(pk) : nullptr;
+    ge A;  // set iff !cached — the cached branch only touches neg_table
+    if (cached != nullptr) {
+      if (!cached->on_curve) return -1;
+    } else {
+      if (!ge_frombytes(A, pk)) return -1;
+    }
     sc s;
     if (!sc_frombytes32_canonical(s, sig + 32)) return -1;
 
@@ -626,15 +736,41 @@ extern "C" int hs_ed25519_batch_verify(const uint8_t* msgs, uint32_t msg_len,
     if (sc_iszero(z)) z.v[0] = 1;
 
     b_coeff = sc_add(b_coeff, sc_mul(z, s));
-    scalars.push_back(z);
-    points.push_back(ge_neg(R));
-    scalars.push_back(sc_mul(z, h));
-    points.push_back(ge_neg(A));
+    if (small) {
+      scratch.emplace_back();
+      straus_fill(scratch.back(), ge_neg(R));
+      tables.push_back(&scratch.back());
+      scalars.push_back(z);
+      if (cached != nullptr) {
+        tables.push_back(&cached->neg_table);
+      } else {
+        scratch.emplace_back();
+        straus_fill(scratch.back(), ge_neg(A));
+        tables.push_back(&scratch.back());
+      }
+      scalars.push_back(sc_mul(z, h));
+    } else {
+      scalars.push_back(z);
+      points.push_back(ge_neg(R));
+      scalars.push_back(sc_mul(z, h));
+      points.push_back(ge_neg(A));
+    }
   }
   scalars.push_back(b_coeff);
-  points.push_back(B);
 
-  ge P = ge_msm(scalars, points);
+  ge P;
+  if (small) {
+    tables.push_back(basepoint_table());
+    P = ge_msm_straus_tables(scalars, tables);
+  } else {
+    ge B;
+    B.X = FE_BX;
+    B.Y = FE_BY;
+    B.Z = fe_one();
+    B.T = fe_mul(FE_BX, FE_BY);
+    points.push_back(B);
+    P = ge_msm(scalars, points);
+  }
   // cofactored acceptance: [8]P == O
   P = ge_double(ge_double(ge_double(P)));
   return ge_is_identity(P) ? 1 : 0;

@@ -8,7 +8,7 @@ import asyncio
 from types import SimpleNamespace
 
 from hotstuff_tpu.consensus import Core, ConsensusState, ProposerMessage, Synchronizer
-from hotstuff_tpu.consensus.core import CONSENSUS_STATE_KEY
+from hotstuff_tpu.consensus.core import CONSENSUS_STATE_KEY, make_event_channels
 from hotstuff_tpu.consensus.leader import LeaderElector
 from hotstuff_tpu.consensus.wire import TAG_PROPOSE, TAG_VOTE, encode_timeout, encode_vote
 from hotstuff_tpu.crypto import SignatureService
@@ -32,8 +32,7 @@ def make_core(tmp_path, base, name_idx, timeout_ms=10_000):
     com = committee(base)
     name, secret = keys()[name_idx]
     sig_service = SignatureService(secret)
-    loopback: asyncio.Queue = asyncio.Queue()
-    rx_message: asyncio.Queue = asyncio.Queue()
+    rx_events, rx_message, loopback = make_event_channels(2_000)
     tx_proposer: asyncio.Queue = asyncio.Queue()
     tx_commit: asyncio.Queue = asyncio.Queue()
     sync = Synchronizer(name, com, store, loopback, 10_000)
@@ -46,7 +45,7 @@ def make_core(tmp_path, base, name_idx, timeout_ms=10_000):
         LeaderElector(com),
         sync,
         timeout_ms,
-        rx_message=rx_message,
+        rx_events=rx_events,
         rx_loopback=loopback,
         tx_proposer=tx_proposer,
         tx_commit=tx_commit,
@@ -145,6 +144,85 @@ async def test_local_timeout_broadcasts(tmp_path):
     await asyncio.sleep(0.05)
     h.core.spawn()
     await asyncio.wait_for(asyncio.gather(*listens), timeout=2.0)
+    teardown(h)
+
+
+@async_test
+async def test_local_timeout_fires_under_message_flood(tmp_path):
+    """View-change liveness bound: a flood of cheap protocol messages
+    queued ahead of the timer must delay the local timeout by at most
+    one processing batch — the expiry check runs every loop iteration,
+    not only when the timer pump's event drains through the merged
+    queue (review finding on the r5 select-loop merge)."""
+    base = fresh_base_port()
+    h = make_core(tmp_path, base, name_idx=0, timeout_ms=150)
+    from hotstuff_tpu.consensus import QC
+
+    expected = encode_timeout(signed_timeout(QC.genesis(), 1, h.name, h.secret))
+    listens = [
+        asyncio.ensure_future(listener(base + i, expected)) for i in (1, 2, 3)
+    ]
+    await asyncio.sleep(0.05)
+    # pre-load a deep backlog of far-future votes (free rejections, but
+    # each occupies a queue slot ahead of any timer event)
+    pk, sk = keys()[1]
+    junk = signed_vote(chain(1)[0], pk, sk)
+    junk.round = 10_000
+    for _ in range(1_500):
+        h.rx_message.put_nowait((TAG_VOTE, junk))
+    h.core.spawn()
+    # keep feeding while the timer runs so the queue never drains
+    async def feeder():
+        while True:
+            try:
+                h.rx_message.put_nowait((TAG_VOTE, junk))
+            except asyncio.QueueFull:
+                pass
+            await asyncio.sleep(0.01)
+
+    feed = asyncio.ensure_future(feeder())
+    try:
+        await asyncio.wait_for(asyncio.gather(*listens), timeout=2.0)
+    finally:
+        feed.cancel()
+    teardown(h)
+
+
+@async_test
+async def test_loopback_processed_under_message_flood(tmp_path):
+    """Loopback liveness bound: the node's own/sync-resumed blocks ride
+    a priority channel drained every iteration, never queued behind the
+    network backlog (review finding on the r5 select-loop merge) — a
+    loopback proposal still produces our vote while junk floods the
+    message queue."""
+    base = fresh_base_port()
+    h = make_core(tmp_path, base, name_idx=0, timeout_ms=60_000)
+    b1 = chain(1)[0]
+    expected_vote = signed_vote(b1, h.name, h.secret)
+    listen = asyncio.ensure_future(listener(base + 2, encode_vote(expected_vote)))
+    await asyncio.sleep(0.05)
+
+    pk, sk = keys()[1]
+    junk = signed_vote(b1, pk, sk)
+    junk.round = 10_000
+    for _ in range(1_500):
+        h.rx_message.put_nowait((TAG_VOTE, junk))
+    h.core.spawn()
+
+    async def feeder():
+        while True:
+            try:
+                h.rx_message.put_nowait((TAG_VOTE, junk))
+            except asyncio.QueueFull:
+                pass
+            await asyncio.sleep(0.01)
+
+    feed = asyncio.ensure_future(feeder())
+    try:
+        await h.core.rx_loopback.put(b1)
+        await asyncio.wait_for(listen, timeout=2.0)
+    finally:
+        feed.cancel()
     teardown(h)
 
 
