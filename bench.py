@@ -305,6 +305,30 @@ def bench_sharded(msgs, pks, sigs) -> dict:
     }
 
 
+def probe_weather_ms() -> float:
+    """Median dispatch+fetch of a tiny resident-arg jit call — the
+    tunnel round-trip this run is paying.  Pinned in the output so an
+    end-to-end throughput swing between rounds is attributable to the
+    development tunnel (the dispatch stream is tunnel-bound here; the
+    device_* numbers are slope-measured and weather-independent)."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return (x * 2 + 1).sum()
+
+    x = jax.device_put(np.ones((128, 20), np.int32))
+    np.asarray(f(x))
+    times = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return round(times[len(times) // 2] * 1e3, 2)
+
+
 def main() -> int:
     import jax
 
@@ -327,6 +351,7 @@ def main() -> int:
                 "unit": "sigs/s",
                 "vs_baseline": round(tpu_tput / cpu_tput, 3),
                 "baseline": cpu_provenance,
+                "tunnel_dispatch_p50_ms": probe_weather_ms(),
                 "device_throughput": device_tput,
                 "qc_verify_ms": qc_latency,
                 "tc_verify_ms": tc_latency,

@@ -983,6 +983,16 @@ class Core:
                         loops.append(self.rx_loopback.get_nowait())
                     except asyncio.QueueEmpty:
                         break
+                else:
+                    # capped drain left blocks queued whose wake tokens
+                    # this iteration may already have consumed — re-arm
+                    # one so an otherwise-idle loop cannot strand them
+                    # until the round timer (review finding, r5)
+                    if self.rx_loopback.qsize() > 0:
+                        try:
+                            self.rx_events.put_nowait((EV_LOOP, None))
+                        except asyncio.QueueFull:
+                            pass
                 if burst:
                     preverified = await self._preverify_burst(burst)
                     for idx, message in enumerate(burst):

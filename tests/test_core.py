@@ -189,6 +189,27 @@ async def test_local_timeout_fires_under_message_flood(tmp_path):
 
 
 @async_test
+async def test_loopback_backlog_drains_without_external_wakeups(tmp_path):
+    """>64 loopback blocks queued in one burst exceed the per-iteration
+    drain cap; the re-armed wake token must keep the loop processing
+    them with NO network traffic or timer expiry (review finding: the
+    capped drain could strand the tail until the round timeout)."""
+    base = fresh_base_port()
+    h = make_core(tmp_path, base, name_idx=0, timeout_ms=60_000)
+    b1 = chain(1)[0]
+    h.core.spawn()
+    for _ in range(150):
+        h.core.rx_loopback.put_nowait(b1)
+    deadline = asyncio.get_running_loop().time() + 2.0
+    while h.core.rx_loopback.qsize() > 0:
+        assert asyncio.get_running_loop().time() < deadline, (
+            f"loopback backlog stranded: {h.core.rx_loopback.qsize()} left"
+        )
+        await asyncio.sleep(0.02)
+    teardown(h)
+
+
+@async_test
 async def test_loopback_processed_under_message_flood(tmp_path):
     """Loopback liveness bound: the node's own/sync-resumed blocks ride
     a priority channel drained every iteration, never queued behind the
