@@ -271,7 +271,9 @@ def bench_cpu(msgs, pks, sigs) -> tuple[float, dict]:
                 shared, 32, pkb, sgb, n, shared=True
             )
         )
-        provenance["backend"] = "native-batch-pippenger (dalek parity)"
+        provenance["backend"] = (
+            "native-batch (dalek parity; straus<200<=pippenger)"
+        )
         provenance["batch_sigs_per_s"] = round(batch_rate)
         baseline = max(batch_rate, loop_rate)
     else:
