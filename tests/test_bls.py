@@ -451,3 +451,58 @@ def test_grouped_tc_batch_verification():
     sigs_bad = sigs[:3] + [bytes(bad)] + sigs[4:]
     out = v.verify_many(digests, pks, sigs_bad)
     assert out == [True] * 3 + [False] + [True] * 4
+
+
+def test_native_g1_membership_endomorphism_parity():
+    """The production subgroup check is the GLV-endomorphism test
+    (phi(P) == -[x^2]P); the full r-order ladder stays in the library
+    as the oracle.  Parity over every torsion shape an adversary can
+    reach: raw curve points, cofactor-cleared (in G1), pure-cofactor,
+    mixed, and order-3 components (3 divides the G1 cofactor).  A wrong
+    beta (the other cube root's eigenvalue) or ladder edge case flips
+    one of these."""
+    native = _native_or_skip()
+    import ctypes
+    import hashlib
+
+    lib = native._lib
+    lib.hs_bls_g1_membership.restype = ctypes.c_int
+    lib.hs_bls_g1_membership.argtypes = [ctypes.c_char_p, ctypes.c_int]
+
+    bls_x = -0xD201000000010000
+    h1 = (bls_x - 1) ** 2 // 3
+
+    def ser(pt: G1Point) -> bytes:
+        if pt.inf:
+            return bytes(96)
+        return pt.x.to_bytes(48, "big") + pt.y.to_bytes(48, "big")
+
+    def curve_point(seed: bytes) -> G1Point:
+        counter = 0
+        while True:
+            h = hashlib.sha256(seed + counter.to_bytes(4, "big")).digest()
+            x = int.from_bytes(h + h[:16], "big") % P
+            y2 = (x**3 + 4) % P
+            y = pow(y2, (P + 1) // 4, P)
+            if y * y % P == y2:
+                return G1Point(x, y)
+            counter += 1
+
+    g = G1Point.generator()
+    points = [G1Point.identity(), g, g._mul_raw(12345)]
+    for i in range(3):
+        q = curve_point(bytes([i, 0x7C]) * 16)
+        points += [
+            q,
+            q._mul_raw(h1),  # in G1
+            q._mul_raw(R),  # pure cofactor torsion
+            q._mul_raw(h1) + q._mul_raw(R),  # mixed
+            q._mul_raw(R)._mul_raw(h1 // 3),  # order 1 or 3
+        ]
+    checked = 0
+    for pt in points:
+        fast = lib.hs_bls_g1_membership(ser(pt), 0)
+        slow = lib.hs_bls_g1_membership(ser(pt), 1)
+        assert fast == slow != -1, (pt.inf, fast, slow)
+        checked += 1
+    assert checked == len(points)
