@@ -261,12 +261,24 @@ class Consensus:
             from ..network import ReliableSender, SimpleSender
 
             receiver_cls = NetworkReceiver
+            # Bounded per-sender connection pools for big co-located
+            # committees (set by run-many from its fd budget;
+            # absent/non-positive = reference parity, unbounded)
+            from ..network.pool import parse_max_conns
+
+            max_conns = parse_max_conns(
+                os.environ.get("HOTSTUFF_MAX_PEER_CONNS")
+            )
 
             def make_sender():
-                return SimpleSender(link_delay=link_delay)
+                return SimpleSender(
+                    link_delay=link_delay, max_conns=max_conns
+                )
 
             def make_reliable():
-                return ReliableSender(link_delay=link_delay)
+                return ReliableSender(
+                    link_delay=link_delay, max_conns=max_conns
+                )
         self.receiver = receiver_cls(
             bind_host,
             address[1],

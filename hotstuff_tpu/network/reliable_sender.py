@@ -27,6 +27,7 @@ from collections import deque
 
 from .errors import UnexpectedAckError, classify
 from .framing import FramingError, read_frame, send_frame, set_nodelay
+from .pool import BoundedPoolMixin, abort_writer
 from .wan import LinkScheduler
 
 log = logging.getLogger(__name__)
@@ -45,6 +46,8 @@ class _Connection:
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         # un-ACKed in-flight messages, FIFO-paired with incoming ACKs
         self.pending: deque[tuple[bytes, CancelHandler]] = deque()
+        self._waiting = False  # writer_loop parked on an empty queue
+        self._writer: asyncio.StreamWriter | None = None
         # WAN emulation (network/wan.py): outbound frames wait for their
         # deliver-at time; ACK futures resolve one return-leg later, so
         # the proposer's quorum-ACK back-pressure sees full RTTs.
@@ -59,6 +62,12 @@ class _Connection:
     def deliver_at(self) -> float:
         return 0.0 if self._scheduler is None else self._scheduler.deliver_at()
 
+    @property
+    def idle(self) -> bool:
+        """Nothing queued AND every sent frame ACKed — eviction loses
+        no message and cancels no caller's ACK future."""
+        return self._waiting and self.queue.empty() and not self.pending
+
     async def _run(self) -> None:
         delay = RETRY_DELAY_S
         while True:
@@ -70,6 +79,7 @@ class _Connection:
                 delay = min(delay * 2, RETRY_CAP_S)
                 continue
             set_nodelay(writer)
+            self._writer = writer
             log.debug("Outgoing connection established with %s", self.address)
             delay = RETRY_DELAY_S  # reset on success
             try:
@@ -101,7 +111,11 @@ class _Connection:
 
         async def writer_loop():
             while True:
-                at, data, fut = await self.queue.get()
+                self._waiting = True
+                try:
+                    at, data, fut = await self.queue.get()
+                finally:
+                    self._waiting = False
                 if fut.cancelled():
                     continue
                 # join `pending` BEFORE any await: a connection drop
@@ -158,6 +172,10 @@ class _Connection:
 
     def close(self) -> None:
         self.task.cancel()
+        # release the socket immediately (pool.abort_writer docstring);
+        # eviction only targets fully-ACKed idle connections
+        abort_writer(self._writer)
+        self._writer = None
         # fail every outstanding ACK future so no caller hangs
         while not self.queue.empty():
             _, _, fut = self.queue.get_nowait()
@@ -169,19 +187,27 @@ class _Connection:
         self.pending.clear()
 
 
-class ReliableSender:
-    def __init__(self, link_delay=None):
+class ReliableSender(BoundedPoolMixin):
+    """``max_conns``: bounded connection pool (None = reference parity).
+    Only IDLE connections — empty queue, every frame ACKed — are LRU
+    evicted, so reliability semantics (retransmit, ACK futures) are
+    untouched; a proposer's broadcast may transiently exceed the cap
+    and the pool shrinks back as ACKs drain.  Pool machinery shared
+    with SimpleSender (network/pool.py)."""
+
+    def __init__(self, link_delay=None, max_conns: int | None = None):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
+        self._max_conns = max_conns
+        self._sweeper: asyncio.Task | None = None
 
     def _connection(self, address: Address) -> _Connection:
-        conn = self._connections.get(address)
-        if conn is None or conn.task.done():
-            delay_fn = (
-                self._link_delay(address) if self._link_delay else None
-            )
-            conn = _Connection(address, delay_fn=delay_fn)
-            self._connections[address] = conn
+        conn = self._lru_hit(address)
+        if conn is not None:
+            return conn
+        delay_fn = self._link_delay(address) if self._link_delay else None
+        conn = _Connection(address, delay_fn=delay_fn)
+        self._admit(address, conn)
         return conn
 
     async def send(self, address: Address, data: bytes) -> CancelHandler:
@@ -204,6 +230,4 @@ class ReliableSender:
         return await self.broadcast(picks, data)
 
     def close(self) -> None:
-        for conn in self._connections.values():
-            conn.close()
-        self._connections.clear()
+        self._close_pool()

@@ -15,6 +15,7 @@ import random
 
 from .errors import classify
 from .framing import read_frame, send_frame, set_nodelay
+from .pool import BoundedPoolMixin, abort_writer
 from .wan import LinkScheduler
 
 log = logging.getLogger(__name__)
@@ -38,13 +39,39 @@ class _Connection:
         self._scheduler = (
             None if delay_fn is None else LinkScheduler(delay_fn)
         )
+        self._waiting = False  # parked on an empty queue (see idle)
+        self._writer: asyncio.StreamWriter | None = None
         self.task = asyncio.get_running_loop().create_task(
             self._run(), name=f"simple-conn-{address}"
         )
 
+    @property
+    def idle(self) -> bool:
+        """Nothing queued and nothing in flight — safe to evict without
+        losing a message (best-effort semantics allow losing FUTURE
+        messages on eviction; in-flight ones must still go out).  "In
+        flight" includes the transport write buffer: send_frame returns
+        while bytes may still sit unflushed below the high-water mark,
+        and eviction aborts without flushing."""
+        if not (self._waiting and self.queue.empty()):
+            return False
+        if self._writer is None:
+            return True  # never connected: nothing can be in flight
+        try:
+            return self._writer.transport.get_write_buffer_size() == 0
+        except (RuntimeError, AttributeError):
+            return True  # transport already closed/closing
+
     def put_nowait(self, data: bytes) -> None:
         at = 0.0 if self._scheduler is None else self._scheduler.deliver_at()
         self.queue.put_nowait((at, data))
+
+    async def _next(self):
+        self._waiting = True
+        try:
+            return await self.queue.get()
+        finally:
+            self._waiting = False
 
     async def _wait(self, at: float) -> None:
         if at:
@@ -52,20 +79,21 @@ class _Connection:
 
     async def _run(self) -> None:
         while True:
-            at, data = await self.queue.get()
+            at, data = await self._next()
             try:
                 reader, writer = await asyncio.open_connection(*self.address)
             except OSError as e:
                 log.warning("%s", classify(e, "connect", self.address))
                 continue  # drop this message, wait for the next
             set_nodelay(writer)
+            self._writer = writer
             log.debug("Outgoing connection established with %s", self.address)
             sink = asyncio.get_running_loop().create_task(self._sink_acks(reader))
             try:
                 while True:
                     await self._wait(at)
                     await send_frame(writer, data)
-                    at, data = await self.queue.get()
+                    at, data = await self._next()
             except (ConnectionError, OSError) as e:
                 log.warning("%s", classify(e, "send", self.address))
             finally:
@@ -84,27 +112,40 @@ class _Connection:
 
     def close(self) -> None:
         self.task.cancel()
+        abort_writer(self._writer)
+        self._writer = None
 
 
-class SimpleSender:
+class SimpleSender(BoundedPoolMixin):
     """Fire-and-forget sends; keeps one connection per peer.
 
     ``link_delay``: optional WAN-emulation hook — a callable
     ``(address) -> (() -> float)`` returning the per-link delay sampler
-    (None for an undelayed link)."""
+    (None for an undelayed link).
 
-    def __init__(self, link_delay=None):
+    ``max_conns``: bounded connection pool (None = reference parity:
+    one persistent connection per peer forever).  Big co-located
+    committees need the bound — at 256 nodes every (sender, peer) pair
+    persisting means a single committee-wide timeout broadcast crosses
+    the process fd limit (measured: the 256-node run deterministically
+    wedged at round ~19 as per-round leader/vote connections
+    accumulated to 20k fds).  Eviction is LRU over IDLE connections
+    only, so no queued or in-flight message is ever dropped by the
+    bound."""
+
+    def __init__(self, link_delay=None, max_conns: int | None = None):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
+        self._max_conns = max_conns
+        self._sweeper: asyncio.Task | None = None
 
     def _connection(self, address: Address) -> _Connection:
-        conn = self._connections.get(address)
-        if conn is None or conn.task.done():
-            delay_fn = (
-                self._link_delay(address) if self._link_delay else None
-            )
-            conn = _Connection(address, delay_fn=delay_fn)
-            self._connections[address] = conn
+        conn = self._lru_hit(address)
+        if conn is not None:
+            return conn
+        delay_fn = self._link_delay(address) if self._link_delay else None
+        conn = _Connection(address, delay_fn=delay_fn)
+        self._admit(address, conn)
         return conn
 
     async def send(self, address: Address, data: bytes) -> None:
@@ -115,8 +156,25 @@ class SimpleSender:
             log.warning("Dropping message to %s: channel full", address)
 
     async def broadcast(self, addresses: list[Address], data: bytes) -> None:
-        for addr in addresses:
-            await self.send(addr, data)
+        if self._max_conns is None or len(addresses) <= self._max_conns:
+            for addr in addresses:
+                await self.send(addr, data)
+            return
+        # Bounded pool: pace the fan-out so the working set stays near
+        # the cap — without this, a committee-wide broadcast creates
+        # every connection before the loop can drain ANY of them (send
+        # never yields), busting the pool in one burst.  The wait is
+        # time-bounded; delivery remains best-effort.
+        deadline = asyncio.get_running_loop().time() + 2.0
+        for start in range(0, len(addresses), self._max_conns):
+            for addr in addresses[start : start + self._max_conns]:
+                await self.send(addr, data)
+            while (
+                sum(1 for c in self._connections.values() if not c.idle)
+                > self._max_conns
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.002)
 
     async def lucky_broadcast(
         self, addresses: list[Address], data: bytes, nodes: int
@@ -127,6 +185,4 @@ class SimpleSender:
         await self.broadcast(picks, data)
 
     def close(self) -> None:
-        for conn in self._connections.values():
-            conn.close()
-        self._connections.clear()
+        self._close_pool()

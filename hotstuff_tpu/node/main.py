@@ -115,6 +115,25 @@ async def _run_node(args) -> None:
     await node.analyze_block()
 
 
+def _raise_fd_limit(target: int) -> None:
+    """Best-effort RLIMIT_NOFILE raise to ``target`` (soft AND hard
+    when the process may — root on this rig); silently keeps the
+    current limit when it is already enough or the raise is denied."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft >= target:
+            return
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (target, max(hard, target)))
+        except (ValueError, OSError):
+            # can't raise the hard cap: take everything the soft cap allows
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except (ValueError, OSError, ImportError):
+        pass
+
+
 async def _run_many(args) -> None:
     """Several nodes co-located in ONE process from existing config
     files — the reference's in-process testbed shape (main.rs:102-148)
@@ -128,6 +147,35 @@ async def _run_many(args) -> None:
     # claims into one device dispatch stream, so the device pays off at
     # committee sizes far below the per-node threshold (node.py warmup).
     os.environ["HOTSTUFF_COLOCATED_NODES"] = str(len(key_files))
+    # File-descriptor headroom: n co-located nodes keep one persistent
+    # connection per (sender, peer) pair and BOTH socket endpoints live
+    # in this process, so a committee-wide timeout broadcast opens up to
+    # ~2*n^2 sockets at once (n=256: ~131k — the default 20k limit made
+    # a single view-change storm cascade into accept() EMFILE failures
+    # and a wedged committee).  Best effort: never lowers the limit and
+    # stays inside the hard cap / fs.nr_open.
+    _raise_fd_limit(2 * len(key_files) * len(key_files) + 20_000)
+    # Where the fd limit cannot cover the committee (a capability-
+    # restricted container pins the hard cap), bound the per-sender
+    # connection pools instead: idle-LRU eviction keeps the process
+    # near (n * senders * cap) connections at 2 fds each, at the cost
+    # of reconnects as leadership rotates.  Parity (unbounded) is kept
+    # whenever the fd budget already fits the quadratic worst case.
+    import resource
+
+    n = len(key_files)
+    soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    if n > 1 and soft < 2 * n * n + 10_000:
+        budget_conns = max(1_000, (soft - 4_000) // 2)
+        cap = max(4, budget_conns // (4 * n))
+        os.environ.setdefault("HOTSTUFF_MAX_PEER_CONNS", str(cap))
+        logging.getLogger(__name__).info(
+            "fd budget %d < 2*%d^2: bounding per-sender connection "
+            "pools at %s",
+            soft,
+            n,
+            os.environ["HOTSTUFF_MAX_PEER_CONNS"],
+        )
     nodes = []
     for i, key_file in enumerate(key_files):
         nodes.append(
@@ -142,7 +190,28 @@ async def _run_many(args) -> None:
             )
         )
     _freeze_boot_objects()
-    await asyncio.gather(*(n.analyze_block() for n in nodes))
+
+    async def _fd_probe() -> None:
+        # capacity diagnostics for big co-located committees: one line
+        # every 5 s with the process's live fd count (the 256-node fd
+        # post-mortem needed exactly this and had to guess)
+        plog = logging.getLogger(__name__)
+        while True:
+            try:
+                n_fds = len(os.listdir("/proc/self/fd"))
+            except OSError:
+                return
+            plog.info("fd-probe: %d open fds", n_fds)
+            await asyncio.sleep(5)
+
+    probe = None
+    if len(nodes) >= 64:
+        probe = asyncio.ensure_future(_fd_probe())
+    try:
+        await asyncio.gather(*(n.analyze_block() for n in nodes))
+    finally:
+        if probe is not None:
+            probe.cancel()
 
 
 async def _deploy_testbed(nodes: int, base_port: int, scheme: str) -> None:

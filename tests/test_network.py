@@ -223,3 +223,61 @@ def test_listen_failure_is_typed():
         await a.shutdown()
 
     asyncio.run(body())
+
+
+def test_simple_sender_bounded_pool_evicts_idle():
+    """max_conns bounds the persistent-connection pool: sending to more
+    peers than the cap evicts idle LRU connections (and only idle ones),
+    while every message still arrives (r5: an unbounded pool wedged the
+    256-node in-process committee against the process fd limit)."""
+
+    async def body():
+        base = BASE_PORT + 60
+        n = 5
+        payload = b"bounded"
+        listeners = [
+            asyncio.ensure_future(listener(base + i, payload))
+            for i in range(n)
+        ]
+        await asyncio.sleep(0.05)
+        sender = SimpleSender(max_conns=2)
+        for i in range(n):
+            await sender.send(("127.0.0.1", base + i), payload)
+            await asyncio.sleep(0.05)  # let the connection drain to idle
+        await asyncio.wait_for(asyncio.gather(*listeners), timeout=5)
+        assert len(sender._connections) <= 2
+        sender.close()
+
+    asyncio.run(body())
+
+
+def test_reliable_sender_bounded_pool_keeps_acks():
+    """ReliableSender's bound only evicts fully-ACKed idle connections:
+    a capped broadcast still returns one resolving ACK future per peer."""
+
+    async def body():
+        base = BASE_PORT + 80
+        n = 4
+        payload = b"capped-reliable"
+        listeners = [
+            asyncio.ensure_future(listener(base + i, payload))
+            for i in range(n)
+        ]
+        await asyncio.sleep(0.05)
+        sender = ReliableSender(max_conns=2)
+        handlers = await sender.broadcast(
+            [("127.0.0.1", base + i) for i in range(n)], payload
+        )
+        acks = await asyncio.wait_for(asyncio.gather(*handlers), timeout=5)
+        assert acks == [b"Ack"] * n
+        await asyncio.gather(*listeners)
+        # pool shrinks back to the cap once everything is ACKed
+        for _ in range(50):
+            sender._evict_idle(2)
+            if len(sender._connections) <= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(sender._connections) <= 2
+        sender.close()
+
+    asyncio.run(body())
