@@ -307,6 +307,59 @@ def bench_sharded(msgs, pks, sigs) -> dict:
     }
 
 
+def bench_verify_split(msgs, pks, sigs) -> dict:
+    """Host-dispatch vs device wall split for QC verification, measured
+    through the telemetry counters the async verify service exports
+    (hotstuff_verify_host_wall_seconds / _device_wall_seconds on
+    /metrics): QC-shaped claim waves driven through both the inline host
+    route and the device dispatch route, so the reported split comes
+    from the SAME instruments a production node publishes — not a
+    bench-only stopwatch."""
+    import asyncio
+
+    from hotstuff_tpu import telemetry
+    from hotstuff_tpu.crypto.async_service import AsyncVerifyService
+    from hotstuff_tpu.crypto.service import CpuVerifier
+    from hotstuff_tpu.node.node import LazyDeviceVerifier
+
+    telemetry.enable()
+    qc = 256
+    claim = ("shared", msgs[0], tuple(zip(pks[:qc], sigs[:qc])))
+
+    async def drive() -> dict:
+        host = AsyncVerifyService(CpuVerifier())  # inline host route
+        dev_backend = LazyDeviceVerifier("tpu")
+        dev_backend.precompute(pks)
+        dev_backend.warmup(batch=qc)
+        device = AsyncVerifyService(dev_backend, device=True)
+        try:
+            for _ in range(8):
+                assert (await host.verify_claims([claim])) == [True]
+                assert (await device.verify_claims([claim])) == [True]
+        finally:
+            device.close()
+
+        reg = telemetry.registry()
+
+        def total(name: str) -> float:
+            return sum(i.value for i in reg if i.name == f"hotstuff_{name}")
+
+        return {
+            "qc_size": qc,
+            "host_wall_ms": round(total("verify_host_wall_seconds") * 1e3, 3),
+            "device_wall_ms": round(
+                total("verify_device_wall_seconds") * 1e3, 3
+            ),
+            "device_sigs": device.device_sigs,
+            "cpu_fallback_sigs": device.cpu_sigs,
+            "deadline_misses": device.deadline_misses,
+            "claims_submitted": int(total("verify_claims_submitted")),
+            "claims_unique": int(total("verify_claims_unique")),
+        }
+
+    return asyncio.run(drive())
+
+
 def probe_weather_ms() -> float:
     """Median dispatch+fetch of a tiny resident-arg jit call — the
     tunnel round-trip this run is paying.  Pinned in the output so an
@@ -358,6 +411,7 @@ def main() -> int:
                 "qc_verify_ms": qc_latency,
                 "tc_verify_ms": tc_latency,
                 "sharded_route": sharded,
+                "verify_split": bench_verify_split(msgs, pks, sigs),
             }
         )
     )

@@ -56,6 +56,10 @@ RE_VERIFY_STATS = re.compile(
     r"device_sigs=(\d+) cpu_sigs=(\d+) deadline_misses=(\d+) "
     r"ewma_ms=([\d.]+)"
 )
+# periodic per-node telemetry snapshot (telemetry/exporter.py) — a
+# cumulative JSON document superseding 'Work stats:'; keep the LAST
+# line per node log
+RE_TELEMETRY = re.compile(r"Telemetry snapshot: (\{.*\})")
 
 
 def _ts(s: str) -> float:
@@ -120,6 +124,19 @@ class LogParser:
         self.verify_ewma_ms = (
             max(v[4] for v in per_tag.values()) if per_tag else None
         )
+
+        # telemetry snapshots (cumulative): last document per node log
+        import json as _json
+
+        self.telemetry_docs: list[dict] = []
+        for content in node_logs:
+            matches = RE_TELEMETRY.findall(content)
+            if not matches:
+                continue
+            try:
+                self.telemetry_docs.append(_json.loads(matches[-1]))
+            except ValueError:
+                pass  # truncated log line mid-write
 
         # only blocks whose proposal we saw count toward latency
         self.commits = {
@@ -270,6 +287,7 @@ class LogParser:
             f" View-change timeouts: {self.timeouts}\n"
             f" Client rate warnings: {self.rate_warnings}\n"
             + self._verify_stats_txt()
+            + self._telemetry_breakdown_txt()
             + "-----------------------------------------\n"
         )
 
@@ -291,3 +309,65 @@ class LogParser:
             f" Verify deadline misses: {self.deadline_misses}\n"
             f" Verify dispatch EWMA (worst service): {ewma}\n"
         )
+
+    def _telemetry_breakdown_txt(self) -> str:
+        """Commit-latency breakdown from the per-node telemetry
+        snapshots (only for runs with telemetry enabled): where a
+        committed block's wall time went — the network/aggregation edges
+        of its lifecycle, plus host-dispatch vs device verify wall and
+        event-loop lag as per-commit attribution lines."""
+        docs = self.telemetry_docs
+        if not docs:
+            return ""
+
+        def edge_stats(edge: str):
+            """Count-weighted mean and worst p99 across nodes, or None
+            when no node recorded the edge."""
+            entries = [
+                d.get("trace", {}).get("edges", {}).get(edge, {})
+                for d in docs
+            ]
+            entries = [e for e in entries if e.get("count")]
+            total = sum(e["count"] for e in entries)
+            if not total:
+                return None
+            mean_ms = sum(e["mean_ms"] * e["count"] for e in entries) / total
+            p99_ms = max(e.get("p99_ms", 0.0) for e in entries)
+            return total, mean_ms, p99_ms
+
+        rows = []
+        for edge, label in (
+            ("propose_to_vote", "propose -> first-vote (net + verify)"),
+            ("vote_to_qc", "first-vote -> QC (aggregation)"),
+            ("qc_to_commit", "QC -> commit (2-chain)"),
+            ("propose_to_commit", "propose -> commit (total)"),
+        ):
+            s = edge_stats(edge)
+            if s is not None:
+                count, mean_ms, p99_ms = s
+                rows.append(
+                    f" {label + ':':<40} mean {mean_ms:7.1f} ms"
+                    f"  p99 {p99_ms:7.1f} ms  (n={count})\n"
+                )
+        if not rows:
+            return ""
+        commits = sum(d.get("trace", {}).get("commits", 0) for d in docs)
+        attribution = []
+        host_wall_ms = sum(d.get("verify_wall_ms", 0.0) for d in docs)
+        if commits and host_wall_ms:
+            attribution.append(
+                f"host verify {host_wall_ms / commits:.2f} ms/commit"
+            )
+        if self.verify_ewma_ms is not None:
+            attribution.append(
+                f"device dispatch EWMA {self.verify_ewma_ms:.1f} ms"
+            )
+        lags = [
+            d["loop_lag_mean_ms"] for d in docs if "loop_lag_mean_ms" in d
+        ]
+        if lags:
+            attribution.append(f"loop lag mean {mean(lags):.2f} ms")
+        txt = " + COMMIT LATENCY BREAKDOWN (telemetry):\n" + "".join(rows)
+        if attribution:
+            txt += " Attribution: " + ", ".join(attribution) + "\n"
+        return txt

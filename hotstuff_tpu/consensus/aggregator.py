@@ -251,6 +251,10 @@ class Aggregator:
         # equivocations ahead of the real proposal can't permanently drop
         # honest votes.  Bounded: one vote per author per round.
         self.parked: dict[Round, dict[PublicKey, Vote]] = {}
+        # Cumulative accounting (plain ints, always on — telemetry reads
+        # them through Core's snapshot section when enabled).
+        self.cells_evicted = 0
+        self.votes_parked = 0
 
     def add_vote(
         self,
@@ -292,6 +296,7 @@ class Aggregator:
     def _park(self, vote: Vote) -> None:
         """Remember a verified-but-unplaceable vote (one per author/round)."""
         self.parked.setdefault(vote.round, {}).setdefault(vote.author, vote)
+        self.votes_parked += 1
 
     def _replay_parked(
         self, round_: Round, digest: Digest, maker: QCMaker
@@ -403,6 +408,7 @@ class Aggregator:
         log.warning("Evicting digest cell to admit %s",
                     "own-vote cell" if own else "a verified one")
         del makers[victim]
+        self.cells_evicted += 1
         return True
 
     def add_timeout(
@@ -431,3 +437,21 @@ class Aggregator:
             r: v for r, v in self.cell_payers.items() if r >= round_
         }
         self.parked = {r: v for r, v in self.parked.items() if r >= round_}
+
+    def stats(self) -> dict:
+        """Snapshot of aggregation pressure (telemetry pull section)."""
+        return {
+            "vote_rounds": len(self.votes_aggregators),
+            "vote_cells": sum(
+                len(m) for m in self.votes_aggregators.values()
+            ),
+            "pending_votes": sum(
+                len(maker.votes)
+                for makers in self.votes_aggregators.values()
+                for maker in makers.values()
+            ),
+            "timeout_rounds": len(self.timeouts_aggregators),
+            "parked_votes": sum(len(p) for p in self.parked.values()),
+            "votes_parked_total": self.votes_parked,
+            "cells_evicted_total": self.cells_evicted,
+        }

@@ -117,6 +117,9 @@ class PayloadBodies:
 
 
 class ConsensusReceiverHandler:
+    #: wire tag -> label on the received-message counters (index == tag)
+    TAG_NAMES = ("propose", "vote", "timeout", "tc", "sync_request", "producer")
+
     def __init__(
         self,
         tx_consensus: asyncio.Queue,
@@ -124,6 +127,7 @@ class ConsensusReceiverHandler:
         tx_producer: asyncio.Queue,
         scheme: str | None = None,
         bodies: PayloadBodies | None = None,
+        telemetry=None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
@@ -133,13 +137,34 @@ class ConsensusReceiverHandler:
             raise ValueError(f"unknown committee scheme '{scheme}'")
         self.scheme = scheme
         self.bodies = bodies
+        # Per-tag received counters, built once at boot (telemetry on) so
+        # the dispatch hot path is one tuple index + int add, no lookups.
+        self._msg_counters = None
+        self._dropped = None
+        if telemetry is not None:
+            self._msg_counters = tuple(
+                telemetry.registry.counter(
+                    "net_messages_received",
+                    "Consensus messages received, by wire tag",
+                    {**telemetry.labels, "tag": tag_name},
+                )
+                for tag_name in self.TAG_NAMES
+            )
+            self._dropped = telemetry.counter(
+                "net_messages_dropped",
+                "Received frames dropped (malformed or poisoned payload)",
+            )
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         try:
             tag, payload = decode_message(message, scheme=self.scheme)
         except SerializationError as e:
             log.warning("Dropping malformed message: %s", e)
+            if self._dropped is not None:
+                self._dropped.inc()
             return
+        if self._msg_counters is not None and tag < len(self._msg_counters):
+            self._msg_counters[tag].inc()
         if tag == TAG_SYNC_REQUEST:
             await self.tx_helper.put(payload)
         elif tag == TAG_PROPOSE:
@@ -196,6 +221,7 @@ class Consensus:
         verifier: VerifierBackend | None = None,
         bind_host: str = "0.0.0.0",
         transport: str = "asyncio",
+        telemetry=None,
     ) -> "Consensus":
         self = cls()
         # NOTE: this log entry is used to compute performance.
@@ -208,6 +234,25 @@ class Consensus:
             verifier = CpuVerifier()
 
         payload_bodies = PayloadBodies(store, parameters.payload_body_budget)
+        if telemetry is not None:
+            telemetry.gauge(
+                "payload_pending_bytes",
+                "Uncommitted payload bodies held against the byte budget",
+                fn=lambda b=payload_bodies: b._pending_bytes,
+            )
+            telemetry.gauge(
+                "payload_evictions",
+                "Payload bodies evicted under budget pressure",
+                fn=lambda b=payload_bodies: b.evicted,
+            )
+            telemetry.add_section(
+                "payload_bodies",
+                lambda b=payload_bodies: {
+                    "pending": len(b._pending),
+                    "pending_bytes": b._pending_bytes,
+                    "evicted": b.evicted,
+                },
+            )
         tx_producer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         # The core's three select sources merge into ONE event queue
         # (core.make_event_channels); producers keep channel-shaped
@@ -287,6 +332,7 @@ class Consensus:
                 # mixed-scheme schedules accept the union on the wire
                 scheme=committee.wire_scheme(),
                 bodies=payload_bodies,
+                telemetry=telemetry,
             ),
         )
         await self.receiver.spawn()
@@ -306,6 +352,9 @@ class Consensus:
             parameters.sync_retry_delay,
             network=make_sender(),
         )
+        if telemetry is not None:
+            telemetry.register_store(store)
+            telemetry.register_network("sync", self.synchronizer.network)
 
         self.core = Core(
             name,
@@ -324,6 +373,7 @@ class Consensus:
             tx_commit=tx_commit,
             network=make_sender(),
             payload_bodies=payload_bodies,
+            telemetry=telemetry,
         )
         self._tasks.append(self.core.spawn())
 
@@ -335,6 +385,7 @@ class Consensus:
             rx_message=tx_proposer,
             tx_loopback=tx_loopback,
             network=make_reliable(),
+            telemetry=telemetry,
         )
         self._tasks.append(self.proposer.spawn())
 
@@ -342,6 +393,10 @@ class Consensus:
             committee, store, rx_requests=tx_helper, network=make_sender()
         )
         self._tasks.append(self.helper.spawn())
+        if telemetry is not None:
+            telemetry.register_network("core", self.core.network)
+            telemetry.register_network("proposer", self.proposer.network)
+            telemetry.register_network("helper", self.helper.network)
         return self
 
     async def shutdown(self) -> None:

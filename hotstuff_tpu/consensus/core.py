@@ -280,6 +280,7 @@ class Core:
         timeout_backoff: float = 2.0,
         timeout_cap_ms: int = 60_000,
         payload_bodies=None,
+        telemetry=None,
     ):
         self.name = name
         self.committee = committee
@@ -340,6 +341,26 @@ class Core:
         self._task: asyncio.Task | None = None
         # per-node logger so multi-node (in-process) runs are attributable
         self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
+        # telemetry (telemetry/__init__.py): every hook below is guarded
+        # by `if self._trace is not None` — with telemetry off the hot
+        # path pays one attribute test and nothing else
+        self.telemetry = telemetry
+        self._trace = telemetry.trace if telemetry is not None else None
+        if telemetry is not None:
+            telemetry.gauge(
+                "core_round", "Current consensus round", fn=lambda: self.round
+            )
+            telemetry.gauge(
+                "core_event_queue_depth",
+                "Merged core event queue occupancy",
+                fn=rx_events.qsize,
+            )
+            telemetry.gauge(
+                "core_loopback_depth",
+                "Priority loopback channel occupancy",
+                fn=rx_loopback.qsize,
+            )
+            telemetry.add_section("aggregator", self.aggregator.stats)
 
     # ---- persistence (fork additions, core.rs:76-86, 112-153) --------------
 
@@ -441,6 +462,8 @@ class Core:
         for b in reversed(to_commit):
             await self.tx_commit.put(b)
             committed_payloads.update(b.payloads)
+            if self._trace is not None:
+                self._trace.mark_committed(b.digest().to_bytes(), b.round)
             # NOTE: this log entry is used to compute performance.
             # One info line per block in the chain walk — a DELIBERATE
             # divergence from the reference, which info-logs only the
@@ -494,6 +517,8 @@ class Core:
         if via_tc:
             self._consecutive_tcs += 1
             snap = self._consecutive_tcs == 1
+            if self._trace is not None:
+                self._trace.mark_tc_advance()
         else:
             self._consecutive_tcs = 0
             snap = True
@@ -532,6 +557,8 @@ class Core:
         )
 
     def _process_qc(self, qc: QC) -> None:
+        if self._trace is not None and not qc.is_genesis():
+            self._trace.mark_qc_formed(qc.hash.to_bytes())
         self._advance_round(qc.round)
         self._update_high_qc(qc)
 
@@ -591,6 +618,8 @@ class Core:
 
     async def _local_timeout_round(self) -> None:
         self.log.warning("Timeout reached for round %d", self.round)
+        if self._trace is not None:
+            self._trace.mark_timeout()
         self._increase_last_voted_round(self.round)
         # durable before the Timeout broadcast, same safety argument as
         # in _make_vote
@@ -638,6 +667,8 @@ class Core:
             # the committee is live — timeouts from here on are real
             # liveness signals, not idle pacing (_saw_proposal)
             self._saw_proposal = True
+        if self._trace is not None:
+            self._trace.mark_proposed(block.digest().to_bytes(), block.round)
 
         # b0 <- |qc0; b1| <- |qc1; block|: suspend if ancestors are missing
         # (the synchronizer will re-inject the block via loopback).
@@ -678,6 +709,8 @@ class Core:
         vote = await self._make_vote(block)
         if vote is not None:
             self.log.debug("Created %r", vote)
+            if self._trace is not None:
+                self._trace.mark_first_vote(block.digest().to_bytes())
             next_leader = self.leader_elector.get_leader(self.round + 1)
             if next_leader == self.name:
                 # own vote: we just signed it — no verification needed

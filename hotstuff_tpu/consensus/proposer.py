@@ -69,6 +69,7 @@ class Proposer:
         rx_message: asyncio.Queue,
         tx_loopback: asyncio.Queue,
         network: ReliableSender | None = None,
+        telemetry=None,
     ):
         self.name = name
         self.committee = committee
@@ -102,6 +103,29 @@ class Proposer:
         self.network = network if network is not None else ReliableSender()
         self._task: asyncio.Task | None = None
         self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
+        # Telemetry (optional): payload buffer dwell time + buffer
+        # occupancy.  With telemetry on, `pending` values hold the
+        # arrival timestamp (read at make time); off, they stay None —
+        # no per-payload float allocation.
+        self.telemetry = telemetry
+        self._payload_wait = None
+        self._deferred_makes = None
+        if telemetry is not None:
+            self._payload_wait = telemetry.trace.payload_wait
+            self._deferred_makes = telemetry.counter(
+                "proposer_deferred_makes",
+                "Makes deferred for lack of buffered payloads",
+            )
+            telemetry.gauge(
+                "proposer_pending_payloads",
+                "Payload digests buffered for proposal",
+                fn=lambda: len(self.pending),
+            )
+            telemetry.gauge(
+                "proposer_inflight_proposals",
+                "Own proposals whose commit/orphan fate is undecided",
+                fn=lambda: len(self.inflight),
+            )
 
     def _buffer_payload(self, digest: Digest) -> None:
         if digest in self.seen:
@@ -111,7 +135,12 @@ class Proposer:
         self.seen[digest] = None
         while len(self.seen) > SEEN_CAP:
             self.seen.popitem(last=False)
-        self.pending[digest] = None
+        if self._payload_wait is not None:
+            import time
+
+            self.pending[digest] = time.monotonic()
+        else:
+            self.pending[digest] = None
 
     async def _make_block(
         self, round_: Round, qc: QC, tc: TC | None, allow_empty: bool = False
@@ -123,6 +152,8 @@ class Proposer:
             # wedging the round until the view-change timer (see module
             # docstring).  A newer Make supersedes this one.
             self.deferred = ProposerMessage.make(round_, qc, tc)
+            if self._deferred_makes is not None:
+                self._deferred_makes.inc()
             self.log.info("Round: %d, no payloads yet - proposal deferred", round_)
             return
         # allow_empty: the core signalled that uncommitted payload blocks
@@ -130,9 +161,19 @@ class Proposer:
         # commit now rather than on the producer's next burst.
         self.last_made_round = round_
         take = min(len(self.pending), MAX_BLOCK_PAYLOADS)
-        payloads = tuple(
-            self.pending.popitem(last=False)[0] for _ in range(take)
-        )
+        if self._payload_wait is not None and take:
+            import time
+
+            now = time.monotonic()
+            popped = [self.pending.popitem(last=False) for _ in range(take)]
+            for _, arrived in popped:
+                if arrived:  # re-buffered orphans may carry None
+                    self._payload_wait.observe(now - arrived)
+            payloads = tuple(d for d, _ in popped)
+        else:
+            payloads = tuple(
+                self.pending.popitem(last=False)[0] for _ in range(take)
+            )
         if payloads:
             self.inflight[round_] = payloads
             while len(self.inflight) > MAX_INFLIGHT:

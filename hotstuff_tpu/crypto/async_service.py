@@ -217,6 +217,52 @@ class AsyncVerifyService:
         self.cpu_sigs = 0
         self.deadline_misses = 0
         self._next_stats_log = 0.0
+        # Telemetry instruments (ISSUE 1), labelled by the service tag.
+        # All None when telemetry is off — every hot-path touch below is
+        # guarded on ``_tel_wave`` so the disabled cost is one attribute
+        # test per wave.
+        self._tel_wave = None
+        self._tel_claims_submitted = None
+        self._tel_claims_unique = None
+        self._tel_device_wall = None
+        self._tel_host_wall = None
+        from .. import telemetry
+
+        if telemetry.enabled():
+            reg = telemetry.registry()
+            labels = {"svc": self._stats_tag}
+            self._tel_claims_submitted = reg.counter(
+                "verify_claims_submitted",
+                "Verification claims submitted (pre-dedup, all cores)",
+                labels,
+            )
+            self._tel_claims_unique = reg.counter(
+                "verify_claims_unique",
+                "Unique claims actually evaluated after cross-core dedup",
+                labels,
+            )
+            self._tel_wave = reg.histogram(
+                "verify_wave_sigs",
+                "Signatures per coalesced dispatch wave",
+                labels,
+                bounds=telemetry.SIZE_BOUNDS,
+            )
+            self._tel_device_wall = reg.float_counter(
+                "verify_device_wall_seconds",
+                "Wall seconds spent inside device verify dispatches",
+                labels,
+            )
+            self._tel_host_wall = reg.float_counter(
+                "verify_host_wall_seconds",
+                "Wall seconds spent in host (CPU) claim evaluation",
+                labels,
+            )
+            reg.gauge(
+                "verify_pending_batches",
+                "Submissions queued for the next dispatch wave",
+                labels,
+                fn=lambda: len(self._pending),
+            )
 
     # ---- acquisition -------------------------------------------------------
 
@@ -290,7 +336,18 @@ class AsyncVerifyService:
         if not claims:
             return []
         if not self.device:
-            return eval_claims_sync(self.backend, claims)
+            if self._tel_wave is None:
+                return eval_claims_sync(self.backend, claims)
+            # inline services have no dedup stage: submitted == unique
+            t0 = time.perf_counter()
+            out = eval_claims_sync(self.backend, claims)
+            self._tel_host_wall.add(time.perf_counter() - t0)
+            self._tel_claims_submitted.inc(len(claims))
+            self._tel_claims_unique.inc(len(claims))
+            self._tel_wave.observe(
+                sum(1 if c[0] == "one" else len(c[2]) for c in claims)
+            )
+            return out
 
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -386,6 +443,8 @@ class AsyncVerifyService:
         t0 = time.perf_counter()
         out = eval_claims_sync(target, claims)
         wall = time.perf_counter() - t0
+        if self._tel_device_wall is not None:
+            self._tel_device_wall.add(wall)
         ewma = self._device_ewma_s
         self._device_ewma_s = (
             wall if ewma is None else (1 - _EWMA_ALPHA) * ewma + _EWMA_ALPHA * wall
@@ -421,6 +480,10 @@ class AsyncVerifyService:
                 1 if c[0] == "one" else len(c[2]) for c in claims
             )
             self.dispatches += 1
+            if self._tel_wave is not None:
+                self._tel_claims_submitted.inc(sum(len(cs) for cs, _ in batch))
+                self._tel_claims_unique.inc(len(claims))
+                self._tel_wave.observe(n_sigs)
 
             async def serve_cpu(batch) -> None:
                 # CPU serving holds the GIL either way (measured) — run
@@ -436,7 +499,11 @@ class AsyncVerifyService:
                 for cs, fut in batch:
                     todo = [c for c in cs if c not in memo]
                     if todo:
-                        for c, r in zip(todo, eval_claims_sync(cpu, todo)):
+                        t0 = time.perf_counter()
+                        results = eval_claims_sync(cpu, todo)
+                        if self._tel_host_wall is not None:
+                            self._tel_host_wall.add(time.perf_counter() - t0)
+                        for c, r in zip(todo, results):
                             memo[c] = r
                     if not fut.done():
                         fut.set_result([memo[c] for c in cs])

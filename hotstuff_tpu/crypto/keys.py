@@ -18,10 +18,15 @@ import os
 import struct
 from typing import Iterator
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-)
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pure-Python fallback (ed25519_ref) below
+    HAVE_CRYPTOGRAPHY = False
 
 from ..utils.fixed_bytes import FixedBytes
 
@@ -111,10 +116,15 @@ class SecretKey(WipeableSecret):
 
 
 def _keypair_from_seed(seed32: bytes) -> tuple[PublicKey, SecretKey]:
-    sk = Ed25519PrivateKey.from_private_bytes(seed32)
-    pub = sk.public_key().public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    if HAVE_CRYPTOGRAPHY:
+        sk = Ed25519PrivateKey.from_private_bytes(seed32)
+        pub = sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+    else:
+        from .ed25519_ref import public_from_seed
+
+        pub = public_from_seed(seed32)
     return PublicKey(pub), SecretKey(seed32 + pub)
 
 

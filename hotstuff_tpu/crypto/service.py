@@ -147,11 +147,27 @@ class SignatureService:
 
     def __init__(self, secret: SecretKey):
         self._secret = secret
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PrivateKey,
-        )
+        try:
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PrivateKey,
+            )
 
-        self._key: object | None = Ed25519PrivateKey.from_private_bytes(secret.seed)
+            self._key: object | None = Ed25519PrivateKey.from_private_bytes(
+                secret.seed
+            )
+        except ImportError:  # pure-Python fallback keeps the same surface
+            from .ed25519_ref import sign as _ref_sign
+
+            seed = secret.seed
+
+            class _RefKey:
+                __slots__ = ()
+
+                @staticmethod
+                def sign(msg: bytes) -> bytes:
+                    return _ref_sign(seed, msg)
+
+            self._key = _RefKey()
         self._closed = False
 
     async def request_signature(self, digest: Digest) -> Signature:

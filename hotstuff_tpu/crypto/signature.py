@@ -14,11 +14,19 @@ from __future__ import annotations
 from functools import lru_cache
 from typing import Iterable, Sequence
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pure-Python RFC 8032 fallback (ed25519_ref)
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidSignature(Exception):  # type: ignore[no-redef]
+        pass
 
 from ..utils.fixed_bytes import FixedBytes
 from .digest import Digest
@@ -31,13 +39,40 @@ class CryptoError(Exception):
     """Signature verification / malformed key errors."""
 
 
-@lru_cache(maxsize=4096)
-def _parsed_pk(pk_bytes: bytes) -> Ed25519PublicKey:
-    """Parsed-key cache: EVP_PKEY construction costs roughly as much as
-    the verify itself, and committees reuse a fixed key set — profiled
-    ~2x on the consensus CPU verify path.  Raises ValueError on
-    malformed keys (not cached)."""
-    return Ed25519PublicKey.from_public_bytes(pk_bytes)
+if HAVE_CRYPTOGRAPHY:
+
+    @lru_cache(maxsize=4096)
+    def _parsed_pk(pk_bytes: bytes) -> "Ed25519PublicKey":
+        """Parsed-key cache: EVP_PKEY construction costs roughly as much
+        as the verify itself, and committees reuse a fixed key set —
+        profiled ~2x on the consensus CPU verify path.  Raises ValueError
+        on malformed keys (not cached)."""
+        return Ed25519PublicKey.from_public_bytes(pk_bytes)
+
+else:
+
+    class _RefParsedPk:
+        """ed25519_ref-backed stand-in for a parsed OpenSSL key: same
+        ``verify(sig, msg)`` surface, raising InvalidSignature."""
+
+        __slots__ = ("_pk",)
+
+        def __init__(self, pk_bytes: bytes):
+            from .ed25519_ref import point_decompress
+
+            if len(pk_bytes) != 32 or point_decompress(pk_bytes) is None:
+                raise ValueError("malformed ed25519 public key")
+            self._pk = pk_bytes
+
+        def verify(self, sig: bytes, msg: bytes) -> None:
+            from .ed25519_ref import verify as _ref_verify
+
+            if not _ref_verify(sig, self._pk, msg):
+                raise InvalidSignature("signature mismatch")
+
+    @lru_cache(maxsize=4096)
+    def _parsed_pk(pk_bytes: bytes) -> "_RefParsedPk":  # type: ignore[misc]
+        return _RefParsedPk(pk_bytes)
 
 
 BLS_SIGNATURE_SIZE = 48  # compressed G1 (crypto/bls)
@@ -55,8 +90,12 @@ class Signature(FixedBytes):
 
     @classmethod
     def new(cls, digest: Digest, secret: SecretKey) -> "Signature":
-        sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
-        return cls(sk.sign(digest.to_bytes()))
+        if HAVE_CRYPTOGRAPHY:
+            sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
+            return cls(sk.sign(digest.to_bytes()))
+        from .ed25519_ref import sign as _ref_sign
+
+        return cls(_ref_sign(secret.seed, digest.to_bytes()))
 
     # R / s halves — the reference serializes the signature as two 32-byte
     # parts (crypto/src/lib.rs:186-189); we expose them for the TPU kernel.

@@ -29,6 +29,10 @@ class BoundedPoolMixin:
     _max_conns: int | None
     _sweeper: asyncio.Task | None
 
+    #: idle connections evicted under the bound (telemetry reads this;
+    #: class attr so unevicting senders pay no per-instance slot)
+    pool_evictions = 0
+
     def _lru_hit(self, address) -> object | None:
         """The live connection for ``address`` refreshed to
         most-recently-used, or None if absent/finished."""
@@ -59,6 +63,7 @@ class BoundedPoolMixin:
             elif conn.idle:
                 conn.close()
                 del self._connections[addr]
+                self.pool_evictions += 1
 
     def _ensure_sweeper(self) -> None:
         """Shrink-to-cap sweeper, armed only while the pool exceeds the

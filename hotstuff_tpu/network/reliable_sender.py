@@ -48,6 +48,7 @@ class _Connection:
         self.pending: deque[tuple[bytes, CancelHandler]] = deque()
         self._waiting = False  # writer_loop parked on an empty queue
         self._writer: asyncio.StreamWriter | None = None
+        self.connect_failures = 0
         # WAN emulation (network/wan.py): outbound frames wait for their
         # deliver-at time; ACK futures resolve one return-leg later, so
         # the proposer's quorum-ACK back-pressure sees full RTTs.
@@ -65,7 +66,15 @@ class _Connection:
     @property
     def idle(self) -> bool:
         """Nothing queued AND every sent frame ACKed — eviction loses
-        no message and cancels no caller's ACK future."""
+        no message and cancels no caller's ACK future.
+
+        A connection stuck in connect-retry (``_writer`` unset: never
+        established, or between reconnect attempts) has no writer_loop
+        to park, so ``_waiting`` never becomes True — without the first
+        branch a dead peer would pin its pool slot forever, un-evictable
+        while it backs off toward the 60 s retry cap."""
+        if self._writer is None:
+            return self.queue.empty() and not self.pending
         return self._waiting and self.queue.empty() and not self.pending
 
     async def _run(self) -> None:
@@ -74,6 +83,7 @@ class _Connection:
             try:
                 reader, writer = await asyncio.open_connection(*self.address)
             except OSError as e:
+                self.connect_failures += 1
                 log.debug("%s", classify(e, "connect", self.address))
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, RETRY_CAP_S)
@@ -97,6 +107,7 @@ class _Connection:
                 log.warning("%s", classify(e, op, self.address))
             finally:
                 writer.close()
+                self._writer = None  # disconnected: back to retry state
 
     async def _keep_alive(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

@@ -41,6 +41,7 @@ class _Connection:
         )
         self._waiting = False  # parked on an empty queue (see idle)
         self._writer: asyncio.StreamWriter | None = None
+        self.connect_failures = 0
         self.task = asyncio.get_running_loop().create_task(
             self._run(), name=f"simple-conn-{address}"
         )
@@ -83,6 +84,7 @@ class _Connection:
             try:
                 reader, writer = await asyncio.open_connection(*self.address)
             except OSError as e:
+                self.connect_failures += 1
                 log.warning("%s", classify(e, "connect", self.address))
                 continue  # drop this message, wait for the next
             set_nodelay(writer)
@@ -99,6 +101,7 @@ class _Connection:
             finally:
                 sink.cancel()
                 writer.close()
+                self._writer = None  # disconnected: back to retry state
 
     @staticmethod
     async def _sink_acks(reader: asyncio.StreamReader) -> None:
@@ -133,6 +136,10 @@ class SimpleSender(BoundedPoolMixin):
     only, so no queued or in-flight message is ever dropped by the
     bound."""
 
+    #: broadcast chunks that waited for pool drain (telemetry reads
+    #: this; class attr so unpaced senders pay no per-instance slot)
+    pacing_stalls = 0
+
     def __init__(self, link_delay=None, max_conns: int | None = None):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
@@ -163,18 +170,36 @@ class SimpleSender(BoundedPoolMixin):
         # Bounded pool: pace the fan-out so the working set stays near
         # the cap — without this, a committee-wide broadcast creates
         # every connection before the loop can drain ANY of them (send
-        # never yields), busting the pool in one burst.  The wait is
+        # never yields), busting the pool in one burst.  Each chunk gets
+        # its OWN drain deadline (one shared deadline let the first slow
+        # chunk eat the whole budget and the rest blast out unpaced),
+        # and only THIS broadcast's connections count against the cap —
+        # unrelated busy peers (other traffic on a shared sender) must
+        # not stall a fan-out that is itself under budget.  The wait is
         # time-bounded; delivery remains best-effort.
-        deadline = asyncio.get_running_loop().time() + 2.0
+        loop = asyncio.get_running_loop()
+        sent: list[Address] = []
         for start in range(0, len(addresses), self._max_conns):
-            for addr in addresses[start : start + self._max_conns]:
+            chunk = addresses[start : start + self._max_conns]
+            for addr in chunk:
                 await self.send(addr, data)
+            sent.extend(chunk)
+            deadline = loop.time() + 2.0
+            stalled = False
             while (
-                sum(1 for c in self._connections.values() if not c.idle)
+                sum(
+                    1
+                    for addr in sent
+                    if (c := self._connections.get(addr)) is not None
+                    and not c.idle
+                )
                 > self._max_conns
-                and asyncio.get_running_loop().time() < deadline
+                and loop.time() < deadline
             ):
+                stalled = True
                 await asyncio.sleep(0.002)
+            if stalled:
+                self.pacing_stalls += 1
 
     async def lucky_broadcast(
         self, addresses: list[Address], data: bytes, nodes: int

@@ -87,6 +87,7 @@ def _freeze_boot_objects() -> None:
     permanent objects every pass, which a one-core rig feels directly in
     round latency (measured ~2x consensus-latency cut at 16 nodes)."""
     import gc
+    import os
 
     gc.collect()
     gc.freeze()
@@ -96,13 +97,61 @@ def _freeze_boot_objects() -> None:
     # keep the default cadence (young garbage is the bulk and collects
     # in ~0.15 ms); gen2 runs 50x less often, turning a per-20 s stall
     # into a per-~15 min one.  Cyclic garbage surviving gen1 accumulates
-    # until then — bounded in practice: the actor graph is cycle-light
-    # and the heavy allocators (codec, crypto) produce acyclic objects.
+    # until then — the stretch is paired with a scheduled off-peak full
+    # collection below so the accumulation is bounded by the sweep
+    # period, not by the (now rare) threshold trigger.
+    # HOTSTUFF_GC_GEN2_STRETCH=0 opts out (default thresholds kept) for
+    # workloads whose allocation profile is cycle-heavy.
+    stretch = os.environ.get("HOTSTUFF_GC_GEN2_STRETCH", "1").strip().lower()
+    if stretch in ("", "0", "false", "no", "off"):
+        return
     g0, g1, _ = gc.get_threshold()
     gc.set_threshold(g0, g1, 500)
+    period = float(os.environ.get("HOTSTUFF_GC_GEN2_PERIOD", "300") or 300)
+    if period <= 0:
+        return
+
+    async def _gen2_sweep() -> None:
+        import time
+
+        glog = logging.getLogger(__name__)
+        while True:
+            await asyncio.sleep(period)
+            t0 = time.perf_counter()
+            freed = gc.collect(2)
+            glog.debug(
+                "scheduled gen2 sweep: %d collected in %.1f ms",
+                freed,
+                (time.perf_counter() - t0) * 1e3,
+            )
+
+    asyncio.ensure_future(_gen2_sweep())
+
+
+def _metrics_port(args) -> int | None:
+    """The /metrics port: ``--metrics-port`` first, then the
+    HOTSTUFF_METRICS_PORT env knob; None = endpoint off (default)."""
+    port = getattr(args, "metrics_port", None)
+    if port is not None:
+        return port
+    import os
+
+    env = os.environ.get("HOTSTUFF_METRICS_PORT", "").strip()
+    if not env:
+        return None
+    try:
+        return int(env)
+    except ValueError:
+        log.warning("ignoring non-integer HOTSTUFF_METRICS_PORT=%r", env)
+        return None
 
 
 async def _run_node(args) -> None:
+    from .. import telemetry
+
+    # before Node.new: a configured endpoint force-enables collection,
+    # and the nodes booted below only pick telemetry up at boot
+    await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
         key_file=args.keys,
@@ -125,8 +174,17 @@ def _raise_fd_limit(target: int) -> None:
         soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
         if soft >= target:
             return
+        # Never LOWER the hard cap: RLIM_INFINITY is -1 on Linux, so the
+        # obvious max(hard, target) would replace an unlimited cap with
+        # ``target`` — and for a non-root process that shrink is
+        # irreversible.  Touch the hard cap only when it is finite and
+        # actually below the target.
+        if hard != resource.RLIM_INFINITY and hard < target:
+            new_hard = target
+        else:
+            new_hard = hard
         try:
-            resource.setrlimit(resource.RLIMIT_NOFILE, (target, max(hard, target)))
+            resource.setrlimit(resource.RLIMIT_NOFILE, (target, new_hard))
         except (ValueError, OSError):
             # can't raise the hard cap: take everything the soft cap allows
             resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
@@ -142,6 +200,9 @@ async def _run_many(args) -> None:
     measured path: every actor shares one asyncio loop."""
     import os
 
+    from .. import telemetry
+
+    await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
     # claims into one device dispatch stream, so the device pays off at
@@ -214,10 +275,15 @@ async def _run_many(args) -> None:
             probe.cancel()
 
 
-async def _deploy_testbed(nodes: int, base_port: int, scheme: str) -> None:
+async def _deploy_testbed(
+    nodes: int, base_port: int, scheme: str, metrics_port: int | None = None
+) -> None:
     """In-process local testbed (reference main.rs:102-148): n fresh
     keypairs, committee.json + node_i.json on disk, every node spawned as
     a task in this process, commit channels drained."""
+    from .. import telemetry
+
+    await telemetry.maybe_start_server(metrics_port)
     keys = [Secret.new(scheme) for _ in range(nodes)]
     committee = Committee.new(
         [
@@ -293,6 +359,14 @@ def main(argv=None) -> int:
         default="cpu",
         help="signature verification backend",
     )
+    metrics_help = (
+        "serve Prometheus /metrics on this port and enable telemetry "
+        "(0 = ephemeral port, logged at startup; default: off, or the "
+        "HOTSTUFF_METRICS_PORT env knob)"
+    )
+    p_run.add_argument(
+        "--metrics-port", type=int, default=None, help=metrics_help
+    )
 
     p_many = sub.add_parser(
         "run-many",
@@ -308,12 +382,18 @@ def main(argv=None) -> int:
     p_many.add_argument(
         "--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu"
     )
+    p_many.add_argument(
+        "--metrics-port", type=int, default=None, help=metrics_help
+    )
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
     p_dep.add_argument("--base-port", type=int, default=25_200)
     p_dep.add_argument(
         "--scheme", choices=["ed25519", "bls"], default="ed25519"
+    )
+    p_dep.add_argument(
+        "--metrics-port", type=int, default=None, help=metrics_help
     )
 
     args = parser.parse_args(argv)
@@ -332,7 +412,14 @@ def main(argv=None) -> int:
         asyncio.run(_run_many(args))
         return 0
     if args.command == "deploy":
-        asyncio.run(_deploy_testbed(args.nodes, args.base_port, args.scheme))
+        asyncio.run(
+            _deploy_testbed(
+                args.nodes,
+                args.base_port,
+                args.scheme,
+                metrics_port=_metrics_port(args),
+            )
+        )
         return 0
     return 1
 

@@ -262,18 +262,30 @@ class Node:
             )
             verifier.warmup(batch=wave)
 
+        from .. import telemetry
+
+        tel = telemetry.for_node(str(secret.name)[:8])
         stats_task = None
-        if os.environ.get("HOTSTUFF_WORK_STATS"):
+        probe_running = False
+        if tel is not None or os.environ.get("HOTSTUFF_WORK_STATS"):
             # per-node work accounting for the committee-scaling
             # decomposition (utils/workstats.py): counted verifier +
-            # loop-lag probe, one parseable log line every few seconds
+            # loop-lag probe, one parseable log line every few seconds.
+            # Telemetry reuses the same counted-verifier wrapper; the
+            # snapshot document is a superset of the Work stats one.
             from ..utils.workstats import CountingVerifier, WorkStats, run_probe
 
             stats = WorkStats()
             verifier = CountingVerifier(verifier, stats)
-            stats_task = asyncio.ensure_future(
-                run_probe(stats, logging.getLogger(f"workstats.{secret.name}"))
-            )
+            if os.environ.get("HOTSTUFF_WORK_STATS"):
+                probe_running = True
+                stats_task = asyncio.ensure_future(
+                    run_probe(
+                        stats, logging.getLogger(f"workstats.{secret.name}")
+                    )
+                )
+            if tel is not None:
+                tel.attach_workstats(stats)
 
         self.commit = asyncio.Queue(maxsize=self.CHANNEL_CAPACITY)
         self.consensus = await Consensus.spawn(
@@ -286,8 +298,23 @@ class Node:
             verifier=verifier,
             bind_host=bind_host,
             transport=transport,
+            telemetry=tel,
         )
         self._stats_task = stats_task
+        self._snapshot_task = None
+        if tel is not None:
+            from ..telemetry.exporter import run_snapshot_logger
+
+            # the snapshot logger samples loop lag only when no workstats
+            # probe is doing it already (double-counting would halve the
+            # reported mean)
+            self._snapshot_task = asyncio.ensure_future(
+                run_snapshot_logger(
+                    tel,
+                    logging.getLogger(f"telemetry.{secret.name}"),
+                    sample_lag=not probe_running,
+                )
+            )
         log.info("Node %s successfully booted", secret.name)
         return self
 
@@ -299,9 +326,10 @@ class Node:
             # Here the application would execute the committed payload.
 
     async def shutdown(self) -> None:
-        stats_task = getattr(self, "_stats_task", None)
-        if stats_task is not None:
-            stats_task.cancel()
+        for attr in ("_stats_task", "_snapshot_task"):
+            task = getattr(self, attr, None)
+            if task is not None:
+                task.cancel()
         if self.consensus is not None:
             await self.consensus.shutdown()
         if self.store is not None:

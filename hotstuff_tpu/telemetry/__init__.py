@@ -1,0 +1,280 @@
+"""hotstuff_tpu.telemetry — the permanent attribution layer.
+
+Three pieces (ISSUE 1 tentpole):
+
+1. **Per-round trace recorder** (``trace.py``): timestamps each block's
+   lifecycle edges (proposed -> first-vote -> QC-formed -> committed,
+   plus view-change/timeout edges) into a bounded ring buffer with
+   fixed log-bucket latency histograms.
+2. **Component gauges/counters** (``metrics.py`` instruments): the
+   crypto verify services, the network senders/pools, and the store
+   self-register into one process-wide :class:`Registry`, labelled per
+   node (co-located committees share the process).
+3. **Export** (``exporter.py``): an optional stdlib-only HTTP
+   ``/metrics`` endpoint (Prometheus text format, off by default) plus
+   a periodic ``Telemetry snapshot: {json}`` log line whose document is
+   a superset of the ``Work stats:`` one (the scaling harness's scrape
+   contract is subsumed, not broken).
+
+Enablement: ``HOTSTUFF_TELEMETRY=1``, or setting a metrics port
+(``--metrics-port`` / ``HOTSTUFF_METRICS_PORT`` — a scrape endpoint
+implies collection), or :func:`enable` from code.  Disabled (the
+default), ``for_node`` returns ``None`` and every consensus hook is a
+single ``if tel is not None`` — no per-message allocation, no writes.
+
+Overhead budget when enabled: each lifecycle mark is a dict lookup plus
+scalar stores; each histogram observe is a bisect over a static bound
+tuple plus three scalar updates; gauges are pull-model (evaluated at
+scrape/snapshot time only).  Nothing on the hot path allocates
+per-message; per-*proposal* records (one small list each) are the only
+steady-state allocation and both record maps are bounded.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+from .metrics import (
+    LATENCY_BOUNDS_S,
+    SIZE_BOUNDS,
+    Counter,
+    FloatCounter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from .trace import EDGES, TraceRecorder
+
+_REGISTRY = Registry()
+_NODES: dict[str, "NodeTelemetry"] = {}
+_FORCED = False
+
+
+def registry() -> Registry:
+    """The process-wide instrument registry (what /metrics renders)."""
+    return _REGISTRY
+
+
+def enable() -> None:
+    """Force-enable telemetry for this process (the CLI calls this when
+    a metrics port is configured)."""
+    global _FORCED
+    _FORCED = True
+
+
+def enabled() -> bool:
+    if _FORCED:
+        return True
+    env = os.environ.get("HOTSTUFF_TELEMETRY")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "no", "off")
+    return bool(os.environ.get("HOTSTUFF_METRICS_PORT"))
+
+
+def for_node(name) -> "NodeTelemetry | None":
+    """The node's telemetry handle, or None when telemetry is off —
+    callers guard every hook with ``if tel is not None``."""
+    if not enabled():
+        return None
+    key = str(name)
+    tel = _NODES.get(key)
+    if tel is None:
+        tel = _NODES[key] = NodeTelemetry(key)
+    return tel
+
+
+def snapshot_all() -> dict:
+    """One snapshot document per node in this process (/snapshot)."""
+    return {n: t.snapshot() for n, t in _NODES.items()}
+
+
+def trace_all(n: int = 32) -> dict:
+    """The newest completed per-round trace records per node (/trace)."""
+    return {name: t.trace.recent(n) for name, t in _NODES.items()}
+
+
+def reset() -> None:
+    """Drop all registered instruments and node handles (tests only)."""
+    global _REGISTRY, _FORCED
+    _REGISTRY = Registry()
+    _NODES.clear()
+    _FORCED = False
+
+
+async def maybe_start_server(port: int | None, host: str = "0.0.0.0"):
+    """Start the /metrics endpoint when ``port`` is configured (0 =
+    ephemeral, logged at startup); returns the server or None."""
+    if port is None:
+        return None
+    from .exporter import MetricsServer
+
+    enable()
+    return await MetricsServer(_REGISTRY, host=host, port=port).start()
+
+
+class NodeTelemetry:
+    """Per-node facade over the shared registry: the trace recorder,
+    node-labelled instrument constructors, and the snapshot document.
+
+    Components contribute to the snapshot either through instruments
+    (labelled with this node) or through ``add_section(name, fn)`` —
+    ``fn`` is evaluated at snapshot time (pull model)."""
+
+    def __init__(self, node: str, registry: Registry | None = None):
+        self.node = str(node)
+        self.registry = registry if registry is not None else _REGISTRY
+        self.labels = {"node": self.node}
+        self.trace = TraceRecorder(self.registry, self.labels)
+        self.workstats = None  # utils.workstats.WorkStats, attached by Node
+        self._sections: dict[str, Callable[[], dict]] = {}
+        self._senders: list[tuple[str, object]] = []
+
+    # ---- instrument constructors (node-labelled) -----------------------
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self.registry.counter(name, help_, dict(self.labels))
+
+    def float_counter(self, name: str, help_: str = "") -> FloatCounter:
+        return self.registry.float_counter(name, help_, dict(self.labels))
+
+    def gauge(self, name: str, help_: str = "", fn=None) -> Gauge:
+        return self.registry.gauge(name, help_, dict(self.labels), fn=fn)
+
+    def histogram(
+        self, name: str, help_: str = "", bounds=LATENCY_BOUNDS_S
+    ) -> Histogram:
+        return self.registry.histogram(
+            name, help_, dict(self.labels), bounds=bounds
+        )
+
+    # ---- component registration ----------------------------------------
+
+    def attach_workstats(self, stats) -> None:
+        self.workstats = stats
+
+    def add_section(self, name: str, fn: Callable[[], dict]) -> None:
+        self._sections[name] = fn
+
+    def register_store(self, store) -> None:
+        engine = getattr(store, "engine", None)
+        if engine is not None and hasattr(engine, "__len__"):
+            self.gauge(
+                "store_keys",
+                "Live keys in the node's store engine",
+                fn=lambda e=engine: len(e),
+            )
+
+    def register_network(self, role: str, sender) -> None:
+        """Wire pull gauges over a sender's pool: occupancy, idle-LRU
+        evictions, per-peer retry/backoff state, pacing stalls.  Counts
+        from evicted connections age out with them (live-peer view)."""
+        self._senders.append((role, sender))
+        labels = {**self.labels, "role": role}
+        reg = self.registry
+
+        def conns(s=sender):
+            return getattr(s, "_connections", {}).values()
+
+        reg.gauge(
+            "net_pool_connections",
+            "Live connections in the sender's pool",
+            labels,
+            fn=lambda: len(conns()),
+        )
+        reg.gauge(
+            "net_pool_evictions",
+            "Idle connections LRU-evicted by the pool bound",
+            labels,
+            fn=lambda s=sender: getattr(s, "pool_evictions", 0),
+        )
+        reg.gauge(
+            "net_peers_retrying",
+            "Live peers currently disconnected (connect-retry/backoff)",
+            labels,
+            fn=lambda: sum(
+                1 for c in conns() if getattr(c, "_writer", None) is None
+            ),
+        )
+        reg.gauge(
+            "net_connect_failures",
+            "Connect attempts failed across live connections",
+            labels,
+            fn=lambda: sum(
+                getattr(c, "connect_failures", 0) for c in conns()
+            ),
+        )
+        reg.gauge(
+            "net_queued_messages",
+            "Messages queued across the sender's connections",
+            labels,
+            fn=lambda: sum(c.queue.qsize() for c in conns()),
+        )
+        if hasattr(type(sender), "pacing_stalls"):
+            reg.gauge(
+                "net_broadcast_pacing_stalls",
+                "Bounded-pool broadcast chunks that waited for drain",
+                labels,
+                fn=lambda s=sender: s.pacing_stalls,
+            )
+
+    # ---- snapshot -------------------------------------------------------
+
+    def _net_section(self) -> dict:
+        out = {}
+        for role, s in self._senders:
+            conns = list(getattr(s, "_connections", {}).values())
+            entry = {
+                "conns": len(conns),
+                "queued": sum(c.queue.qsize() for c in conns),
+                "retrying": sum(
+                    1 for c in conns if getattr(c, "_writer", None) is None
+                ),
+                "connect_failures": sum(
+                    getattr(c, "connect_failures", 0) for c in conns
+                ),
+                "evictions": getattr(s, "pool_evictions", 0),
+            }
+            if hasattr(type(s), "pacing_stalls"):
+                entry["pacing_stalls"] = s.pacing_stalls
+            out[role] = entry
+        return out
+
+    def snapshot(self) -> dict:
+        """The ``Telemetry snapshot:`` document.  A strict superset of
+        ``WorkStats.to_json()`` (the ``Work stats:`` scrape contract) —
+        its keys stay at the top level."""
+        doc: dict = {"node": self.node}
+        if self.workstats is not None:
+            doc.update(self.workstats.to_json())
+        doc["trace"] = self.trace.to_json()
+        if self._senders:
+            doc["net"] = self._net_section()
+        for name, fn in self._sections.items():
+            try:
+                doc[name] = fn()
+            except Exception as e:  # noqa: BLE001 — snapshots never throw
+                doc[name] = {"error": str(e)}
+        return doc
+
+
+__all__ = [
+    "Counter",
+    "FloatCounter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "TraceRecorder",
+    "NodeTelemetry",
+    "EDGES",
+    "LATENCY_BOUNDS_S",
+    "SIZE_BOUNDS",
+    "registry",
+    "enable",
+    "enabled",
+    "for_node",
+    "snapshot_all",
+    "trace_all",
+    "reset",
+    "maybe_start_server",
+]

@@ -29,6 +29,19 @@ log = logging.getLogger(__name__)
 LOG_INTERVAL = 5.0
 LAG_INTERVAL = 0.05
 
+# The 'Work stats:' scrape contract: every key WorkStats.to_json emits.
+# The telemetry snapshot document (telemetry/__init__.py, 'Telemetry
+# snapshot:' line) must stay a SUPERSET of these keys at its top level —
+# tests/test_telemetry.py pins both sides to this tuple.
+WORKSTATS_KEYS = (
+    "elapsed_s",
+    "verify_calls",
+    "verify_sigs",
+    "verify_wall_ms",
+    "loop_lag_mean_ms",
+    "loop_lag_max_ms",
+)
+
 
 class WorkStats:
     __slots__ = (
