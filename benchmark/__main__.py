@@ -36,10 +36,25 @@ def task_local(args) -> int:
         wan=args.wan,
         payload_homes=args.payload_homes,
         no_claim_dedup=args.no_claim_dedup,
+        journal=args.journal,
     )
     if args.wait_weather is not None:
         bench.wait_weather(threshold_ms=args.wait_weather)
     parser = bench.run()
+    trace_txt = ""
+    if args.journal:
+        from .traces import TraceSet
+
+        traces = TraceSet.load(PathMaker.journals_path())
+        trace_txt = traces.summary()
+        if traces.blocks:
+            out = traces.export_chrome_trace(PathMaker.trace_file())
+            Print.info(
+                f"Chrome trace written to {out} "
+                "(open in https://ui.perfetto.dev)"
+            )
+        else:
+            Print.warn("journaling was on but no journal records were found")
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
     )
@@ -54,11 +69,26 @@ def task_local(args) -> int:
     if args.wan:
         label += "-wan"
     summary = parser.result(
-        faults=args.faults, nodes=args.nodes, verifier=label
+        faults=args.faults, nodes=args.nodes, verifier=label, extra=trace_txt
     )
     print(summary)
     _save_result(summary, args.faults, args.nodes, args.rate, label,
                  ok=parser.has_window())
+    return 0
+
+
+def task_traces(args) -> int:
+    """Merge flight-recorder journals into the cross-node SUMMARY block
+    and a Chrome trace-event JSON (open in https://ui.perfetto.dev)."""
+    from .traces import TraceSet
+
+    traces = TraceSet.load(args.dir)
+    if not traces.journals:
+        Print.error(f"no journal segments found under {args.dir}")
+        return 1
+    print(traces.summary())
+    out = traces.export_chrome_trace(args.out)
+    Print.info(f"Chrome trace written to {out}")
     return 0
 
 
@@ -262,6 +292,13 @@ def main(argv=None) -> int:
         "adaptive router actually choose the device)",
     )
     p.add_argument(
+        "--journal",
+        action="store_true",
+        help="enable the consensus flight recorder in every node and "
+        "append the cross-node trace reconstruction to the SUMMARY "
+        "(journals under logs/journals/, Chrome trace in logs/trace.json)",
+    )
+    p.add_argument(
         "--no-claim-dedup",
         action="store_true",
         help="give every core a PRIVATE verify service (no cross-core "
@@ -304,6 +341,19 @@ def main(argv=None) -> int:
     p = sub.add_parser("logs")
     p.add_argument("--dir", default=PathMaker.logs_path())
     p.set_defaults(fn=task_logs)
+
+    p = sub.add_parser("traces")
+    p.add_argument(
+        "--dir",
+        default=PathMaker.journals_path(),
+        help="directory holding the per-node journal segments",
+    )
+    p.add_argument(
+        "--out",
+        default=PathMaker.trace_file(),
+        help="where to write the Chrome trace-event JSON",
+    )
+    p.set_defaults(fn=task_traces)
 
     p = sub.add_parser("aggregate")
     p.set_defaults(fn=task_aggregate)

@@ -46,6 +46,7 @@ class LocalBench:
         wan: bool = False,
         payload_homes: int = 1,
         no_claim_dedup: bool = False,
+        journal: bool = False,
     ):
         self.nodes = nodes
         self.rate = rate
@@ -73,6 +74,9 @@ class LocalBench:
         self.transport = transport
         self.base_port = base_port
         self.scheme = scheme
+        # journal=True: flight recorder on in every node (JSONL ring
+        # segments under logs/journals/, merged by benchmark/traces.py)
+        self.journal = journal
         # in_process=True: the whole committee co-locates in ONE node
         # process (`run-many`, the reference's in-process testbed shape,
         # main.rs:102-148).  On a host with fewer cores than nodes the
@@ -148,6 +152,11 @@ class LocalBench:
         )
         if self.no_claim_dedup:
             wan_env["HOTSTUFF_NO_CLAIM_DEDUP"] = "1"
+        if self.journal:
+            wan_env["HOTSTUFF_JOURNAL"] = "1"
+            wan_env["HOTSTUFF_JOURNAL_DIR"] = os.path.abspath(
+                PathMaker.journals_path()
+            )
         proc = subprocess.Popen(
             cmd,
             stdout=f,

@@ -232,8 +232,25 @@ class LogParser:
                 lat.append(self.commits[block] - sent)
         return mean(lat) if lat else None
 
+    def commit_round_gap(self) -> tuple[float, int] | None:
+        """(mean, max) gap between consecutive COMMITTED rounds, or None
+        without >= 2 committed rounds.  A gap of 1 is the steady state;
+        larger gaps count the rounds lost to view changes — the
+        liveness-cost view the storm benches exist to measure."""
+        rounds = sorted(
+            {self.block_round[b] for b in self.commits if b in self.block_round}
+        )
+        if len(rounds) < 2:
+            return None
+        gaps = [b - a for a, b in zip(rounds, rounds[1:])]
+        return mean(gaps), max(gaps)
+
     def result(
-        self, faults: int = 0, nodes: int | None = None, verifier: str = "cpu"
+        self,
+        faults: int = 0,
+        nodes: int | None = None,
+        verifier: str = "cpu",
+        extra: str = "",
     ) -> str:
         c_tps, c_dur = self.consensus_throughput()
         e_tps, _ = self.end_to_end_throughput()
@@ -285,10 +302,22 @@ class LogParser:
             + f" End-to-end latency: {e2e_lat_txt}\n"
             f" Committed blocks: {len(self.commits)}\n"
             f" View-change timeouts: {self.timeouts}\n"
-            f" Client rate warnings: {self.rate_warnings}\n"
+            + self._round_gap_txt()
+            + f" Client rate warnings: {self.rate_warnings}\n"
             + self._verify_stats_txt()
             + self._telemetry_breakdown_txt()
+            + extra
             + "-----------------------------------------\n"
+        )
+
+    def _round_gap_txt(self) -> str:
+        gap = self.commit_round_gap()
+        if gap is None:
+            return ""
+        gap_mean, gap_max = gap
+        return (
+            f" Commit round gap: mean {gap_mean:.2f}, max {gap_max}"
+            " (1.00 = no rounds lost)\n"
         )
 
     def _verify_stats_txt(self) -> str:

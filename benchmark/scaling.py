@@ -34,6 +34,7 @@ from .logs import LogParser
 from .utils import PathMaker, Print
 
 RE_WORKSTATS = re.compile(r"\[(?:[^]]*)\] (workstats\.[^ ]+) Work stats: (\{.*\})")
+RE_TELEMETRY = re.compile(r"Telemetry snapshot: (\{.*\})")
 
 
 def scrape_workstats(logs_dir: str) -> list[dict]:
@@ -48,6 +49,27 @@ def scrape_workstats(logs_dir: str) -> list[dict]:
     return list(latest.values())
 
 
+def scrape_telemetry(logs_dir: str) -> list[dict]:
+    """Last 'Telemetry snapshot' document per node across the node logs.
+    The snapshot is a strict SUPERSET of the Work stats document (the
+    pinned telemetry contract), so callers read the same keys from
+    either — this scraper is preferred, scrape_workstats is the
+    fallback for old logs (ROADMAP follow-up)."""
+    latest: dict[tuple, dict] = {}
+    for path in sorted(glob(os.path.join(logs_dir, "node-*.log"))):
+        with open(path) as f:
+            for line in f:
+                m = RE_TELEMETRY.search(line)
+                if not m:
+                    continue
+                try:
+                    doc = json.loads(m.group(1))
+                except ValueError:
+                    continue  # truncated log line mid-write
+                latest[(path, doc.get("node"))] = doc
+    return list(latest.values())
+
+
 def run_scaling(
     sizes=(4, 8, 16, 32),
     rate: int = 1_000,
@@ -55,6 +77,10 @@ def run_scaling(
     timeout_delay: int = 5_000,
     verifier: str = "cpu",
 ) -> str:
+    # Telemetry snapshots are the preferred work-accounting source (the
+    # superset document); HOTSTUFF_WORK_STATS stays on so the loop-lag
+    # probe runs AND old-style lines exist as the scrape fallback.
+    os.environ["HOTSTUFF_TELEMETRY"] = "1"
     os.environ["HOTSTUFF_WORK_STATS"] = "1"
     rows = []
     try:
@@ -68,7 +94,11 @@ def run_scaling(
                 verifier=verifier,
             )
             parser: LogParser = bench.run()
-            stats = scrape_workstats(PathMaker.logs_path())
+            # prefer the telemetry snapshot document (same keys at top
+            # level); fall back cleanly when only Work stats lines exist
+            stats = scrape_telemetry(PathMaker.logs_path())
+            if not stats:
+                stats = scrape_workstats(PathMaker.logs_path())
             tps, window = parser.consensus_throughput()
             lat_s = parser.consensus_latency()
             payloads = parser.committed_payloads()
@@ -93,6 +123,7 @@ def run_scaling(
                 }
             )
     finally:
+        os.environ.pop("HOTSTUFF_TELEMETRY", None)
         os.environ.pop("HOTSTUFF_WORK_STATS", None)
     return format_report(rows, rate, duration, verifier=verifier)
 

@@ -1,0 +1,487 @@
+"""Cross-node trace reconstruction from flight-recorder journals.
+
+The per-node journals (hotstuff_tpu/telemetry/journal.py) each record one
+node's view of the consensus lifecycle with that node's own clocks.  This
+module merges a run's journals into one committee-wide timeline:
+
+1. **Load** every ``*.jsonl`` ring segment under a journal directory,
+   grouping records by the node named in each segment's meta line.
+2. **Estimate per-node clock offsets** from matched send/recv pairs: a
+   propose journaled at the leader and its recv.propose at a replica (or
+   a vote.send and its recv.vote) give a one-way wall-clock delta per
+   directed node pair.  The MINIMUM delta over a run approximates
+   (min network delay + clock offset); with both directions measured the
+   symmetric estimate ``offset = (d_ab - d_ba) / 2`` cancels the delay
+   (NTP's classic assumption: symmetric minimum paths).  Offsets are
+   propagated from the best-connected reference node by BFS, so a
+   committee is aligned even when some pairs never exchanged messages.
+3. **Reconstruct** every block's cross-node timeline — propose at the
+   leader, receive/vote at each replica, QC formation, commit on every
+   node — using corrected wall clocks for cross-node edges and raw
+   monotonic clocks for same-node edges (immune to wall steps).
+4. **Report**: a SUMMARY block with per-edge committee-wide gaps and
+   straggler attribution (``summary()``), and a Chrome trace-event JSON
+   openable in Perfetto / chrome://tracing (``export_chrome_trace()``):
+   one track per node, one duration slice per block per node, one flow
+   arrow per propose->recv edge, instant markers for timeouts.
+
+Pure stdlib; no dependency on the node runtime (reads JSONL only).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from collections import Counter, defaultdict
+from statistics import mean
+
+#: a block counts as reconstructed when its commit can be attributed —
+#: the propose anchor plus at least one receive edge were journaled
+_SEG_RE = re.compile(r"^(?P<prefix>.+)-(?P<seq>\d{6})\.jsonl$")
+
+
+# ---- loading ---------------------------------------------------------------
+
+
+def load_journals(dir_path: str) -> dict[str, list[dict]]:
+    """node id -> that node's records, merged across ring segments and
+    sorted by monotonic time.  Torn lines (a crash mid-write) are
+    skipped; the node id comes from each segment's meta line (filenames
+    are sanitized and ambiguous)."""
+    by_node: dict[str, list[dict]] = defaultdict(list)
+    paths = sorted(glob.glob(os.path.join(dir_path, "*.jsonl")))
+    for path in paths:
+        node = None
+        records = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn line (crash mid-write)
+                if rec.get("e") == "meta":
+                    node = rec.get("n", node)
+                    continue
+                records.append(rec)
+        if node is None:
+            # segment lost its meta line: fall back to the filename prefix
+            m = _SEG_RE.match(os.path.basename(path))
+            node = m.group("prefix") if m else os.path.basename(path)
+        by_node[node].extend(records)
+    for records in by_node.values():
+        records.sort(key=lambda r: r.get("m", 0))
+    return dict(by_node)
+
+
+# ---- clock-offset estimation ----------------------------------------------
+
+
+def estimate_offsets(
+    journals: dict[str, list[dict]],
+) -> tuple[dict[str, int], str | None]:
+    """(offsets, reference): per-node wall-clock offset in ns relative
+    to the reference node (``corrected = w - offset[node]``).  Nodes
+    with no matched message pair to the connected component keep offset
+    0 (their cross-node edges are then only as good as NTP)."""
+    # send-side indexes: who proposed each digest (and when), and when
+    # each node sent its vote for each digest
+    propose_at: dict[str, tuple[str, int]] = {}
+    vote_sent: dict[tuple[str, str], int] = {}
+    for node, records in journals.items():
+        for r in records:
+            e = r["e"]
+            if e == "propose" and r["d"] not in propose_at:
+                propose_at[r["d"]] = (node, r["w"])
+            elif e == "vote.send":
+                vote_sent.setdefault((r["d"], node), r["w"])
+
+    # minimum observed one-way delta per directed pair (sender, receiver)
+    min_delta: dict[tuple[str, str], int] = {}
+
+    def feed(sender: str, receiver: str, delta: int) -> None:
+        key = (sender, receiver)
+        if key not in min_delta or delta < min_delta[key]:
+            min_delta[key] = delta
+
+    for node, records in journals.items():
+        for r in records:
+            e = r["e"]
+            if e == "recv.propose":
+                src = propose_at.get(r["d"])
+                if src is not None and src[0] != node:
+                    feed(src[0], node, r["w"] - src[1])
+            elif e == "recv.vote":
+                sent = vote_sent.get((r["d"], r["p"]))
+                if sent is not None and r["p"] != node:
+                    feed(r["p"], node, r["w"] - sent)
+
+    # symmetric pairwise offsets where both directions were measured
+    pair_offset: dict[tuple[str, str], float] = {}
+    adjacency: dict[str, set[str]] = defaultdict(set)
+    for (a, b), d_ab in min_delta.items():
+        d_ba = min_delta.get((b, a))
+        if d_ba is None:
+            continue
+        # clock(b) - clock(a), delay cancelled under symmetric minimums
+        pair_offset[(a, b)] = (d_ab - d_ba) / 2.0
+        pair_offset[(b, a)] = (d_ba - d_ab) / 2.0
+        adjacency[a].add(b)
+        adjacency[b].add(a)
+
+    nodes = sorted(journals)
+    if not nodes:
+        return {}, None
+    reference = max(nodes, key=lambda n: (len(adjacency.get(n, ())), n))
+    offsets: dict[str, int] = {n: 0 for n in nodes}
+    seen = {reference}
+    frontier = [reference]
+    while frontier:
+        a = frontier.pop()
+        for b in adjacency.get(a, ()):
+            if b in seen:
+                continue
+            offsets[b] = offsets[a] + int(pair_offset[(a, b)])
+            seen.add(b)
+            frontier.append(b)
+    return offsets, reference
+
+
+# ---- reconstruction --------------------------------------------------------
+
+
+class TraceSet:
+    """A run's merged, clock-aligned committee timeline."""
+
+    def __init__(self, journals: dict[str, list[dict]]):
+        self.journals = journals
+        self.nodes = sorted(journals)
+        self.offsets, self.reference = estimate_offsets(journals)
+        # digest -> timeline; every (m, w) pair below is (node-local
+        # monotonic ns, offset-corrected wall ns)
+        self.blocks: dict[str, dict] = {}
+        # rounds that any node journaled a local timeout for, with the
+        # corrected wall time of the first complaint
+        self.timeouts: dict[int, tuple[str, int]] = {}
+        self._reconstruct()
+
+    @classmethod
+    def load(cls, dir_path: str) -> "TraceSet":
+        return cls(load_journals(dir_path))
+
+    def _corr(self, node: str, w: int) -> int:
+        return w - self.offsets.get(node, 0)
+
+    def _block(self, digest: str, round_: int) -> dict:
+        info = self.blocks.get(digest)
+        if info is None:
+            info = self.blocks[digest] = {
+                "round": round_,
+                "leader": None,
+                "propose": None,  # (m, w_corr) at the leader
+                "recv": {},  # node -> (m, w_corr), first arrival
+                "vote_send": {},  # node -> (m, w_corr)
+                "qc": None,  # (node, m, w_corr), first formation
+                "commit": {},  # node -> (m, w_corr)
+            }
+        elif round_ and not info["round"]:
+            info["round"] = round_
+        return info
+
+    def _reconstruct(self) -> None:
+        for node, records in self.journals.items():
+            for r in records:
+                e = r["e"]
+                if e in ("tc", "round.enter", "recv.timeout", "recv.tc",
+                         "sync.req", "sync.reply", "sync.done",
+                         "recv.sync_req"):
+                    continue
+                if e == "timeout":
+                    rnd = r["r"]
+                    w = self._corr(node, r["w"])
+                    if rnd not in self.timeouts or w < self.timeouts[rnd][1]:
+                        self.timeouts[rnd] = (node, w)
+                    continue
+                stamp = (r["m"], self._corr(node, r["w"]))
+                info = self._block(r["d"], r["r"])
+                if e == "propose":
+                    if info["propose"] is None:
+                        info["leader"] = node
+                        info["propose"] = stamp
+                elif e == "recv.propose":
+                    if node not in info["recv"]:
+                        info["recv"][node] = stamp
+                elif e == "vote.send":
+                    info["vote_send"].setdefault(node, stamp)
+                elif e == "qc":
+                    if info["qc"] is None:
+                        info["qc"] = (node, r["m"], stamp[1])
+                elif e == "commit":
+                    info["commit"].setdefault(node, stamp)
+
+    # ---- derived views -----------------------------------------------------
+
+    def committed(self) -> list[str]:
+        """Digests with at least one commit record, oldest round first."""
+        return sorted(
+            (d for d, i in self.blocks.items() if i["commit"]),
+            key=lambda d: self.blocks[d]["round"],
+        )
+
+    def reconstructed(self) -> list[str]:
+        """Committed digests whose commit can be ATTRIBUTED: the propose
+        anchor and at least one receive edge were journaled."""
+        return [
+            d
+            for d in self.committed()
+            if self.blocks[d]["propose"] is not None
+            and self.blocks[d]["recv"]
+        ]
+
+    def coverage(self) -> float:
+        committed = self.committed()
+        if not committed:
+            return 0.0
+        return len(self.reconstructed()) / len(committed)
+
+    def edge_gaps(self) -> dict:
+        """Committee-wide per-edge statistics (ms floats) over the
+        reconstructed blocks.  Cross-node edges use corrected wall
+        clocks; same-node edges use that node's monotonic clock."""
+        pr: list[float] = []  # propose -> replica recv (cross-node)
+        spread: list[float] = []  # recv spread across replicas, per block
+        rv: list[float] = []  # recv -> vote sent (same node, monotonic)
+        pq: list[float] = []  # propose -> QC formed (cross-node)
+        pc: list[float] = []  # propose -> commit (cross-node, all nodes)
+        cspread: list[float] = []  # commit spread across nodes, per block
+        recv_last: Counter = Counter()  # straggler: last to receive
+        commit_last: Counter = Counter()  # straggler: last to commit
+        for d in self.reconstructed():
+            info = self.blocks[d]
+            _, w0 = info["propose"]
+            recvs = info["recv"]
+            ws = [w for _, w in recvs.values()]
+            pr.extend((w - w0) / 1e6 for w in ws)
+            if len(ws) >= 2:
+                spread.append((max(ws) - min(ws)) / 1e6)
+                recv_last[max(recvs, key=lambda n: recvs[n][1])] += 1
+            for node, (m_v, _) in info["vote_send"].items():
+                got = recvs.get(node)
+                if got is not None:
+                    rv.append((m_v - got[0]) / 1e6)
+            if info["qc"] is not None:
+                pq.append((info["qc"][2] - w0) / 1e6)
+            commits = info["commit"]
+            cws = [w for _, w in commits.values()]
+            pc.extend((w - w0) / 1e6 for w in cws)
+            if len(cws) >= 2:
+                cspread.append((max(cws) - min(cws)) / 1e6)
+                commit_last[max(commits, key=lambda n: commits[n][1])] += 1
+        return {
+            "propose_to_recv": pr,
+            "recv_spread": spread,
+            "recv_to_vote": rv,
+            "propose_to_qc": pq,
+            "propose_to_commit": pc,
+            "commit_spread": cspread,
+            "recv_straggler": recv_last,
+            "commit_straggler": commit_last,
+        }
+
+    # ---- reporting ---------------------------------------------------------
+
+    def summary(self) -> str:
+        """The ``+ CROSS-NODE TRACE`` SUMMARY block (appended to the
+        bench SUMMARY by ``python -m benchmark local --journal``)."""
+        committed = self.committed()
+        if not self.nodes:
+            return ""
+        lines = [" + CROSS-NODE TRACE (flight recorder):\n"]
+        lines.append(
+            f" Nodes journaled: {len(self.nodes)};"
+            f" committed blocks reconstructed:"
+            f" {len(self.reconstructed())}/{len(committed)}"
+            f" ({100.0 * self.coverage():.0f}%)\n"
+        )
+        if self.reference is not None and len(self.nodes) > 1:
+            offs = ", ".join(
+                f"{n} {self.offsets.get(n, 0) / 1e6:+.2f}"
+                for n in self.nodes
+                if n != self.reference
+            )
+            lines.append(
+                f" Clock offsets vs {self.reference} (ms): {offs}\n"
+            )
+        gaps = self.edge_gaps()
+
+        def row(label: str, values: list[float], extra: str = "") -> None:
+            if not values:
+                return
+            lines.append(
+                f" {label + ':':<34} mean {mean(values):7.2f} ms"
+                f"  max {max(values):7.2f} ms{extra}\n"
+            )
+
+        row("propose -> replica recv", gaps["propose_to_recv"])
+        row("recv spread across committee", gaps["recv_spread"])
+        row("recv -> vote sent (local)", gaps["recv_to_vote"])
+        row("propose -> QC formed", gaps["propose_to_qc"])
+        row("propose -> commit (all nodes)", gaps["propose_to_commit"])
+        row("commit spread across committee", gaps["commit_spread"])
+        for counter, label in (
+            (gaps["recv_straggler"], "last to receive"),
+            (gaps["commit_straggler"], "last to commit"),
+        ):
+            if counter:
+                node, hits = counter.most_common(1)[0]
+                total = sum(counter.values())
+                lines.append(
+                    f" Straggler ({label}): {node}"
+                    f" ({100.0 * hits / total:.0f}% of {total} blocks)\n"
+                )
+        if self.timeouts:
+            rounds = sorted(self.timeouts)
+            shown = ", ".join(str(r) for r in rounds[:8])
+            if len(rounds) > 8:
+                shown += ", ..."
+            lines.append(
+                f" Timed-out rounds journaled: {len(rounds)} ({shown})\n"
+            )
+        return "".join(lines)
+
+    # ---- Perfetto export ---------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (the dict; see export_chrome_trace).
+        One track (pid) per node; per block one duration slice per node
+        that saw it (leader: propose->commit, replica: recv->commit)
+        with a flow arrow per propose->recv edge; timeouts as instant
+        markers."""
+        pid_of = {n: i for i, n in enumerate(self.nodes)}
+        events: list[dict] = []
+        for node, pid in pid_of.items():
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"node {node}"},
+                }
+            )
+
+        # everything is expressed in microseconds since the run's first
+        # corrected wall timestamp
+        anchors = [
+            i["propose"][1] for i in self.blocks.values() if i["propose"]
+        ]
+        anchors.extend(w for _, w in self.timeouts.values())
+        if not anchors:
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+        base = min(anchors)
+
+        def us(w_corr: int) -> float:
+            return (w_corr - base) / 1e3
+
+        for digest, info in sorted(
+            self.blocks.items(), key=lambda kv: kv[1]["round"]
+        ):
+            if info["propose"] is None:
+                continue
+            rnd = info["round"]
+            name = f"r{rnd} {digest[:8]}"
+            args = {"round": rnd, "digest": digest}
+            _, w0 = info["propose"]
+            leader = info["leader"]
+            ends = [w for _, w in info["commit"].values()]
+            ends.append(w0)
+            if info["qc"] is not None:
+                ends.append(info["qc"][2])
+            leader_end = info["commit"].get(leader)
+            events.append(
+                {
+                    "name": name,
+                    "cat": "block",
+                    "ph": "X",
+                    "pid": pid_of[leader],
+                    "tid": 0,
+                    "ts": us(w0),
+                    "dur": max(
+                        1.0,
+                        us(leader_end[1] if leader_end else max(ends))
+                        - us(w0),
+                    ),
+                    "args": {**args, "role": "leader"},
+                }
+            )
+            for node, (_, w_recv) in info["recv"].items():
+                end = info["commit"].get(node)
+                vote = info["vote_send"].get(node)
+                w_end = end[1] if end else (vote[1] if vote else w_recv)
+                events.append(
+                    {
+                        "name": name,
+                        "cat": "block",
+                        "ph": "X",
+                        "pid": pid_of[node],
+                        "tid": 0,
+                        "ts": us(w_recv),
+                        "dur": max(1.0, us(w_end) - us(w_recv)),
+                        "args": {**args, "role": "replica"},
+                    }
+                )
+                # one flow arrow per propose->recv edge (flow ids must
+                # be unique per arrow: digest alone would fan out)
+                flow = {"cat": "flow", "name": f"propagate {name}"}
+                events.append(
+                    {
+                        **flow,
+                        "ph": "s",
+                        "id": f"{digest}:{node}",
+                        "pid": pid_of[leader],
+                        "tid": 0,
+                        "ts": us(w0),
+                    }
+                )
+                events.append(
+                    {
+                        **flow,
+                        "ph": "f",
+                        "bp": "e",
+                        "id": f"{digest}:{node}",
+                        "pid": pid_of[node],
+                        "tid": 0,
+                        "ts": us(w_recv),
+                    }
+                )
+        for rnd, (node, w) in sorted(self.timeouts.items()):
+            events.append(
+                {
+                    "name": f"timeout r{rnd}",
+                    "cat": "timeout",
+                    "ph": "i",
+                    "s": "p",
+                    "pid": pid_of[node],
+                    "tid": 0,
+                    "ts": us(w),
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the Chrome trace-event JSON; open in https://ui.perfetto.dev
+        (or chrome://tracing).  Returns ``path``."""
+        doc = self.chrome_trace()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+__all__ = ["load_journals", "estimate_offsets", "TraceSet"]

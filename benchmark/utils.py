@@ -49,6 +49,17 @@ class PathMaker:
         return os.path.join(PathMaker.logs_path(), "client.log")
 
     @staticmethod
+    def journals_path() -> str:
+        """Flight-recorder journal directory for local bench runs (under
+        logs/ so _cleanup_files resets it with everything else)."""
+        return os.path.join(PathMaker.logs_path(), "journals")
+
+    @staticmethod
+    def trace_file() -> str:
+        """The merged Chrome trace-event JSON (open in Perfetto)."""
+        return os.path.join(PathMaker.logs_path(), "trace.json")
+
+    @staticmethod
     def results_path() -> str:
         return os.path.join(PathMaker.base_path(), "results")
 

@@ -36,6 +36,9 @@ from .wire import (
     TAG_PRODUCER,
     TAG_PROPOSE,
     TAG_SYNC_REQUEST,
+    TAG_TC,
+    TAG_TIMEOUT,
+    TAG_VOTE,
     decode_message,
 )
 
@@ -141,6 +144,11 @@ class ConsensusReceiverHandler:
         # the dispatch hot path is one tuple index + int add, no lookups.
         self._msg_counters = None
         self._dropped = None
+        # flight recorder: receive edges are journaled HERE (post-decode)
+        # rather than at the socket, so each record carries the decoded
+        # (round, digest, author) — exactly what the cross-node offset
+        # estimation in benchmark/traces.py matches against send records
+        self._journal = telemetry.journal if telemetry is not None else None
         if telemetry is not None:
             self._msg_counters = tuple(
                 telemetry.registry.counter(
@@ -165,6 +173,33 @@ class ConsensusReceiverHandler:
             return
         if self._msg_counters is not None and tag < len(self._msg_counters):
             self._msg_counters[tag].inc()
+        j = self._journal
+        if j is not None:
+            if tag == TAG_PROPOSE:
+                j.record(
+                    "recv.propose",
+                    payload.round,
+                    payload.digest(),
+                    str(payload.author)[:8],
+                )
+            elif tag == TAG_VOTE:
+                j.record(
+                    "recv.vote",
+                    payload.round,
+                    payload.hash,
+                    str(payload.author)[:8],
+                )
+            elif tag == TAG_TIMEOUT:
+                j.record(
+                    "recv.timeout",
+                    payload.round,
+                    None,
+                    str(payload.author)[:8],
+                )
+            elif tag == TAG_TC:
+                j.record("recv.tc", payload.round)
+            elif tag == TAG_SYNC_REQUEST:
+                j.record("recv.sync_req", 0, payload[0], str(payload[1])[:8])
         if tag == TAG_SYNC_REQUEST:
             await self.tx_helper.put(payload)
         elif tag == TAG_PROPOSE:
@@ -351,10 +386,25 @@ class Consensus:
             tx_loopback,
             parameters.sync_retry_delay,
             network=make_sender(),
+            telemetry=telemetry,
         )
+        # Per-peer network gauges at small committee sizes (ROADMAP
+        # follow-up): bounded label cardinality, and small committees are
+        # where per-peer attribution is readable.  All four senders dial
+        # the same peer set (the broadcast addresses); works for bare
+        # committees and epoch schedules alike (union view).
+        peers = None
+        if telemetry is not None:
+            from .. import telemetry as telemetry_mod
+
+            all_peers = committee.broadcast_addresses(name)
+            if len(all_peers) + 1 <= telemetry_mod.PEER_GAUGE_MAX_COMMITTEE:
+                peers = all_peers
         if telemetry is not None:
             telemetry.register_store(store)
-            telemetry.register_network("sync", self.synchronizer.network)
+            telemetry.register_network(
+                "sync", self.synchronizer.network, peers=peers
+            )
 
         self.core = Core(
             name,
@@ -390,13 +440,21 @@ class Consensus:
         self._tasks.append(self.proposer.spawn())
 
         self.helper = Helper(
-            committee, store, rx_requests=tx_helper, network=make_sender()
+            committee,
+            store,
+            rx_requests=tx_helper,
+            network=make_sender(),
+            telemetry=telemetry,
         )
         self._tasks.append(self.helper.spawn())
         if telemetry is not None:
-            telemetry.register_network("core", self.core.network)
-            telemetry.register_network("proposer", self.proposer.network)
-            telemetry.register_network("helper", self.helper.network)
+            telemetry.register_network("core", self.core.network, peers=peers)
+            telemetry.register_network(
+                "proposer", self.proposer.network, peers=peers
+            )
+            telemetry.register_network(
+                "helper", self.helper.network, peers=peers
+            )
         return self
 
     async def shutdown(self) -> None:

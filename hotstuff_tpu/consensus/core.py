@@ -346,6 +346,9 @@ class Core:
         # path pays one attribute test and nothing else
         self.telemetry = telemetry
         self._trace = telemetry.trace if telemetry is not None else None
+        # flight recorder (telemetry/journal.py): same guard discipline —
+        # journaling off means one attribute test per site and no writes
+        self._journal = telemetry.journal if telemetry is not None else None
         if telemetry is not None:
             telemetry.gauge(
                 "core_round", "Current consensus round", fn=lambda: self.round
@@ -359,6 +362,11 @@ class Core:
                 "core_loopback_depth",
                 "Priority loopback channel occupancy",
                 fn=rx_loopback.qsize,
+            )
+            telemetry.gauge(
+                "core_timer_resets",
+                "Round timer re-arms (rounds entered + backoff restarts)",
+                fn=lambda: self.timer.resets,
             )
             telemetry.add_section("aggregator", self.aggregator.stats)
 
@@ -464,6 +472,8 @@ class Core:
             committed_payloads.update(b.payloads)
             if self._trace is not None:
                 self._trace.mark_committed(b.digest().to_bytes(), b.round)
+            if self._journal is not None:
+                self._journal.record("commit", b.round, b.digest())
             # NOTE: this log entry is used to compute performance.
             # One info line per block in the chain walk — a DELIBERATE
             # divergence from the reference, which info-logs only the
@@ -519,6 +529,11 @@ class Core:
             snap = self._consecutive_tcs == 1
             if self._trace is not None:
                 self._trace.mark_tc_advance()
+            if self._journal is not None:
+                # view change: force-flush so the record survives even if
+                # the node wedges in the new view
+                self._journal.record("tc", round_)
+                self._journal.flush()
         else:
             self._consecutive_tcs = 0
             snap = True
@@ -529,6 +544,8 @@ class Core:
         self.round = round_ + 1
         self._saw_proposal = False
         self.state_changed = True
+        if self._journal is not None:
+            self._journal.record("round.enter", self.round)
         self.log.debug("Moved to round %d", self.round)
         self.aggregator.cleanup(self.round)
         # Tell the proposer the chain moved on, so a make deferred while
@@ -559,6 +576,14 @@ class Core:
     def _process_qc(self, qc: QC) -> None:
         if self._trace is not None and not qc.is_genesis():
             self._trace.mark_qc_formed(qc.hash.to_bytes())
+        # journal only NEW high QCs: every proposal/timeout re-carries
+        # older QCs and re-recording them would swamp the timeline
+        if (
+            self._journal is not None
+            and not qc.is_genesis()
+            and qc.round > self.high_qc.round
+        ):
+            self._journal.record("qc", qc.round, qc.hash)
         self._advance_round(qc.round)
         self._update_high_qc(qc)
 
@@ -620,6 +645,11 @@ class Core:
         self.log.warning("Timeout reached for round %d", self.round)
         if self._trace is not None:
             self._trace.mark_timeout()
+        if self._journal is not None:
+            # timeout: a force-flush point (the whole point of a flight
+            # recorder is surviving the interesting failures)
+            self._journal.record("timeout", self.round)
+            self._journal.flush()
         self._increase_last_voted_round(self.round)
         # durable before the Timeout broadcast, same safety argument as
         # in _make_vote
@@ -712,6 +742,13 @@ class Core:
             if self._trace is not None:
                 self._trace.mark_first_vote(block.digest().to_bytes())
             next_leader = self.leader_elector.get_leader(self.round + 1)
+            if self._journal is not None:
+                self._journal.record(
+                    "vote.send",
+                    block.round,
+                    block.digest(),
+                    str(next_leader)[:8],
+                )
             if next_leader == self.name:
                 # own vote: we just signed it — no verification needed
                 await self._handle_vote(vote, sig_verified=True)

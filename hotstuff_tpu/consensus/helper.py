@@ -28,11 +28,13 @@ class Helper:
         store: Store,
         rx_requests: asyncio.Queue,
         network: SimpleSender | None = None,
+        telemetry=None,
     ):
         self.committee = committee
         self.store = store
         self.rx_requests = rx_requests
         self.network = network if network is not None else SimpleSender()
+        self._journal = telemetry.journal if telemetry is not None else None
         self._task: asyncio.Task | None = None
 
     async def run(self) -> None:
@@ -47,6 +49,10 @@ class Helper:
             data = await self.store.read(digest.to_bytes())
             if data is not None:
                 block = Block.deserialize(data)
+                if self._journal is not None:
+                    self._journal.record(
+                        "sync.reply", block.round, digest, str(origin)[:8]
+                    )
                 await self.network.send(address, encode_propose(block))
 
     def spawn(self) -> asyncio.Task:

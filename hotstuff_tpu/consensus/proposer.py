@@ -110,6 +110,7 @@ class Proposer:
         self.telemetry = telemetry
         self._payload_wait = None
         self._deferred_makes = None
+        self._journal = telemetry.journal if telemetry is not None else None
         if telemetry is not None:
             self._payload_wait = telemetry.trace.payload_wait
             self._deferred_makes = telemetry.counter(
@@ -194,6 +195,11 @@ class Proposer:
             ",".join(str(p) for p in block.payloads),
             block.digest(),
         )
+        if self._journal is not None:
+            # the propose record is the timeline anchor traces.py hangs
+            # every recv.propose edge off — journaled just before the
+            # broadcast leaves this node
+            self._journal.record("propose", block.round, block.digest())
 
         # Broadcast to the union of epochs (committee.broadcast_addresses
         # is the union on a CommitteeSchedule — members of the adjacent

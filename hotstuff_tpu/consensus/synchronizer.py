@@ -38,6 +38,7 @@ class Synchronizer:
         tx_loopback: asyncio.Queue,
         sync_retry_delay_ms: int,
         network: SimpleSender | None = None,
+        telemetry=None,
     ):
         self.name = name
         self.committee = committee
@@ -45,6 +46,7 @@ class Synchronizer:
         self.tx_loopback = tx_loopback
         self.sync_retry_delay = sync_retry_delay_ms / 1000.0
         self.network = network if network is not None else SimpleSender()
+        self._journal = telemetry.journal if telemetry is not None else None
 
         self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
         self._pending: set[Digest] = set()  # child digests being synced
@@ -81,6 +83,8 @@ class Synchronizer:
             return
         self._pending.discard(child.digest())
         self._requests.pop(parent, None)
+        if self._journal is not None:
+            self._journal.record("sync.done", child.round, parent)
         await self.tx_loopback.put(child)
 
     async def _request_parent(self, block: Block) -> None:
@@ -97,6 +101,10 @@ class Synchronizer:
         if parent not in self._requests:
             self.log.debug("Requesting sync for block %s", parent)
             self._requests[parent] = time.monotonic()
+            if self._journal is not None:
+                self._journal.record(
+                    "sync.req", block.round, parent, str(block.author)[:8]
+                )
             address = self.committee.address(block.author)
             if address is not None:
                 await self.network.send(

@@ -11,12 +11,17 @@ across resets.
 from __future__ import annotations
 
 import asyncio
+import time
 
 
 class Timer:
     def __init__(self, duration_ms: int):
         self.duration = duration_ms / 1000.0
         self._deadline: float | None = None
+        # observability (free int stores, read by pull gauges / the
+        # flight recorder): how often the timer re-armed and when
+        self.resets = 0
+        self.armed_at_ns = 0
 
     def set_duration_ms(self, duration_ms: float) -> None:
         """Change the duration used by subsequent resets (the core's
@@ -26,6 +31,8 @@ class Timer:
 
     def reset(self) -> None:
         self._deadline = asyncio.get_running_loop().time() + self.duration
+        self.resets += 1
+        self.armed_at_ns = time.monotonic_ns()
 
     def expired(self) -> bool:
         """Is the *current* deadline in the past? A ``wait()`` that completed

@@ -146,11 +146,23 @@ def _metrics_port(args) -> int | None:
         return None
 
 
+def _apply_journal_dir(args) -> None:
+    """Force-enable the flight recorder when ``--journal-dir`` was given
+    (the env knobs HOTSTUFF_JOURNAL / HOTSTUFF_JOURNAL_DIR work without
+    the flag; off by default)."""
+    jdir = getattr(args, "journal_dir", None)
+    if jdir:
+        from .. import telemetry
+
+        telemetry.set_journal_dir(jdir)
+
+
 async def _run_node(args) -> None:
     from .. import telemetry
 
     # before Node.new: a configured endpoint force-enables collection,
     # and the nodes booted below only pick telemetry up at boot
+    _apply_journal_dir(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -202,6 +214,7 @@ async def _run_many(args) -> None:
 
     from .. import telemetry
 
+    _apply_journal_dir(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -276,13 +289,19 @@ async def _run_many(args) -> None:
 
 
 async def _deploy_testbed(
-    nodes: int, base_port: int, scheme: str, metrics_port: int | None = None
+    nodes: int,
+    base_port: int,
+    scheme: str,
+    metrics_port: int | None = None,
+    journal_dir: str | None = None,
 ) -> None:
     """In-process local testbed (reference main.rs:102-148): n fresh
     keypairs, committee.json + node_i.json on disk, every node spawned as
     a task in this process, commit channels drained."""
     from .. import telemetry
 
+    if journal_dir:
+        telemetry.set_journal_dir(journal_dir)
     await telemetry.maybe_start_server(metrics_port)
     keys = [Secret.new(scheme) for _ in range(nodes)]
     committee = Committee.new(
@@ -367,6 +386,13 @@ def main(argv=None) -> int:
     p_run.add_argument(
         "--metrics-port", type=int, default=None, help=metrics_help
     )
+    journal_help = (
+        "enable the consensus flight recorder and write its JSONL ring "
+        "segments under this directory (default: off, or the "
+        "HOTSTUFF_JOURNAL / HOTSTUFF_JOURNAL_DIR env knobs; merge "
+        "journals with `python -m benchmark traces`)"
+    )
+    p_run.add_argument("--journal-dir", default=None, help=journal_help)
 
     p_many = sub.add_parser(
         "run-many",
@@ -385,6 +411,7 @@ def main(argv=None) -> int:
     p_many.add_argument(
         "--metrics-port", type=int, default=None, help=metrics_help
     )
+    p_many.add_argument("--journal-dir", default=None, help=journal_help)
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
@@ -395,6 +422,7 @@ def main(argv=None) -> int:
     p_dep.add_argument(
         "--metrics-port", type=int, default=None, help=metrics_help
     )
+    p_dep.add_argument("--journal-dir", default=None, help=journal_help)
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
@@ -418,6 +446,7 @@ def main(argv=None) -> int:
                 args.base_port,
                 args.scheme,
                 metrics_port=_metrics_port(args),
+                journal_dir=getattr(args, "journal_dir", None),
             )
         )
         return 0

@@ -265,6 +265,17 @@ class Node:
         from .. import telemetry
 
         tel = telemetry.for_node(str(secret.name)[:8])
+        # Flight recorder (telemetry/journal.py): must attach BEFORE
+        # Consensus.spawn — the consensus actors capture
+        # ``telemetry.journal`` at construction time.
+        self._journal = None
+        jdir = telemetry.journal_dir(store_path)
+        if tel is not None and jdir:
+            from ..telemetry.journal import Journal
+
+            self._journal = Journal(tel.node, jdir)
+            tel.attach_journal(self._journal)
+            log.info("Flight recorder journaling to %s", jdir)
         stats_task = None
         probe_running = False
         if tel is not None or os.environ.get("HOTSTUFF_WORK_STATS"):
@@ -332,5 +343,8 @@ class Node:
                 task.cancel()
         if self.consensus is not None:
             await self.consensus.shutdown()
+        journal = getattr(self, "_journal", None)
+        if journal is not None:
+            journal.close()
         if self.store is not None:
             self.store.close()

@@ -49,6 +49,13 @@ from .trace import EDGES, TraceRecorder
 _REGISTRY = Registry()
 _NODES: dict[str, "NodeTelemetry"] = {}
 _FORCED = False
+_JOURNAL_DIR: str | None = None  # forced via --journal-dir
+
+#: committees at or below this size get per-PEER network gauges
+#: (``net.peer.<name>.*``) in addition to the per-role ones — the label
+#: cardinality is bounded (<= 8 peers x 4 senders) and small committees
+#: are exactly where per-peer attribution is readable
+PEER_GAUGE_MAX_COMMITTEE = 8
 
 
 def registry() -> Registry:
@@ -66,10 +73,49 @@ def enable() -> None:
 def enabled() -> bool:
     if _FORCED:
         return True
+    if journal_enabled():
+        # the flight recorder rides on the NodeTelemetry handle, so
+        # journaling implies collection
+        return True
     env = os.environ.get("HOTSTUFF_TELEMETRY")
     if env is not None:
         return env.strip().lower() not in ("", "0", "false", "no", "off")
     return bool(os.environ.get("HOTSTUFF_METRICS_PORT"))
+
+
+def set_journal_dir(path: str | None) -> None:
+    """Force-enable journaling into ``path`` (the CLI's --journal-dir)."""
+    global _JOURNAL_DIR
+    _JOURNAL_DIR = path
+
+
+def journal_enabled() -> bool:
+    """Is the flight recorder (telemetry/journal.py) on?  Off by
+    default: ``HOTSTUFF_JOURNAL=1``, ``HOTSTUFF_JOURNAL_DIR=<dir>``, or
+    ``--journal-dir`` enable it."""
+    if _JOURNAL_DIR is not None:
+        return True
+    env = os.environ.get("HOTSTUFF_JOURNAL")
+    if env is not None and env.strip().lower() not in (
+        "", "0", "false", "no", "off",
+    ):
+        return True
+    return bool(os.environ.get("HOTSTUFF_JOURNAL_DIR"))
+
+
+def journal_dir(store_path: str) -> str | None:
+    """The journal directory for a node at ``store_path``, or None when
+    journaling is off.  Resolution: --journal-dir, then
+    HOTSTUFF_JOURNAL_DIR, then ``<store_path>.journal`` (the "under the
+    node's store path" default)."""
+    if not journal_enabled():
+        return None
+    if _JOURNAL_DIR is not None:
+        return _JOURNAL_DIR
+    env = os.environ.get("HOTSTUFF_JOURNAL_DIR", "").strip()
+    if env:
+        return env
+    return f"{store_path}.journal"
 
 
 def for_node(name) -> "NodeTelemetry | None":
@@ -96,10 +142,11 @@ def trace_all(n: int = 32) -> dict:
 
 def reset() -> None:
     """Drop all registered instruments and node handles (tests only)."""
-    global _REGISTRY, _FORCED
+    global _REGISTRY, _FORCED, _JOURNAL_DIR
     _REGISTRY = Registry()
     _NODES.clear()
     _FORCED = False
+    _JOURNAL_DIR = None
 
 
 async def maybe_start_server(port: int | None, host: str = "0.0.0.0"):
@@ -127,8 +174,12 @@ class NodeTelemetry:
         self.labels = {"node": self.node}
         self.trace = TraceRecorder(self.registry, self.labels)
         self.workstats = None  # utils.workstats.WorkStats, attached by Node
+        self.journal = None  # telemetry.journal.Journal, attached by Node
         self._sections: dict[str, Callable[[], dict]] = {}
         self._senders: list[tuple[str, object]] = []
+        # peer short-name -> [(sender, address)]: feeds the per-peer
+        # snapshot block at small committee sizes (register_network)
+        self._peer_conns: dict[str, list[tuple[object, object]]] = {}
 
     # ---- instrument constructors (node-labelled) -----------------------
 
@@ -153,6 +204,12 @@ class NodeTelemetry:
     def attach_workstats(self, stats) -> None:
         self.workstats = stats
 
+    def attach_journal(self, journal) -> None:
+        """Attach the node's flight recorder (telemetry/journal.py);
+        consensus actors pick it up as ``telemetry.journal`` at boot."""
+        self.journal = journal
+        self.add_section("journal", journal.stats)
+
     def add_section(self, name: str, fn: Callable[[], dict]) -> None:
         self._sections[name] = fn
 
@@ -165,10 +222,16 @@ class NodeTelemetry:
                 fn=lambda e=engine: len(e),
             )
 
-    def register_network(self, role: str, sender) -> None:
+    def register_network(self, role: str, sender, peers=None) -> None:
         """Wire pull gauges over a sender's pool: occupancy, idle-LRU
         evictions, per-peer retry/backoff state, pacing stalls.  Counts
-        from evicted connections age out with them (live-peer view)."""
+        from evicted connections age out with them (live-peer view).
+
+        ``peers``: optional [(public key, address)] of this sender's
+        live peers — when given (committee size <=
+        PEER_GAUGE_MAX_COMMITTEE, wired by Consensus.spawn), per-PEER
+        gauges are exported under ``net_peer_*`` in /metrics and a
+        ``net.peer.<name>.*`` block appears in the snapshot."""
         self._senders.append((role, sender))
         labels = {**self.labels, "role": role}
         reg = self.registry
@@ -217,6 +280,52 @@ class NodeTelemetry:
                 labels,
                 fn=lambda s=sender: s.pacing_stalls,
             )
+        if peers:
+            for peer_name, address in peers:
+                self._register_peer(role, sender, peer_name, address)
+
+    def _register_peer(self, role: str, sender, peer_name, address) -> None:
+        """Per-peer gauges over one sender's connection to ``address``.
+        The connection is looked up lazily (pull model) — senders create
+        connections on first send, so it may not exist yet."""
+        short = str(peer_name)[:8]
+        labels = {**self.labels, "role": role, "peer": short}
+        reg = self.registry
+
+        def conn(s=sender, a=address):
+            return getattr(s, "_connections", {}).get(a)
+
+        def queued():
+            c = conn()
+            return c.queue.qsize() if c is not None else 0
+
+        def retrying():
+            c = conn()
+            return int(c is not None and getattr(c, "_writer", None) is None)
+
+        def failures():
+            c = conn()
+            return getattr(c, "connect_failures", 0) if c is not None else 0
+
+        reg.gauge(
+            "net_peer_queued",
+            "Messages queued toward this peer",
+            labels,
+            fn=queued,
+        )
+        reg.gauge(
+            "net_peer_retrying",
+            "1 while this peer is disconnected (connect-retry/backoff)",
+            labels,
+            fn=retrying,
+        )
+        reg.gauge(
+            "net_peer_connect_failures",
+            "Connect attempts failed toward this peer",
+            labels,
+            fn=failures,
+        )
+        self._peer_conns.setdefault(short, []).append((sender, address))
 
     # ---- snapshot -------------------------------------------------------
 
@@ -238,6 +347,26 @@ class NodeTelemetry:
             if hasattr(type(s), "pacing_stalls"):
                 entry["pacing_stalls"] = s.pacing_stalls
             out[role] = entry
+        if self._peer_conns:
+            peer_out = {}
+            for short, conns in self._peer_conns.items():
+                queued = failures = retrying = 0
+                for sender, address in conns:
+                    c = getattr(sender, "_connections", {}).get(address)
+                    if c is None:
+                        continue
+                    queued += c.queue.qsize()
+                    failures += getattr(c, "connect_failures", 0)
+                    retrying = max(
+                        retrying,
+                        int(getattr(c, "_writer", None) is None),
+                    )
+                peer_out[short] = {
+                    "queued": queued,
+                    "retrying": retrying,
+                    "connect_failures": failures,
+                }
+            out["peer"] = peer_out
         return out
 
     def snapshot(self) -> dict:
@@ -269,9 +398,13 @@ __all__ = [
     "EDGES",
     "LATENCY_BOUNDS_S",
     "SIZE_BOUNDS",
+    "PEER_GAUGE_MAX_COMMITTEE",
     "registry",
     "enable",
     "enabled",
+    "set_journal_dir",
+    "journal_enabled",
+    "journal_dir",
     "for_node",
     "snapshot_all",
     "trace_all",

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# One-command flight-recorder run: journal-enabled local bench, merged
+# cross-node trace in the SUMMARY, Chrome trace JSON for Perfetto.
+#
+#   scripts/trace.sh                         # 4 nodes, 500 tx/s, 10 s
+#   scripts/trace.sh --nodes 8 --rate 1000   # extra args pass through
+#
+# Output: logs/journals/ (per-node JSONL ring segments) and
+# logs/trace.json — open the latter at https://ui.perfetto.dev.
+# Timeout-bounded so a hung committee cannot wedge a CI job.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+exec timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m benchmark local \
+    --nodes 4 --rate 500 --duration 10 --journal "$@"
