@@ -77,6 +77,59 @@ def task_local(args) -> int:
     return 0
 
 
+def task_chaos(args) -> int:
+    """One committee run under a seeded fault scenario, with the
+    committee-wide safety/liveness invariant verdict appended to the
+    SUMMARY as a CHAOS block.  Exit code 1 when an invariant fails."""
+    import json
+
+    from .chaos import ChaosBench
+
+    spec = None
+    if args.spec:
+        with open(args.spec) as f:
+            spec = json.load(f)
+    bench = ChaosBench(
+        scenario=args.scenario,
+        seed=args.seed,
+        nodes=args.nodes,
+        rate=args.rate,
+        duration=args.duration,
+        timeout_delay=args.timeout_delay,
+        verifier=args.verifier,
+        transport=args.transport,
+        journal=args.journal,
+        spec=spec,
+    )
+    parser = bench.run()
+    ok, chaos_txt = bench.check_invariants()
+    trace_txt = ""
+    if args.journal:
+        from .traces import TraceSet
+
+        traces = TraceSet.load(PathMaker.journals_path())
+        trace_txt = traces.summary()
+        if traces.blocks:
+            out = traces.export_chrome_trace(PathMaker.trace_file())
+            Print.info(
+                f"Chrome trace written to {out} "
+                "(open in https://ui.perfetto.dev)"
+            )
+    label = f"chaos-{bench.spec.get('name', args.scenario)}"
+    if args.transport != "asyncio":
+        label += f"-{args.transport}"
+    summary = parser.result(
+        faults=0, nodes=args.nodes, verifier=label,
+        extra=trace_txt + chaos_txt,
+    )
+    print(summary)
+    _save_result(summary, 0, args.nodes, args.rate, label,
+                 ok=parser.has_window())
+    if not ok:
+        Print.error("chaos invariants FAILED")
+    return 0 if ok else 1
+
+
 def task_traces(args) -> int:
     """Merge flight-recorder journals into the cross-node SUMMARY block
     and a Chrome trace-event JSON (open in https://ui.perfetto.dev)."""
@@ -307,6 +360,51 @@ def main(argv=None) -> int:
         "co-location artifact",
     )
     p.set_defaults(fn=task_local)
+
+    p = sub.add_parser(
+        "chaos",
+        help="run a committee under a seeded fault scenario and check "
+        "the safety/liveness invariants (docs/FAULTS.md)",
+    )
+    p.add_argument(
+        "--scenario",
+        default="split-brain",
+        help="canned scenario name (hotstuff_tpu/faults/scenarios.py): "
+        "split-brain, leader-isolation, flapping-link, "
+        "rolling-crash-restart",
+    )
+    p.add_argument(
+        "--spec",
+        default=None,
+        help="path to a custom fault-plane spec JSON (overrides "
+        "--scenario/--seed)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument(
+        "--duration",
+        type=float,
+        default=30.0,
+        help="minimum window; extended automatically to cover the "
+        "scenario's last heal plus the liveness bound",
+    )
+    p.add_argument(
+        "--timeout-delay",
+        type=int,
+        default=1_000,
+        help="consensus timeout (ms) — chaos runs default lower than "
+        "`local` so view changes during outages resolve quickly",
+    )
+    p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
+    p.add_argument("--transport", choices=["asyncio", "native"], default="asyncio")
+    p.add_argument(
+        "--journal",
+        action="store_true",
+        help="flight recorder on: fault windows appear as spans on the "
+        "chaos-plane track of logs/trace.json",
+    )
+    p.set_defaults(fn=task_chaos)
 
     p = sub.add_parser("tpu")
     p.add_argument("--sizes", default="4,8,16")

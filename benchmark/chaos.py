@@ -1,0 +1,154 @@
+"""Chaos bench: a local committee under a seeded fault scenario.
+
+``ChaosBench`` extends :class:`LocalBench` with the chaos plane wired
+end-to-end:
+
+  - at config time it builds the scenario spec (hotstuff_tpu/faults/
+    scenarios.py), fills in the committee's ``nodes`` map and a shared
+    ``epoch_unix``, writes it to ``.faults.json``, and injects
+    ``HOTSTUFF_FAULTS`` into every node's environment so each node
+    constructs the same deterministic FaultPlane;
+  - during the measurement window it executes the spec's process-level
+    ``crashes`` schedule (SIGKILL at ``at``, respawn at ``restart_at``;
+    the respawned node appends to its log and rejoins from its
+    persisted store);
+  - after the run it evaluates the committee-wide safety/liveness
+    invariants (benchmark/invariants.py) and renders the ``+ CHAOS``
+    block for the SUMMARY.
+
+``epoch_unix`` (scenario t=0) is set to config time plus a small boot
+margin — the spec file must exist before the first node boots, so the
+epoch cannot observe the actual boot.  On a CPU-verifier committee the
+client starts sending well inside the margin, and every canned scenario
+opens its first window several seconds after t=0, so nodes always
+commit under clean conditions first.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import time
+
+from hotstuff_tpu.faults.scenarios import build, last_heal
+
+from .invariants import check_run
+from .local import LocalBench
+from .utils import PathMaker, Print
+
+#: seconds between config time and scenario t=0 (covers committee +
+#: client boot on a CPU-verifier committee)
+BOOT_MARGIN_S = 8.0
+
+
+class ChaosBench(LocalBench):
+    def __init__(
+        self,
+        scenario: str = "split-brain",
+        seed: int = 0,
+        nodes: int = 4,
+        rate: int = 1_000,
+        duration: float = 30.0,
+        timeout_delay: int = 1_000,
+        verifier: str = "cpu",
+        transport: str = "asyncio",
+        tx_size: int = 512,
+        journal: bool = False,
+        spec: dict | None = None,
+    ):
+        # crash-fault injection (`faults` N) is the scenario's job here;
+        # in_process is out — crashes target individual node processes
+        super().__init__(
+            nodes=nodes,
+            rate=rate,
+            duration=duration,
+            faults=0,
+            timeout_delay=timeout_delay,
+            verifier=verifier,
+            transport=transport,
+            tx_size=tx_size,
+            journal=journal,
+        )
+        self.scenario = scenario
+        self.seed = seed
+        self.spec = spec if spec is not None else build(
+            scenario, nodes=nodes, seed=seed
+        )
+        self._epoch: float | None = None
+        # the run must outlive the last heal by the liveness bound, or
+        # the checker would fail a perfectly healthy committee for
+        # being measured too briefly
+        heal = last_heal(self.spec)
+        if not math.isinf(heal):
+            resume = self.spec.get("liveness", {}).get("resume_within_s", 20.0)
+            self.duration = max(self.duration, heal + resume + 4.0)
+
+    # ---- config ------------------------------------------------------------
+
+    def _config(self) -> None:
+        super()._config()
+        self._epoch = time.time() + BOOT_MARGIN_S
+        spec = dict(self.spec)
+        spec["epoch_unix"] = self._epoch
+        spec["nodes"] = {
+            f"127.0.0.1:{self.base_port + i}": i for i in range(self.nodes)
+        }
+        path = PathMaker.fault_spec_file()
+        with open(path, "w") as f:
+            json.dump(spec, f, indent=2)
+        self.extra_env["HOTSTUFF_FAULTS"] = os.path.abspath(path)
+        Print.info(
+            f"chaos: scenario {self.spec.get('name')!r} seed {self.seed}, "
+            f"spec -> {path} (epoch in {BOOT_MARGIN_S:.0f}s)"
+        )
+
+    # ---- crash/restart schedule --------------------------------------------
+
+    def _measurement_window(self, started: bool) -> None:
+        assert self._epoch is not None
+        deadline = time.time() + self.duration + 4
+        events: list[tuple[float, str, int]] = []
+        for crash in self.spec.get("crashes", ()):
+            node = int(crash["node"])
+            events.append((self._epoch + float(crash["at"]), "kill", node))
+            restart = crash.get("restart_at")
+            if restart is not None:
+                events.append(
+                    (self._epoch + float(restart), "restart", node)
+                )
+        for when, action, node in sorted(events):
+            if when > deadline:
+                Print.warn(
+                    f"chaos: {action} of node {node} falls past the "
+                    "measurement window — skipped"
+                )
+                continue
+            delay = when - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            t_rel = time.time() - self._epoch
+            if action == "kill":
+                proc = self._node_procs.get(node)
+                if proc is not None and proc.poll() is None:
+                    proc.send_signal(signal.SIGKILL)  # a crash, not a stop
+                    proc.wait()
+                Print.info(f"chaos: crashed node {node} (t={t_rel:.1f}s)")
+            else:
+                self._spawn_node(node, append=True)
+                Print.info(f"chaos: restarted node {node} (t={t_rel:.1f}s)")
+        remaining = deadline - time.time()
+        if remaining > 0:
+            time.sleep(remaining)
+
+    # ---- verdict -----------------------------------------------------------
+
+    def check_invariants(self) -> tuple[bool, str]:
+        """Evaluate safety/liveness over the finished run's logs.
+        Returns (all_ok, rendered CHAOS block)."""
+        assert self._epoch is not None, "run() must complete first"
+        return check_run(PathMaker.logs_path(), self.spec, self._epoch)
+
+
+__all__ = ["BOOT_MARGIN_S", "ChaosBench"]

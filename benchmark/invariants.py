@@ -1,0 +1,217 @@
+"""Committee-wide safety/liveness invariants for chaos runs.
+
+The checkers are PURE functions over per-node commit observations so
+the in-process e2e tests can feed them synthetic or live data directly;
+``commits_from_logs`` is the adapter that extracts the observations
+from a bench run's node logs (same schema as benchmark/logs.py), and
+``chaos_block`` renders the verdict as the ``+ CHAOS`` SUMMARY section.
+
+Safety (must hold under ANY fault schedule):
+  - no two nodes commit different blocks at the same round;
+  - no single node commits two different blocks at the same round
+    (restarted nodes may legitimately RE-commit the same block after a
+    crash — only a *different* digest at a seen round is a violation).
+  Together with per-lifetime in-order commitment these imply the
+  committed chains are prefixes of one another.
+
+Liveness (must hold once the scenario heals):
+  - some node commits a NEW round (beyond the pre-heal maximum) within
+    ``resume_within_s`` of the last heal edge;
+  - the first new round is within ``max_round_gap`` of the pre-heal
+    maximum (bounds rounds burned to view-change storms during the
+    outage).
+"""
+
+from __future__ import annotations
+
+import glob
+import math
+import os
+
+from .logs import RE_COMMITTED, _ts
+
+# commit observation: (wall-clock seconds, round, block digest)
+Commit = tuple[float, int, str]
+
+
+def commits_from_logs(logs_dir: str) -> dict[str, list[Commit]]:
+    """Per-node committed-block observations from a logs directory.
+    A restarted node's log holds both lifetimes (the runner appends)."""
+    out: dict[str, list[Commit]] = {}
+    for path in sorted(glob.glob(os.path.join(logs_dir, "node-*.log"))):
+        name = os.path.basename(path)[: -len(".log")]
+        with open(path) as f:
+            content = f.read()
+        out[name] = [
+            (_ts(ts), int(rnd), digest)
+            for ts, rnd, digest in RE_COMMITTED.findall(content)
+        ]
+    return out
+
+
+def check_safety(
+    commits_by_node: dict[str, list[Commit]],
+) -> tuple[bool, list[str]]:
+    """No conflicting commits at any round, across nodes or within one
+    node's (possibly multi-lifetime) history."""
+    violations: list[str] = []
+    chosen: dict[int, tuple[str, str]] = {}  # round -> (digest, first node)
+    for node in sorted(commits_by_node):
+        seen_here: dict[int, str] = {}
+        for _t, rnd, digest in commits_by_node[node]:
+            prev = seen_here.get(rnd)
+            if prev is not None and prev != digest:
+                violations.append(
+                    f"{node} committed two blocks at round {rnd}: "
+                    f"{prev} vs {digest}"
+                )
+            seen_here[rnd] = digest
+            got = chosen.get(rnd)
+            if got is None:
+                chosen[rnd] = (digest, node)
+            elif got[0] != digest:
+                violations.append(
+                    f"conflicting commits at round {rnd}: "
+                    f"{got[1]} -> {got[0]}, {node} -> {digest}"
+                )
+    return (not violations), violations
+
+
+def check_liveness(
+    commits_by_node: dict[str, list[Commit]],
+    heal_unix: float,
+    resume_within_s: float | None = None,
+    max_round_gap: int | None = None,
+) -> tuple[bool, list[str], dict]:
+    """New rounds commit soon after the last heal edge (wall clock
+    ``heal_unix``).  Returns (ok, violations, details) — details carries
+    the measured resume latency for the CHAOS block."""
+    all_commits = sorted(
+        (t, rnd)
+        for commits in commits_by_node.values()
+        for (t, rnd, _d) in commits
+    )
+    details: dict = {}
+    if not all_commits:
+        return False, ["no commits anywhere in the run"], details
+    pre = [rnd for t, rnd in all_commits if t <= heal_unix]
+    pre_max = max(pre) if pre else -1
+    details["pre_heal_max_round"] = pre_max
+    post = [
+        (t, rnd) for t, rnd in all_commits if t > heal_unix and rnd > pre_max
+    ]
+    if not post:
+        return (
+            False,
+            [
+                "no new rounds committed after the last heal "
+                f"(pre-heal max round {pre_max})"
+            ],
+            details,
+        )
+    first_t, first_rnd = post[0]
+    resumed_after = first_t - heal_unix
+    details["resumed_after_s"] = resumed_after
+    details["first_new_round"] = first_rnd
+    violations: list[str] = []
+    if resume_within_s is not None and resumed_after > resume_within_s:
+        violations.append(
+            f"commits resumed {resumed_after:.1f}s after the heal "
+            f"(bound {resume_within_s:.1f}s)"
+        )
+    if max_round_gap is not None and pre_max >= 0:
+        gap = first_rnd - pre_max
+        details["round_gap"] = gap
+        if gap > max_round_gap:
+            violations.append(
+                f"round gap across the outage: {gap} (bound {max_round_gap})"
+            )
+    return (not violations), violations, details
+
+
+def chaos_block(
+    scenario: str,
+    seed: int,
+    safety_ok: bool,
+    safety_violations: list[str],
+    liveness_ok: bool | None,
+    liveness_violations: list[str],
+    details: dict,
+    heal_rel: float | None = None,
+) -> str:
+    """Render the ``+ CHAOS`` SUMMARY section.  ``liveness_ok=None``
+    means the scenario never heals (unbounded rule) — liveness is n/a,
+    not a failure."""
+    lines = [
+        " + CHAOS:\n",
+        f" Scenario: {scenario} (seed {seed})\n",
+        f" Safety (no conflicting commits): {'PASS' if safety_ok else 'FAIL'}\n",
+    ]
+    for v in safety_violations:
+        lines.append(f"   ! {v}\n")
+    if liveness_ok is None:
+        lines.append(" Liveness: n/a (scenario never heals)\n")
+    else:
+        detail = ""
+        if "resumed_after_s" in details:
+            detail = f" (resumed {details['resumed_after_s']:.1f}s after heal"
+            if "round_gap" in details:
+                detail += f", round gap {details['round_gap']}"
+            detail += ")"
+        heal_txt = (
+            f"heal at t={heal_rel:.1f}s" if heal_rel is not None else "heal"
+        )
+        lines.append(
+            f" Liveness (recovery after {heal_txt}): "
+            f"{'PASS' if liveness_ok else 'FAIL'}{detail}\n"
+        )
+        for v in liveness_violations:
+            lines.append(f"   ! {v}\n")
+    return "".join(lines)
+
+
+def check_run(
+    logs_dir: str,
+    spec: dict,
+    epoch_unix: float,
+) -> tuple[bool, str]:
+    """Full invariant check for a finished chaos bench run: parse the
+    node logs, evaluate both invariants against the scenario spec, and
+    return (all_ok, rendered CHAOS block)."""
+    from hotstuff_tpu.faults.scenarios import last_heal
+
+    commits = commits_from_logs(logs_dir)
+    safety_ok, safety_viol = check_safety(commits)
+    heal_rel = last_heal(spec)
+    liveness = spec.get("liveness", {})
+    if math.isinf(heal_rel):
+        live_ok: bool | None = None
+        live_viol: list[str] = []
+        details: dict = {}
+        block = chaos_block(
+            spec.get("name", "custom"), int(spec.get("seed", 0)),
+            safety_ok, safety_viol, live_ok, live_viol, details,
+        )
+        return safety_ok, block
+    live_ok, live_viol, details = check_liveness(
+        commits,
+        heal_unix=epoch_unix + heal_rel,
+        resume_within_s=liveness.get("resume_within_s"),
+        max_round_gap=liveness.get("max_round_gap"),
+    )
+    block = chaos_block(
+        spec.get("name", "custom"), int(spec.get("seed", 0)),
+        safety_ok, safety_viol, live_ok, live_viol, details,
+        heal_rel=heal_rel,
+    )
+    return safety_ok and live_ok, block
+
+
+__all__ = [
+    "Commit",
+    "chaos_block",
+    "check_liveness",
+    "check_run",
+    "check_safety",
+    "commits_from_logs",
+]

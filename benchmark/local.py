@@ -84,6 +84,12 @@ class LocalBench:
         # protocol; this mode shares one asyncio loop instead.
         self.in_process = in_process
         self._procs: list[subprocess.Popen] = []
+        # node index -> its (latest) subprocess — lets subclasses target
+        # individual nodes (ChaosBench crash/restart schedules)
+        self._node_procs: dict[int, subprocess.Popen] = {}
+        # extra environment for every spawned process — subclass hook
+        # (ChaosBench injects HOTSTUFF_FAULTS here)
+        self.extra_env: dict[str, str] = {}
 
     # ---- setup/teardown ----------------------------------------------------
 
@@ -104,6 +110,7 @@ class LocalBench:
             except subprocess.TimeoutExpired:
                 proc.kill()
         self._procs.clear()
+        self._node_procs.clear()
 
     def _config(self) -> None:
         keys = [Secret.new(self.scheme) for _ in range(self.nodes)]
@@ -140,8 +147,13 @@ class LocalBench:
     def _wan_spec_path() -> str:
         return os.path.join(PathMaker.base_path(), ".wan.json")
 
-    def _spawn(self, cmd: list[str], log_file: str) -> subprocess.Popen:
-        f = open(log_file, "w")
+    def _spawn(
+        self, cmd: list[str], log_file: str, append: bool = False
+    ) -> subprocess.Popen:
+        # append=True: a node restarted mid-run (chaos crash/restart)
+        # keeps its pre-crash log — both lifetimes feed the log parser
+        # and the invariant checker
+        f = open(log_file, "a" if append else "w")
         # repo root (the directory holding hotstuff_tpu/), NOT cwd — the
         # harness must work from any working directory
         import hotstuff_tpu
@@ -164,6 +176,7 @@ class LocalBench:
             env={
                 **os.environ,
                 **wan_env,
+                **self.extra_env,
                 # PREPEND the repo root — clobbering an existing
                 # PYTHONPATH can drop site dirs that register jax
                 # backend plugins (the tunneled-TPU rig loads its
@@ -183,6 +196,37 @@ class LocalBench:
             },
         )
         self._procs.append(proc)
+        return proc
+
+    def _node_cmd(self, i: int) -> list[str]:
+        return [
+            sys.executable,
+            "-m",
+            "hotstuff_tpu.node",
+            "-vv",
+            "run",
+            "--keys",
+            PathMaker.key_file(i),
+            "--committee",
+            PathMaker.committee_file(),
+            "--store",
+            PathMaker.db_path(i),
+            "--parameters",
+            PathMaker.parameters_file(),
+            "--verifier",
+            self.verifier,
+            "--transport",
+            self.transport,
+        ]
+
+    def _spawn_node(self, i: int, append: bool = False) -> subprocess.Popen:
+        """Boot (or, with ``append=True``, re-boot) node ``i`` as its
+        own process.  The store persists across restarts, so a respawned
+        node rejoins from its pre-crash chain state."""
+        proc = self._spawn(
+            self._node_cmd(i), PathMaker.node_log_file(i), append=append
+        )
+        self._node_procs[i] = proc
         return proc
 
     # ---- the run -----------------------------------------------------------
@@ -274,28 +318,7 @@ class LocalBench:
                 )
             else:
                 for i in range(self.nodes - self.faults):
-                    self._spawn(
-                        [
-                            py,
-                            "-m",
-                            "hotstuff_tpu.node",
-                            "-vv",
-                            "run",
-                            "--keys",
-                            PathMaker.key_file(i),
-                            "--committee",
-                            PathMaker.committee_file(),
-                            "--store",
-                            PathMaker.db_path(i),
-                            "--parameters",
-                            PathMaker.parameters_file(),
-                            "--verifier",
-                            self.verifier,
-                            "--transport",
-                            self.transport,
-                        ],
-                        PathMaker.node_log_file(i),
-                    )
+                    self._spawn_node(i)
 
             # Launch the producer-path client.
             self._spawn(
@@ -343,10 +366,16 @@ class LocalBench:
                 time.sleep(0.5)
             if not started:
                 Print.warn("client never started sending (boot timeout)")
-            time.sleep(self.duration + 4)  # the window + drain margin
+            self._measurement_window(started)
         except (OSError, subprocess.SubprocessError) as e:
             raise BenchError(f"Failed to run benchmark: {e}") from e
         finally:
             self._kill_processes()
 
         return LogParser.process(PathMaker.logs_path())
+
+    def _measurement_window(self, started: bool) -> None:
+        """Wait out the measurement window.  Subclass hook: ChaosBench
+        overrides this to drive the crash/restart schedule while the
+        committee runs."""
+        time.sleep(self.duration + 4)  # the window + drain margin

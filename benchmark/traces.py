@@ -167,6 +167,14 @@ class TraceSet:
         # rounds that any node journaled a local timeout for, with the
         # corrected wall time of the first complaint
         self.timeouts: dict[int, tuple[str, int]] = {}
+        # producer-channel edges (ROADMAP PR 2 follow-up): per-payload
+        # wait from the leader's recv.producer to its payload.first, ms
+        # on that node's monotonic clock
+        self.payload_waits: list[float] = []
+        # chaos-plane windows: (label, w_open_corr, w_close_corr|None),
+        # taken from the node that journaled the most fault edges (every
+        # node journals the same scenario schedule)
+        self.fault_spans: list[tuple[str, int, int | None]] = []
         self._reconstruct()
 
     @classmethod
@@ -193,12 +201,28 @@ class TraceSet:
         return info
 
     def _reconstruct(self) -> None:
+        fault_edges_best: list[tuple[int, str, str]] = []
         for node, records in self.journals.items():
+            producer_seen: dict[str, int] = {}  # digest -> monotonic ns
+            fault_edges: list[tuple[int, str, str]] = []  # (w_corr, kind, label)
             for r in records:
                 e = r["e"]
                 if e in ("tc", "round.enter", "recv.timeout", "recv.tc",
                          "sync.req", "sync.reply", "sync.done",
-                         "recv.sync_req"):
+                         "recv.sync_req", "sync.expire"):
+                    continue
+                if e == "recv.producer":
+                    producer_seen.setdefault(r["d"], r["m"])
+                    continue
+                if e == "payload.first":
+                    got = producer_seen.get(r["d"])
+                    if got is not None:
+                        self.payload_waits.append((r["m"] - got) / 1e6)
+                    continue
+                if e in ("fault.open", "fault.close"):
+                    fault_edges.append(
+                        (self._corr(node, r["w"]), e[6:], r["p"])
+                    )
                     continue
                 if e == "timeout":
                     rnd = r["r"]
@@ -222,6 +246,18 @@ class TraceSet:
                         info["qc"] = (node, r["m"], stamp[1])
                 elif e == "commit":
                     info["commit"].setdefault(node, stamp)
+            if len(fault_edges) > len(fault_edges_best):
+                fault_edges_best = fault_edges
+        # pair open/close edges per label, in time order
+        open_at: dict[str, int] = {}
+        for w, kind, label in sorted(fault_edges_best):
+            if kind == "open":
+                open_at.setdefault(label, w)
+            elif label in open_at:
+                self.fault_spans.append((label, open_at.pop(label), w))
+        for label, w in open_at.items():  # never-closed windows
+            self.fault_spans.append((label, w, None))
+        self.fault_spans.sort(key=lambda s: s[1])
 
     # ---- derived views -----------------------------------------------------
 
@@ -326,6 +362,7 @@ class TraceSet:
                 f"  max {max(values):7.2f} ms{extra}\n"
             )
 
+        row("producer recv -> proposed", self.payload_waits)
         row("propose -> replica recv", gaps["propose_to_recv"])
         row("recv spread across committee", gaps["recv_spread"])
         row("recv -> vote sent (local)", gaps["recv_to_vote"])
@@ -350,6 +387,16 @@ class TraceSet:
                 shown += ", ..."
             lines.append(
                 f" Timed-out rounds journaled: {len(rounds)} ({shown})\n"
+            )
+        if self.fault_spans:
+            labels = Counter(label for label, _, _ in self.fault_spans)
+            shown = ", ".join(
+                f"{label} x{n}" if n > 1 else label
+                for label, n in sorted(labels.items())
+            )
+            lines.append(
+                f" Fault windows journaled: {len(self.fault_spans)}"
+                f" ({shown})\n"
             )
         return "".join(lines)
 
@@ -380,9 +427,12 @@ class TraceSet:
             i["propose"][1] for i in self.blocks.values() if i["propose"]
         ]
         anchors.extend(w for _, w in self.timeouts.values())
+        anchors.extend(w for _, w, _ in self.fault_spans)
+        anchors.extend(w for _, _, w in self.fault_spans if w is not None)
         if not anchors:
             return {"traceEvents": events, "displayTimeUnit": "ms"}
         base = min(anchors)
+        horizon = max(anchors)
 
         def us(w_corr: int) -> float:
             return (w_corr - base) / 1e3
@@ -470,6 +520,33 @@ class TraceSet:
                     "ts": us(w),
                 }
             )
+        if self.fault_spans:
+            # dedicated chaos track: partition/impairment windows as
+            # duration slices spanning the whole committee timeline
+            chaos_pid = len(self.nodes)
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": chaos_pid,
+                    "tid": 0,
+                    "args": {"name": "chaos plane"},
+                }
+            )
+            for label, w_open, w_close in self.fault_spans:
+                end = w_close if w_close is not None else horizon
+                events.append(
+                    {
+                        "name": label,
+                        "cat": "fault",
+                        "ph": "X",
+                        "pid": chaos_pid,
+                        "tid": 0,
+                        "ts": us(w_open),
+                        "dur": max(1.0, us(end) - us(w_open)),
+                        "args": {"label": label, "closed": w_close is not None},
+                    }
+                )
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def export_chrome_trace(self, path: str) -> str:

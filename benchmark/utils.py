@@ -60,6 +60,12 @@ class PathMaker:
         return os.path.join(PathMaker.logs_path(), "trace.json")
 
     @staticmethod
+    def fault_spec_file() -> str:
+        """The chaos-plane scenario spec the committee loads via
+        HOTSTUFF_FAULTS (benchmark/chaos.py writes it at config time)."""
+        return os.path.join(PathMaker.base_path(), ".faults.json")
+
+    @staticmethod
     def results_path() -> str:
         return os.path.join(PathMaker.base_path(), "results")
 

@@ -200,6 +200,10 @@ class ConsensusReceiverHandler:
                 j.record("recv.tc", payload.round)
             elif tag == TAG_SYNC_REQUEST:
                 j.record("recv.sync_req", 0, payload[0], str(payload[1])[:8])
+            elif tag == TAG_PRODUCER:
+                # producer-channel edge (ROADMAP PR 2 follow-up): lets
+                # traces attribute payload starvation vs consensus stall
+                j.record("recv.producer", 0, payload[0], "client")
         if tag == TAG_SYNC_REQUEST:
             await self.tx_helper.put(payload)
         elif tag == TAG_PROPOSE:
@@ -327,6 +331,17 @@ class Consensus:
             def link_delay(dst, _model=model):  # noqa: E731 — closure
                 return lambda: _model.delay(dst)
 
+        # Chaos plane (HOTSTUFF_FAULTS, faults/plane.py): seeded
+        # deterministic fault injection, threaded through every sender
+        # the same way link_delay is.  Works on both transports.
+        fault_plane = None
+        faults_spec = os.environ.get("HOTSTUFF_FAULTS")
+        if faults_spec:
+            from ..faults import FaultPlane
+
+            fault_plane = FaultPlane.load(faults_spec, address)
+            log.info("Fault plane active: %s", fault_plane.describe())
+
         if transport == "native":
             from ..network.native import (
                 NativeReceiver,
@@ -335,8 +350,12 @@ class Consensus:
             )
 
             receiver_cls = NativeReceiver
-            make_sender = NativeSimpleSender
-            make_reliable = NativeReliableSender
+
+            def make_sender():
+                return NativeSimpleSender(fault_plane=fault_plane)
+
+            def make_reliable():
+                return NativeReliableSender(fault_plane=fault_plane)
         else:
             from ..network import ReliableSender, SimpleSender
 
@@ -352,12 +371,16 @@ class Consensus:
 
             def make_sender():
                 return SimpleSender(
-                    link_delay=link_delay, max_conns=max_conns
+                    link_delay=link_delay,
+                    max_conns=max_conns,
+                    fault_plane=fault_plane,
                 )
 
             def make_reliable():
                 return ReliableSender(
-                    link_delay=link_delay, max_conns=max_conns
+                    link_delay=link_delay,
+                    max_conns=max_conns,
+                    fault_plane=fault_plane,
                 )
         self.receiver = receiver_cls(
             bind_host,
@@ -369,6 +392,7 @@ class Consensus:
                 bodies=payload_bodies,
                 telemetry=telemetry,
             ),
+            fault_plane=fault_plane,
         )
         await self.receiver.spawn()
         log.info(
@@ -377,6 +401,34 @@ class Consensus:
             bind_host,
             address[1],
         )
+
+        if fault_plane is not None:
+            from ..faults import run_clock
+
+            journal = telemetry.journal if telemetry is not None else None
+            self._tasks.append(
+                asyncio.get_running_loop().create_task(
+                    run_clock(fault_plane, journal),
+                    name="fault-clock",
+                )
+            )
+            if telemetry is not None:
+                for count_name, help_text in (
+                    ("dropped", "Frames dropped by the fault plane"),
+                    ("delayed", "Frames delayed by the fault plane"),
+                    ("duplicated", "Frames duplicated by the fault plane"),
+                    ("corrupted", "Frames corrupted by the fault plane"),
+                    (
+                        "inbound_dropped",
+                        "Inbound frames swallowed during isolate windows",
+                    ),
+                ):
+                    telemetry.gauge(
+                        f"fault_{count_name}",
+                        help_text,
+                        fn=lambda p=fault_plane, k=count_name: p.counts[k],
+                    )
+                telemetry.add_section("fault_plane", fault_plane.stats)
 
         leader_elector = LeaderElector(committee)
         self.synchronizer = Synchronizer(
@@ -404,6 +456,11 @@ class Consensus:
             telemetry.register_store(store)
             telemetry.register_network(
                 "sync", self.synchronizer.network, peers=peers
+            )
+            telemetry.gauge(
+                "sync_expired",
+                "Parent-sync requests abandoned at the give-up deadline",
+                fn=lambda s=self.synchronizer: s.expired,
             )
 
         self.core = Core(

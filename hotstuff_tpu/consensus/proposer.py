@@ -200,6 +200,15 @@ class Proposer:
             # every recv.propose edge off — journaled just before the
             # broadcast leaves this node
             self._journal.record("propose", block.round, block.digest())
+            if block.payloads:
+                # producer-channel edge (ROADMAP PR 2 follow-up): pairs
+                # with the receiver's recv.producer record so traces
+                # can measure payload-wait (client frame -> proposed)
+                # and chaos runs can tell payload starvation from
+                # consensus stall
+                self._journal.record(
+                    "payload.first", block.round, block.payloads[0]
+                )
 
         # Broadcast to the union of epochs (committee.broadcast_addresses
         # is the union on a CommitteeSchedule — members of the adjacent

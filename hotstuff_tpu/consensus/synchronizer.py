@@ -8,6 +8,15 @@ block's author, (b) parks a waiter on ``store.notify_read(parent)``, and
 committee every TIMER_ACCURACY tick (the "perfect point-to-point link"
 retry, synchronizer.rs:84-105). When the parent is finally written, the
 suspended child block is re-sent to the core via the loopback channel.
+
+Beyond the reference: requests EXPIRE.  A parent digest that never
+arrives (equivocating proposer, or a sender partitioned before anyone
+stored the block) used to pin its waiter task, its ``_pending`` /
+``_requests`` entries, and its store obligation forever, while
+re-broadcasting to the whole committee every retry tick.  After
+``sync_giveup`` seconds the request is abandoned: waiters are
+cancelled, the suspended children are forgotten (a live chain re-sends
+them via a later QC), and the store obligation is dropped.
 """
 
 from __future__ import annotations
@@ -52,6 +61,13 @@ class Synchronizer:
         self._pending: set[Digest] = set()  # child digests being synced
         self._requests: dict[Digest, float] = {}  # parent digest -> first-ask time
         self._waiters: set[asyncio.Task] = set()
+        # give-up bookkeeping: which waiters/children each parent pins
+        self._by_parent: dict[Digest, list[asyncio.Task]] = {}
+        self._children: dict[Digest, set[Digest]] = {}
+        # generous: far past any honest delivery, but bounded (a parent
+        # that never arrives must not leak tasks or spam the committee)
+        self.sync_giveup = max(30.0, 20 * self.sync_retry_delay)
+        self.expired = 0  # abandoned requests (telemetry gauge)
         self._retry_task: asyncio.Task | None = None
 
     def _ensure_retry_task(self) -> None:
@@ -65,7 +81,9 @@ class Synchronizer:
             await asyncio.sleep(TIMER_ACCURACY_S)
             now = time.monotonic()
             for digest, asked_at in list(self._requests.items()):
-                if asked_at + self.sync_retry_delay < now:
+                if asked_at + self.sync_giveup < now:
+                    self._expire(digest)
+                elif asked_at + self.sync_retry_delay < now:
                     self.log.debug("Requesting sync for block %s (retry)", digest)
                     addresses = [
                         addr
@@ -73,6 +91,23 @@ class Synchronizer:
                     ]
                     message = encode_sync_request(digest, self.name)
                     await self.network.broadcast(addresses, message)
+
+    def _expire(self, parent: Digest) -> None:
+        """Abandon a parent that never arrived: unpin everything it
+        holds.  The chain self-heals if the digest was real — a later
+        block certifying it re-enters via get_parent_block."""
+        self.expired += 1
+        self._requests.pop(parent, None)
+        for task in self._by_parent.pop(parent, ()):
+            task.cancel()
+        for child in self._children.pop(parent, ()):
+            self._pending.discard(child)
+        self.store.cancel_notify(parent.to_bytes())
+        if self._journal is not None:
+            self._journal.record("sync.expire", 0, parent)
+        self.log.warning(
+            "Giving up sync for parent %s after %.0fs", parent, self.sync_giveup
+        )
 
     async def _waiter(self, parent: Digest, child: Block) -> None:
         """Park on the store until the parent exists, then loop the child
@@ -96,7 +131,22 @@ class Synchronizer:
             self._waiter(parent, block), name=f"sync-wait-{parent}"
         )
         self._waiters.add(task)
-        task.add_done_callback(self._waiters.discard)
+        self._by_parent.setdefault(parent, []).append(task)
+        self._children.setdefault(parent, set()).add(block.digest())
+
+        def _cleanup(t, parent=parent):
+            self._waiters.discard(t)
+            tasks = self._by_parent.get(parent)
+            if tasks is not None:
+                try:
+                    tasks.remove(t)
+                except ValueError:
+                    pass
+                if not tasks:
+                    self._by_parent.pop(parent, None)
+                    self._children.pop(parent, None)
+
+        task.add_done_callback(_cleanup)
 
         if parent not in self._requests:
             self.log.debug("Requesting sync for block %s", parent)
@@ -148,4 +198,6 @@ class Synchronizer:
         for task in list(self._waiters):
             task.cancel()
         self._waiters.clear()
+        self._by_parent.clear()
+        self._children.clear()
         self.network.close()

@@ -1,0 +1,34 @@
+"""Seeded chaos plane: deterministic network fault injection.
+
+See docs/FAULTS.md for the scenario spec format, canned scenarios, and
+the safety/liveness invariant definitions checked by
+``python -m benchmark chaos``.
+"""
+
+from .plane import (
+    BARRIER_POLL_S,
+    Decision,
+    FaultPlane,
+    FaultRule,
+    LinkFaults,
+    PASS,
+    corrupt_frame,
+    expand_rules,
+    run_clock,
+)
+from .scenarios import SCENARIOS, build, last_heal
+
+__all__ = [
+    "BARRIER_POLL_S",
+    "Decision",
+    "FaultPlane",
+    "FaultRule",
+    "LinkFaults",
+    "PASS",
+    "SCENARIOS",
+    "build",
+    "corrupt_frame",
+    "expand_rules",
+    "last_heal",
+    "run_clock",
+]

@@ -1,0 +1,131 @@
+"""Canned chaos scenarios for a small local committee.
+
+Each builder returns a complete fault-plane spec dict (see
+``plane.FaultPlane``) given the committee size and seed; the chaos
+benchmark runner fills in ``nodes`` (address -> index) and
+``epoch_unix`` before writing the spec file nodes load.
+
+Timing convention: scenario t=0 is the shared ``epoch_unix``, which the
+runner sets to config time plus a boot margin (the spec file must exist
+before the first node boots).  Fault windows open a few seconds after
+t=0 so every node commits under clean conditions first, and close well
+before the bench ends so liveness recovery is observable.
+"""
+
+from __future__ import annotations
+
+
+def split_brain(nodes: int = 4, seed: int = 0, at: float = 6.0,
+                until: float = 14.0) -> dict:
+    """Partition the committee into two quorum-less halves (f vs f+1
+    loses liveness on both sides for n=4: 2/2).  Safety must hold
+    throughout; commits must resume after the heal."""
+    half = nodes // 2
+    return {
+        "name": "split-brain",
+        "seed": seed,
+        "rules": [
+            {
+                "label": "split-brain",
+                "partition": [list(range(half)), list(range(half, nodes))],
+                "at": at,
+                "until": until,
+            }
+        ],
+        "liveness": {"resume_within_s": 20.0, "max_round_gap": 200},
+    }
+
+
+def leader_isolation(nodes: int = 4, seed: int = 0, at: float = 6.0,
+                     until: float = 13.0) -> dict:
+    """Cut node 0 (the round-robin leader every ``nodes`` rounds) off
+    from the committee AND from clients (inbound cut).  The rest keep
+    committing via timeouts/TCs; node 0 catches up after the heal."""
+    return {
+        "name": "leader-isolation",
+        "seed": seed,
+        "rules": [
+            {"label": "leader-isolation", "isolate": 0, "at": at,
+             "until": until}
+        ],
+        "liveness": {"resume_within_s": 20.0, "max_round_gap": 200},
+    }
+
+
+def flapping_link(nodes: int = 4, seed: int = 0, at: float = 5.0,
+                  until: float = 17.0) -> dict:
+    """One link (0<->1) hard-drops for 1.5s out of every 3s.  Quorum is
+    never lost (n=4 tolerates one bad link) but the reconnect/backoff
+    path is exercised repeatedly."""
+    return {
+        "name": "flapping-link",
+        "seed": seed,
+        "rules": [
+            {"label": "flap-0-1", "from": [0], "to": [1], "drop": 1.0,
+             "at": at, "until": until, "every": 3.0, "for": 1.5},
+            {"label": "flap-1-0", "from": [1], "to": [0], "drop": 1.0,
+             "at": at, "until": until, "every": 3.0, "for": 1.5},
+        ],
+        "liveness": {"resume_within_s": 20.0, "max_round_gap": 200},
+    }
+
+
+def rolling_crash_restart(nodes: int = 4, seed: int = 0) -> dict:
+    """Kill and respawn one node at a time (f=1 for n=4, so the
+    committee keeps committing with 3/4 live).  Process-level: executed
+    by the chaos runner, not the in-node plane."""
+    return {
+        "name": "rolling-crash-restart",
+        "seed": seed,
+        "rules": [],
+        "crashes": [
+            {"node": 1, "at": 5.0, "restart_at": 9.0},
+            {"node": 2, "at": 11.0, "restart_at": 15.0},
+        ],
+        "liveness": {"resume_within_s": 25.0, "max_round_gap": 200},
+    }
+
+
+SCENARIOS = {
+    "split-brain": split_brain,
+    "leader-isolation": leader_isolation,
+    "flapping-link": flapping_link,
+    "rolling-crash-restart": rolling_crash_restart,
+}
+
+
+def build(name: str, nodes: int = 4, seed: int = 0) -> dict:
+    try:
+        builder = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; choose from {sorted(SCENARIOS)}"
+        ) from None
+    return builder(nodes=nodes, seed=seed)
+
+
+def last_heal(spec: dict) -> float:
+    """Scenario time after which no fault is ever active again: the max
+    of rule ``until`` edges (impairing rules only) and crash restarts.
+    Unbounded rules make the scenario never heal (returns +inf)."""
+    t = 0.0
+    for rule in spec.get("rules", ()):
+        impairs = any(
+            rule.get(k) for k in ("drop", "delay_ms", "duplicate", "corrupt")
+        ) or "partition" in rule or "isolate" in rule
+        if not impairs:
+            continue
+        until = rule.get("until")
+        if until is None:
+            return float("inf")
+        t = max(t, float(until))
+    for crash in spec.get("crashes", ()):
+        restart = crash.get("restart_at")
+        if restart is None:
+            return float("inf")
+        t = max(t, float(restart))
+    return t
+
+
+__all__ = ["SCENARIOS", "build", "last_heal", "split_brain",
+           "leader_isolation", "flapping_link", "rolling_crash_restart"]

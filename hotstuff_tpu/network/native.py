@@ -32,8 +32,11 @@ import asyncio
 import ctypes
 import logging
 import os
+import random
 import subprocess
 from collections import deque
+
+from ..faults.plane import BARRIER_POLL_S, corrupt_frame
 
 log = logging.getLogger(__name__)
 
@@ -262,10 +265,11 @@ class NativeReceiver:
     HIGH_WATER = 256
     LOW_WATER = 64
 
-    def __init__(self, host: str, port: int, handler):
+    def __init__(self, host: str, port: int, handler, fault_plane=None):
         self.host = host
         self.port = port
         self.handler = handler
+        self._faults = fault_plane
         self.reactor = Reactor.shared()
         self._listener = -1
         self._queues: dict[int, asyncio.Queue] = {}
@@ -316,8 +320,11 @@ class NativeReceiver:
             payload = await q.get()
             if payload is None:
                 return
+            if self._faults is not None and self._faults.inbound_cut():
+                payload = b""  # isolate window: swallow the frame unACKed
             try:
-                await self.handler.dispatch(writer, payload)
+                if payload:
+                    await self.handler.dispatch(writer, payload)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — a handler bug must not
@@ -399,11 +406,26 @@ def _resolve(host: str) -> str | None:
 
 
 class NativeSimpleSender:
-    """Native drop-in for network.simple_sender.SimpleSender."""
+    """Native drop-in for network.simple_sender.SimpleSender.
 
-    def __init__(self):
+    ``fault_plane`` (chaos plane, faults/plane.py): best-effort links
+    support the full fault matrix — drop skips the send, delay defers
+    the ``ht_send`` via ``call_later`` (later undelayed frames may
+    overtake it: reordering is fair game on a lossy best-effort link),
+    corrupt mangles the bytes, duplicate hands the frame over twice."""
+
+    def __init__(self, fault_plane=None):
         self.reactor = Reactor.shared()
+        self._fault_plane = fault_plane
+        self._links: dict[Address, object] = {}
         self._peers: dict[Address, int] = {}
+
+    def _link(self, address: Address):
+        if self._fault_plane is None:
+            return None
+        if address not in self._links:
+            self._links[address] = self._fault_plane.link(address)
+        return self._links[address]
 
     def _peer(self, address: Address) -> int | None:
         peer = self._peers.get(address)
@@ -422,9 +444,36 @@ class NativeSimpleSender:
         peer = self._peer(address)
         if peer is None:
             return
+        faults = self._link(address)
+        if faults is not None:
+            decision = faults.decide()
+            if decision.drop:
+                return
+            if decision.corrupt:
+                payload = corrupt_frame(payload)
+            if decision.delay_s:
+                asyncio.get_running_loop().call_later(
+                    decision.delay_s, self._send_now, peer, payload,
+                    decision.duplicate,
+                )
+                return
+            if decision.duplicate:
+                self._send_now(peer, payload, True)
+                return
         self.reactor.lib.ht_send(
             self.reactor.handle, peer, payload, len(payload)
         )
+
+    def _send_now(self, peer: int, payload: bytes, duplicate: bool) -> None:
+        if not self.reactor.handle:
+            return  # reactor stopped while the frame sat in its delay
+        self.reactor.lib.ht_send(
+            self.reactor.handle, peer, payload, len(payload)
+        )
+        if duplicate:
+            self.reactor.lib.ht_send(
+                self.reactor.handle, peer, payload, len(payload)
+            )
 
     async def broadcast(self, addresses: list[Address], payload: bytes) -> None:
         for address in addresses:
@@ -463,13 +512,26 @@ class NativeReliableSender:
     unsent — and every later frame queues behind it so transmission
     order always equals queue order.  On disconnect, ``sent`` resets to
     zero: stale ACKs died with the socket, and the whole queue is
-    retransmitted (at-least-once until ACKed, like the reference)."""
+    retransmitted (at-least-once until ACKed, like the reference).
+
+    ``fault_plane`` (chaos plane, faults/plane.py): the FIFO pairing
+    allows only order-preserving faults here — a barrier (hard
+    partition window) or a drawn drop defers the flush exactly like an
+    outbox-full refusal (head-of-line hold, frames flow when the window
+    closes / on the next attempt); delay/corrupt/duplicate are skipped
+    on native reliable links.  Reconnect backoff gets the same full
+    jitter as the asyncio ReliableSender (``jittered_retries``)."""
 
     RETRY_DELAY_S = 0.2
     RETRY_CAP_S = 60.0
 
-    def __init__(self):
+    #: retries whose backoff sleep was jittered (telemetry aggregate)
+    jittered_retries = 0
+
+    def __init__(self, fault_plane=None):
         self.reactor = Reactor.shared()
+        self._fault_plane = fault_plane
+        self._links: dict[int, object] = {}  # pid -> LinkFaults | None
         self._peers: dict[Address, int] = {}
         self._queue: dict[int, deque] = {}  # pid -> deque[(payload, fut)]
         self._sent: dict[int, int] = {}  # pid -> sent prefix length
@@ -491,6 +553,8 @@ class NativeReliableSender:
             self._peers[address] = pid
             self._queue[pid] = deque()
             self._sent[pid] = 0
+            if self._fault_plane is not None:
+                self._links[pid] = self._fault_plane.link(address)
             self.reactor._peer_handlers[pid] = (
                 lambda kind, payload, pid=pid: self._on_peer_event(
                     pid, kind, payload
@@ -522,6 +586,7 @@ class NativeReliableSender:
         at the first refusal (outbox full) — a short retry keeps order
         without busy-waiting."""
         q = self._queue[pid]
+        faults = self._links.get(pid)
         while self._sent[pid] < len(q):
             payload, fut = q[self._sent[pid]]
             if fut.cancelled():
@@ -529,6 +594,16 @@ class NativeReliableSender:
                 # unsent cancelled frames can simply be dropped
                 del q[self._sent[pid]]
                 continue
+            if faults is not None and (faults.barrier() or faults.decide().drop):
+                # hold the head of the line like an outbox-full refusal:
+                # order and ACK pairing survive, frames flow on retry
+                if self._retry_handle.get(pid) is None:
+                    self._retry_handle[pid] = (
+                        asyncio.get_running_loop().call_later(
+                            BARRIER_POLL_S, self._retry_flush, pid
+                        )
+                    )
+                return
             rc = self.reactor.lib.ht_send(
                 self.reactor.handle, pid, payload, len(payload)
             )
@@ -567,6 +642,11 @@ class NativeReliableSender:
             self._sent[pid] = 0
             delay = self._delay.get(pid, self.RETRY_DELAY_S)
             self._delay[pid] = min(delay * 2, self.RETRY_CAP_S)
+            # full jitter past the first retry (see asyncio
+            # ReliableSender._run): spread post-heal reconnects
+            if delay > self.RETRY_DELAY_S:
+                self.jittered_retries += 1
+                delay = random.uniform(0, delay)
             if self._retry_handle.get(pid) is None:
                 self._retry_handle[pid] = asyncio.get_running_loop().call_later(
                     delay, self._retry_flush, pid

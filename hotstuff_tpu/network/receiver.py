@@ -38,12 +38,23 @@ class MessageHandler(Protocol):
 
 
 class Receiver:
-    """Listens on ``address`` and dispatches every frame to ``handler``."""
+    """Listens on ``address`` and dispatches every frame to ``handler``.
 
-    def __init__(self, host: str, port: int, handler: MessageHandler):
+    ``fault_plane`` (chaos plane, faults/plane.py): inbound faulting is
+    all-or-nothing — accepted connections arrive from ephemeral ports,
+    so frames can't be attributed to a committee peer; committee-pair
+    partitions are fully enforced sender-side (every node shares the
+    scenario spec).  The receiver-side cut exists for ``isolate``
+    windows, where frames from planeless senders (benchmark clients)
+    must die too."""
+
+    def __init__(
+        self, host: str, port: int, handler: MessageHandler, fault_plane=None
+    ):
         self.host = host
         self.port = port
         self.handler = handler
+        self._faults = fault_plane
         self._server: asyncio.AbstractServer | None = None
         self._writers: set[asyncio.StreamWriter] = set()
 
@@ -69,6 +80,8 @@ class Receiver:
         try:
             while True:
                 frame = await read_frame(reader)
+                if self._faults is not None and self._faults.inbound_cut():
+                    continue  # isolate window: swallow the frame unACKed
                 await self.handler.dispatch(writer, frame)
         except (
             asyncio.IncompleteReadError,

@@ -12,10 +12,25 @@ depends on them):
   FIFO;
 - on connection failure, un-ACKed messages are retransmitted after
   reconnecting with exponential backoff (200 ms doubling, capped at 60 s —
-  reference reliable_sender.rs:131,166);
+  reference reliable_sender.rs:131,166) with FULL JITTER: each retry
+  sleeps uniform(0, delay) so the whole committee doesn't reconnect-
+  stampede the instant a partition heals (the deterministic schedule
+  synchronised every peer's retry clock);
 - messages whose future was cancelled by the caller are dropped instead of
   retransmitted (the reference drops messages whose CancelHandler receiver
   was dropped).
+
+Chaos-plane semantics on reliable links (faults/plane.py): the FIFO
+ACK pairing constrains what each fault can mean here. A hard partition
+(drop >= 1.0 window) HOLDS frames at the head of the line via
+``barrier()`` — no loss decision is consumed, frames flow when the
+window closes. A probabilistic drop tears the connection with a
+synthetic ConnectionError instead (the frame stays un-ACKed and rides
+the reconnect/retransmit path — exactly what a lost frame causes on a
+reliable link). Corruption sends the mangled bytes then tears the
+connection so the pairing resets and the clean frame is retransmitted.
+Duplication is a no-op: a duplicated frame would draw a second ACK and
+desync the FIFO pairing.
 """
 
 from __future__ import annotations
@@ -25,6 +40,7 @@ import logging
 import random
 from collections import deque
 
+from ..faults.plane import BARRIER_POLL_S, corrupt_frame
 from .errors import UnexpectedAckError, classify
 from .framing import FramingError, read_frame, send_frame, set_nodelay
 from .pool import BoundedPoolMixin, abort_writer
@@ -40,9 +56,18 @@ Address = tuple[str, int]
 CancelHandler = asyncio.Future  # resolves to the ACK payload (bytes)
 
 
+class FaultDisconnect(ConnectionError):
+    """Synthetic disconnect injected by the chaos plane: rides the
+    normal reconnect/retransmit path (loss-on-a-reliable-link)."""
+
+
 class _Connection:
-    def __init__(self, address: Address, delay_fn=None):
+    def __init__(self, address: Address, delay_fn=None, faults=None):
         self.address = address
+        self._faults = faults
+        #: retries whose backoff sleep was jittered (telemetry reads
+        #: this: stampede-avoided reconnect attempts)
+        self.jittered_retries = 0
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         # un-ACKed in-flight messages, FIFO-paired with incoming ACKs
         self.pending: deque[tuple[bytes, CancelHandler]] = deque()
@@ -85,7 +110,15 @@ class _Connection:
             except OSError as e:
                 self.connect_failures += 1
                 log.debug("%s", classify(e, "connect", self.address))
-                await asyncio.sleep(delay)
+                # full jitter: sleep uniform(0, delay) while the CEILING
+                # doubles — peers that lost the same partition at the
+                # same instant spread their reconnects across the window
+                # instead of stampeding the healed link in lockstep
+                if delay > RETRY_DELAY_S:
+                    self.jittered_retries += 1
+                    await asyncio.sleep(random.uniform(0, delay))
+                else:
+                    await asyncio.sleep(delay)
                 delay = min(delay * 2, RETRY_CAP_S)
                 continue
             set_nodelay(writer)
@@ -113,10 +146,14 @@ class _Connection:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         # retransmit un-ACKed messages first (skip cancelled),
-        # reference reliable_sender.rs:187-199
+        # reference reliable_sender.rs:187-199; a live partition window
+        # holds the retransmit burst too (head-of-line, like writer_loop)
         self.pending = deque(
             (d, f) for d, f in self.pending if not f.cancelled()
         )
+        if self._faults is not None and self.pending:
+            while self._faults.barrier():
+                await asyncio.sleep(BARRIER_POLL_S)
         for data, _ in self.pending:
             await send_frame(writer, data)
 
@@ -139,7 +176,7 @@ class _Connection:
                 self.pending.append((data, fut))
                 if at:
                     await LinkScheduler.wait_until(at)
-                await send_frame(writer, data)
+                await self._transmit(writer, data)
 
         def _resolve(fut, ack):
             if not fut.cancelled():
@@ -169,6 +206,29 @@ class _Connection:
 
         wtask = asyncio.ensure_future(writer_loop())
         rtask = asyncio.ensure_future(reader_loop())
+        return await self._supervise(wtask, rtask)
+
+    async def _transmit(self, writer: asyncio.StreamWriter, data: bytes) -> None:
+        """Send one frame through the chaos plane (module docstring has
+        the reliable-link fault semantics)."""
+        faults = self._faults
+        if faults is None:
+            await send_frame(writer, data)
+            return
+        while faults.barrier():
+            await asyncio.sleep(BARRIER_POLL_S)
+        decision = faults.decide()
+        if decision.drop:
+            raise FaultDisconnect(f"fault plane dropped frame to {self.address}")
+        if decision.delay_s:
+            await asyncio.sleep(decision.delay_s)
+        if decision.corrupt:
+            await send_frame(writer, corrupt_frame(data))
+            raise FaultDisconnect(f"fault plane corrupted frame to {self.address}")
+        await send_frame(writer, data)
+
+    @staticmethod
+    async def _supervise(wtask: asyncio.Task, rtask: asyncio.Task) -> None:
         try:
             done, _ = await asyncio.wait(
                 {wtask, rtask}, return_when=asyncio.FIRST_EXCEPTION
@@ -206,10 +266,16 @@ class ReliableSender(BoundedPoolMixin):
     and the pool shrinks back as ACKs drain.  Pool machinery shared
     with SimpleSender (network/pool.py)."""
 
-    def __init__(self, link_delay=None, max_conns: int | None = None):
+    def __init__(
+        self,
+        link_delay=None,
+        max_conns: int | None = None,
+        fault_plane=None,
+    ):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
         self._max_conns = max_conns
+        self._fault_plane = fault_plane
         self._sweeper: asyncio.Task | None = None
 
     def _connection(self, address: Address) -> _Connection:
@@ -217,7 +283,10 @@ class ReliableSender(BoundedPoolMixin):
         if conn is not None:
             return conn
         delay_fn = self._link_delay(address) if self._link_delay else None
-        conn = _Connection(address, delay_fn=delay_fn)
+        faults = (
+            self._fault_plane.link(address) if self._fault_plane else None
+        )
+        conn = _Connection(address, delay_fn=delay_fn, faults=faults)
         self._admit(address, conn)
         return conn
 

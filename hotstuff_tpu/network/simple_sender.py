@@ -13,6 +13,7 @@ import asyncio
 import logging
 import random
 
+from ..faults.plane import corrupt_frame
 from .errors import classify
 from .framing import read_frame, send_frame, set_nodelay
 from .pool import BoundedPoolMixin, abort_writer
@@ -31,10 +32,17 @@ class _Connection:
     ``delay_fn`` (WAN emulation, network/wan.py): each queued message
     carries a deliver-at time; the send loop waits until then before
     writing — per-message propagation delay, pipelined (never a
-    head-of-line rate limit)."""
+    head-of-line rate limit).
 
-    def __init__(self, address: Address, delay_fn=None):
+    ``faults`` (chaos plane, faults/plane.py): the per-link fault view;
+    each frame about to go out consults ``faults.decide()`` — dropped
+    frames are simply not written (best-effort semantics make that
+    exactly message loss), delays sleep inline, corruption flips a byte,
+    duplication writes the frame twice."""
+
+    def __init__(self, address: Address, delay_fn=None, faults=None):
         self.address = address
+        self._faults = faults
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         self._scheduler = (
             None if delay_fn is None else LinkScheduler(delay_fn)
@@ -94,7 +102,7 @@ class _Connection:
             try:
                 while True:
                     await self._wait(at)
-                    await send_frame(writer, data)
+                    await self._transmit(writer, data)
                     at, data = await self._next()
             except (ConnectionError, OSError) as e:
                 log.warning("%s", classify(e, "send", self.address))
@@ -102,6 +110,20 @@ class _Connection:
                 sink.cancel()
                 writer.close()
                 self._writer = None  # disconnected: back to retry state
+
+    async def _transmit(self, writer: asyncio.StreamWriter, data: bytes) -> None:
+        if self._faults is None:
+            await send_frame(writer, data)
+            return
+        decision = self._faults.decide()
+        if decision.drop:
+            return
+        if decision.delay_s:
+            await asyncio.sleep(decision.delay_s)
+        payload = corrupt_frame(data) if decision.corrupt else data
+        await send_frame(writer, payload)
+        if decision.duplicate:
+            await send_frame(writer, payload)
 
     @staticmethod
     async def _sink_acks(reader: asyncio.StreamReader) -> None:
@@ -126,6 +148,10 @@ class SimpleSender(BoundedPoolMixin):
     ``(address) -> (() -> float)`` returning the per-link delay sampler
     (None for an undelayed link).
 
+    ``fault_plane``: optional chaos plane (faults/plane.py) — each new
+    connection resolves its directed-link fault view once, mirroring
+    how ``link_delay`` resolves the WAN delay sampler.
+
     ``max_conns``: bounded connection pool (None = reference parity:
     one persistent connection per peer forever).  Big co-located
     committees need the bound — at 256 nodes every (sender, peer) pair
@@ -140,10 +166,16 @@ class SimpleSender(BoundedPoolMixin):
     #: this; class attr so unpaced senders pay no per-instance slot)
     pacing_stalls = 0
 
-    def __init__(self, link_delay=None, max_conns: int | None = None):
+    def __init__(
+        self,
+        link_delay=None,
+        max_conns: int | None = None,
+        fault_plane=None,
+    ):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
         self._max_conns = max_conns
+        self._fault_plane = fault_plane
         self._sweeper: asyncio.Task | None = None
 
     def _connection(self, address: Address) -> _Connection:
@@ -151,7 +183,10 @@ class SimpleSender(BoundedPoolMixin):
         if conn is not None:
             return conn
         delay_fn = self._link_delay(address) if self._link_delay else None
-        conn = _Connection(address, delay_fn=delay_fn)
+        faults = (
+            self._fault_plane.link(address) if self._fault_plane else None
+        )
+        conn = _Connection(address, delay_fn=delay_fn, faults=faults)
         self._admit(address, conn)
         return conn
 

@@ -157,12 +157,25 @@ def _apply_journal_dir(args) -> None:
         telemetry.set_journal_dir(jdir)
 
 
+def _apply_fault_plane(args) -> None:
+    """Activate the chaos plane when ``--fault-plane`` was given: the
+    flag value (a spec file path or inline JSON) lands in
+    HOTSTUFF_FAULTS, which Consensus.spawn reads at boot — exactly the
+    env-first pattern the WAN and journal knobs use."""
+    import os
+
+    spec = getattr(args, "fault_plane", None)
+    if spec:
+        os.environ["HOTSTUFF_FAULTS"] = spec
+
+
 async def _run_node(args) -> None:
     from .. import telemetry
 
     # before Node.new: a configured endpoint force-enables collection,
     # and the nodes booted below only pick telemetry up at boot
     _apply_journal_dir(args)
+    _apply_fault_plane(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -215,6 +228,7 @@ async def _run_many(args) -> None:
     from .. import telemetry
 
     _apply_journal_dir(args)
+    _apply_fault_plane(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -393,6 +407,13 @@ def main(argv=None) -> int:
         "journals with `python -m benchmark traces`)"
     )
     p_run.add_argument("--journal-dir", default=None, help=journal_help)
+    faults_help = (
+        "activate the chaos plane from this fault-spec file (or inline "
+        "JSON): seeded deterministic drop/delay/duplicate/corrupt per "
+        "directed peer pair on a scenario timeline (docs/FAULTS.md; "
+        "default: off, or the HOTSTUFF_FAULTS env knob)"
+    )
+    p_run.add_argument("--fault-plane", default=None, help=faults_help)
 
     p_many = sub.add_parser(
         "run-many",
@@ -412,6 +433,7 @@ def main(argv=None) -> int:
         "--metrics-port", type=int, default=None, help=metrics_help
     )
     p_many.add_argument("--journal-dir", default=None, help=journal_help)
+    p_many.add_argument("--fault-plane", default=None, help=faults_help)
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
@@ -423,6 +445,7 @@ def main(argv=None) -> int:
         "--metrics-port", type=int, default=None, help=metrics_help
     )
     p_dep.add_argument("--journal-dir", default=None, help=journal_help)
+    p_dep.add_argument("--fault-plane", default=None, help=faults_help)
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
@@ -440,6 +463,7 @@ def main(argv=None) -> int:
         asyncio.run(_run_many(args))
         return 0
     if args.command == "deploy":
+        _apply_fault_plane(args)
         asyncio.run(
             _deploy_testbed(
                 args.nodes,

@@ -89,6 +89,18 @@ class Store:
         self._obligations.setdefault(key, deque()).append(fut)
         return await fut
 
+    def cancel_notify(self, key: bytes) -> None:
+        """Cancel and drop every future parked on ``key``.  The
+        synchronizer calls this when it gives up on a missing parent:
+        waiter tasks cancelled from outside leave their (cancelled)
+        futures in the obligations deque, and absent a later write of
+        that exact key the entry would pin memory forever."""
+        waiters = self._obligations.pop(key, None)
+        if waiters:
+            for fut in waiters:
+                if not fut.done():
+                    fut.cancel()
+
     def close(self) -> None:
         self._closed = True
         for waiters in self._obligations.values():

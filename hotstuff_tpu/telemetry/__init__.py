@@ -280,6 +280,19 @@ class NodeTelemetry:
                 labels,
                 fn=lambda s=sender: s.pacing_stalls,
             )
+        reg.gauge(
+            "net_backoff_jitter",
+            "Reconnect retries whose backoff sleep was jittered "
+            "(stampede-avoided reconnects)",
+            labels,
+            # asyncio reliable connections count per connection; the
+            # native reliable sender keeps one process-wide counter
+            fn=lambda s=sender: sum(
+                getattr(c, "jittered_retries", 0)
+                for c in getattr(s, "_connections", {}).values()
+            )
+            + getattr(s, "jittered_retries", 0),
+        )
         if peers:
             for peer_name, address in peers:
                 self._register_peer(role, sender, peer_name, address)
@@ -307,6 +320,16 @@ class NodeTelemetry:
             c = conn()
             return getattr(c, "connect_failures", 0) if c is not None else 0
 
+        def jittered():
+            c = conn()
+            return getattr(c, "jittered_retries", 0) if c is not None else 0
+
+        reg.gauge(
+            "net_peer_backoff_jitter",
+            "Jittered reconnect retries toward this peer",
+            labels,
+            fn=jittered,
+        )
         reg.gauge(
             "net_peer_queued",
             "Messages queued toward this peer",
@@ -342,6 +365,10 @@ class NodeTelemetry:
                 "connect_failures": sum(
                     getattr(c, "connect_failures", 0) for c in conns
                 ),
+                "jittered_retries": sum(
+                    getattr(c, "jittered_retries", 0) for c in conns
+                )
+                + getattr(s, "jittered_retries", 0),
                 "evictions": getattr(s, "pool_evictions", 0),
             }
             if hasattr(type(s), "pacing_stalls"):

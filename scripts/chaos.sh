@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Run the canned chaos scenarios (docs/FAULTS.md) against a local
+# committee and check the safety/liveness invariants after each.
+#
+#   scripts/chaos.sh                    # all four scenarios, seed 7
+#   scripts/chaos.sh --seed 3 split-brain flapping-link
+#   scripts/chaos.sh --transport native # native reactor instead of asyncio
+#
+# Exits non-zero if ANY scenario fails an invariant.
+set -u
+
+cd "$(dirname "$0")/.."
+
+SEED=7
+TRANSPORT=asyncio
+RATE=400
+EXTRA=()
+SCENARIOS=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --seed)      SEED=$2; shift 2 ;;
+    --transport) TRANSPORT=$2; shift 2 ;;
+    --rate)      RATE=$2; shift 2 ;;
+    --journal)   EXTRA+=(--journal); shift ;;
+    -h|--help)   sed -n '2,9p' "$0"; exit 0 ;;
+    *)           SCENARIOS+=("$1"); shift ;;
+  esac
+done
+if [ ${#SCENARIOS[@]} -eq 0 ]; then
+  SCENARIOS=(split-brain leader-isolation flapping-link rolling-crash-restart)
+fi
+
+FAILED=0
+for scenario in "${SCENARIOS[@]}"; do
+  echo "=== chaos: $scenario (seed $SEED, $TRANSPORT) ==="
+  JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmark chaos \
+    --scenario "$scenario" --seed "$SEED" --transport "$TRANSPORT" \
+    --rate "$RATE" ${EXTRA[@]+"${EXTRA[@]}"} || FAILED=1
+done
+exit $FAILED

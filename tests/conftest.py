@@ -29,3 +29,11 @@ except AttributeError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-scenario committee runs excluded from the tier-1 "
+        "sweep (-m 'not slow')",
+    )

@@ -1,0 +1,194 @@
+"""Chaos-plane end-to-end tests.
+
+The fast smoke (tier-1) runs a REAL 4-node committee in-process under a
+seeded split-brain window and checks the committee-wide invariants on
+the live commit streams: safety throughout, total stall while neither
+half has quorum, and commit resumption after the heal.
+
+The slow tier runs every canned scenario through the full
+``python -m benchmark chaos`` path (subprocess committee + client +
+crash schedule + log-scrape invariant check) on both transports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from benchmark.invariants import check_liveness, check_safety
+
+from .common import async_test, fresh_base_port
+from .test_consensus_e2e import _feed_producers, _shutdown, _spawn_committee
+
+PARTITION_AT = 3.0
+HEAL_AT = 7.0
+
+
+@async_test
+async def test_split_brain_partition_heals_in_process(tmp_path, monkeypatch):
+    """Seeded split-brain on a live in-process committee: commits before
+    the window, a hard stall inside it (2/2 — neither half has quorum),
+    and recovery after the heal, with safety holding end to end."""
+    base = fresh_base_port()
+    epoch = time.time()
+    spec = {
+        "name": "smoke-split-brain",
+        "seed": 7,
+        "epoch_unix": epoch,
+        "nodes": {f"127.0.0.1:{base + i}": i for i in range(4)},
+        "rules": [
+            {
+                "label": "split",
+                "partition": [[0, 1], [2, 3]],
+                "at": PARTITION_AT,
+                "until": HEAL_AT,
+            }
+        ],
+    }
+    monkeypatch.setenv("HOTSTUFF_FAULTS", json.dumps(spec))
+    nodes = await _spawn_committee(tmp_path, base, range(4), timeout_delay=500)
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    records: dict[str, list[tuple[float, int, str]]] = {
+        f"node-{i}": [] for i in range(4)
+    }
+
+    async def collect(i, commit_q):
+        while True:
+            block = await commit_q.get()
+            records[f"node-{i}"].append(
+                (time.time(), block.round, str(block.digest()))
+            )
+
+    collectors = [
+        asyncio.ensure_future(collect(i, commit_q))
+        for i, (_, commit_q, _) in enumerate(nodes)
+    ]
+    try:
+        heal_unix = epoch + HEAL_AT
+        deadline = heal_unix + 25.0
+        recovered = False
+        while time.time() < deadline:
+            ok, _, _ = check_liveness(records, heal_unix=heal_unix)
+            if ok:
+                recovered = True
+                break
+            await asyncio.sleep(0.5)
+
+        every = [obs for commits in records.values() for obs in commits]
+        pre_window = [r for t, r, _ in every if t <= epoch + PARTITION_AT]
+        assert pre_window, "no commits before the partition opened"
+        assert recovered, (
+            "no new rounds committed within 25s of the heal; observed "
+            f"{sorted({r for _, r, _ in every})}"
+        )
+        # the partition actually bit: once in-flight blocks drained,
+        # no NEW round committed until the heal (neither half = quorum)
+        stall_from = epoch + PARTITION_AT + 1.5
+        pre_stall = [r for t, r, _ in every if t <= stall_from]
+        during = [r for t, r, _ in every if stall_from < t <= heal_unix]
+        assert not during or max(during) <= max(pre_stall), (
+            "rounds advanced inside the partition window"
+        )
+        ok, violations = check_safety(records)
+        assert ok, violations
+    finally:
+        for c in collectors:
+            c.cancel()
+        await _shutdown(nodes, feeder)
+
+
+@async_test
+async def test_leader_isolation_heals_in_process(tmp_path, monkeypatch):
+    """Isolate node 0 (leader of round 0 mod 4): the other three keep
+    quorum through the window via view changes; node 0 rejoins after."""
+    base = fresh_base_port()
+    epoch = time.time()
+    spec = {
+        "name": "smoke-isolation",
+        "seed": 3,
+        "epoch_unix": epoch,
+        "nodes": {f"127.0.0.1:{base + i}": i for i in range(4)},
+        "rules": [{"label": "iso", "isolate": 0, "at": 3.0, "until": 6.0}],
+    }
+    monkeypatch.setenv("HOTSTUFF_FAULTS", json.dumps(spec))
+    nodes = await _spawn_committee(tmp_path, base, range(4), timeout_delay=500)
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    records: dict[str, list[tuple[float, int, str]]] = {
+        f"node-{i}": [] for i in range(4)
+    }
+
+    async def collect(i, commit_q):
+        while True:
+            block = await commit_q.get()
+            records[f"node-{i}"].append(
+                (time.time(), block.round, str(block.digest()))
+            )
+
+    collectors = [
+        asyncio.ensure_future(collect(i, commit_q))
+        for i, (_, commit_q, _) in enumerate(nodes)
+    ]
+    try:
+        heal_unix = epoch + 6.0
+        deadline = heal_unix + 25.0
+        while time.time() < deadline:
+            survivors = {k: v for k, v in records.items() if k != "node-0"}
+            ok, _, _ = check_liveness(survivors, heal_unix=heal_unix)
+            # the isolated node must also catch up post-heal
+            if ok and any(t > heal_unix for t, _, _ in records["node-0"]):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            pytest.fail(
+                "committee (or the isolated node) never recovered: "
+                + str({k: len(v) for k, v in records.items()})
+            )
+        ok, violations = check_safety(records)
+        assert ok, violations
+    finally:
+        for c in collectors:
+            c.cancel()
+        await _shutdown(nodes, feeder)
+
+
+# ---- full-harness scenario runs (slow tier) --------------------------------
+
+
+def _run_scenario(tmp_path, monkeypatch, scenario, transport, seed=7):
+    from benchmark.chaos import ChaosBench
+
+    monkeypatch.chdir(tmp_path)
+    bench = ChaosBench(
+        scenario=scenario,
+        seed=seed,
+        nodes=4,
+        rate=400,
+        duration=10.0,  # extended automatically past last heal
+        timeout_delay=1_000,
+        transport=transport,
+    )
+    parser = bench.run()
+    ok, block = bench.check_invariants()
+    assert parser.has_window(), "no commits at all"
+    assert ok, f"invariants failed:\n{block}"
+    assert "Safety (no conflicting commits): PASS" in block
+    assert "Liveness" in block and "PASS" in block
+    return block
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("transport", ["asyncio", "native"])
+@pytest.mark.parametrize(
+    "scenario",
+    ["split-brain", "leader-isolation", "flapping-link",
+     "rolling-crash-restart"],
+)
+def test_canned_scenarios_full_harness(
+    tmp_path, monkeypatch, scenario, transport
+):
+    if transport == "native":
+        pytest.importorskip("hotstuff_tpu.network.native")
+    _run_scenario(tmp_path, monkeypatch, scenario, transport)

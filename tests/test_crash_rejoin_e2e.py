@@ -28,7 +28,7 @@ RE_COMMIT = re.compile(r"Committed block (\d+) -> (\S+)")
 RE_RECOVER = re.compile(r"Recovered consensus state at round (\d+)")
 
 
-def _spawn_node(tmp_path, i, repo_root):
+def _spawn_node(tmp_path, i, repo_root, extra_env=None):
     log = open(tmp_path / f"node_{i}.log", "a")
     return subprocess.Popen(
         [
@@ -48,7 +48,7 @@ def _spawn_node(tmp_path, i, repo_root):
         ],
         stdout=log,
         stderr=subprocess.STDOUT,
-        env={**os.environ, "PYTHONPATH": repo_root},
+        env={**os.environ, "PYTHONPATH": repo_root, **(extra_env or {})},
     )
 
 
@@ -68,8 +68,7 @@ def _wait_commits(tmp_path, i, minimum, deadline_s, baseline=0):
     return False
 
 
-def test_sigkill_node_rejoins_and_commits(tmp_path):
-    base = fresh_base_port()
+def _write_config(tmp_path, base):
     keys = [Secret.new() for _ in range(4)]
     committee = Committee.new(
         [
@@ -84,12 +83,16 @@ def test_sigkill_node_rejoins_and_commits(tmp_path):
     )
     for i, s in enumerate(keys):
         s.write(str(tmp_path / f"key_{i}.json"))
-
     import hotstuff_tpu
 
-    repo_root = os.path.dirname(
+    return os.path.dirname(
         os.path.dirname(os.path.abspath(hotstuff_tpu.__file__))
     )
+
+
+def test_sigkill_node_rejoins_and_commits(tmp_path):
+    base = fresh_base_port()
+    repo_root = _write_config(tmp_path, base)
     procs = {}
     feeder = None
     try:
@@ -143,6 +146,105 @@ def test_sigkill_node_rejoins_and_commits(tmp_path):
         assert common, "no common committed rounds to compare"
         for rnd in common:
             assert c0[rnd] == c3[rnd], f"divergent commit at round {rnd}"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        if feeder is not None and feeder.poll() is None:
+            feeder.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_crash_restart_under_partition(tmp_path):
+    """A crash INSIDE a network partition window: split-brain 0,1|2,3
+    opens at t=6, node 3 is SIGKILLed at t=6 (leaving 2|1 — no quorum
+    anywhere), the partition heals at t=11 (3/4 = quorum resumes), and
+    node 3 restarts at t=12 against its old store.  Safety must hold
+    across every log; everyone commits new rounds after the heal."""
+    import json
+
+    from benchmark.invariants import check_safety
+
+    base = fresh_base_port()
+    repo_root = _write_config(tmp_path, base)
+    epoch = time.time()
+    spec = {
+        "name": "crash-under-partition",
+        "seed": 11,
+        "epoch_unix": epoch,
+        "nodes": {f"127.0.0.1:{base + i}": i for i in range(4)},
+        "rules": [
+            {
+                "label": "split",
+                "partition": [[0, 1], [2, 3]],
+                "at": 6.0,
+                "until": 11.0,
+            }
+        ],
+    }
+    extra_env = {"HOTSTUFF_FAULTS": json.dumps(spec)}
+    procs = {}
+    feeder = None
+    try:
+        for i in range(4):
+            procs[i] = _spawn_node(tmp_path, i, repo_root, extra_env)
+        feeder = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "hotstuff_tpu.node.client",
+                "--committee",
+                str(tmp_path / "committee.json"),
+                "--rate",
+                "200",
+                "--duration",
+                "150",
+                "--warmup",
+                "1",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env={**os.environ, "PYTHONPATH": repo_root},
+        )
+        # clean commits before the window opens at t=6
+        assert _wait_commits(
+            tmp_path, 3, minimum=3, deadline_s=max(0.1, epoch + 6 - time.time())
+        ), "no commits before the partition opened"
+        # crash node 3 just as the partition bites: groups are now 2|1
+        delay = epoch + 6.0 - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        dead_baseline = len(_commits(tmp_path, 3))
+        survivor_baseline = len(_commits(tmp_path, 0))
+        # heal at t=11: {0,1,2} are 3/4 = quorum again and must resume
+        delay = epoch + 11.0 - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        assert _wait_commits(
+            tmp_path, 0, minimum=3, deadline_s=30,
+            baseline=survivor_baseline,
+        ), "survivors never resumed after the heal"
+        # restart node 3 (t>=12, outside every window) on its old store
+        delay = epoch + 12.0 - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        procs[3] = _spawn_node(tmp_path, 3, repo_root, extra_env)
+        assert _wait_commits(
+            tmp_path, 3, minimum=3, deadline_s=40, baseline=dead_baseline
+        ), "restarted node never resumed committing"
+        # committee-wide safety across both of node 3's lifetimes
+        history = {
+            f"node-{i}": [(0.0, int(r), d) for r, d in _commits(tmp_path, i)]
+            for i in range(4)
+        }
+        ok, violations = check_safety(history)
+        assert ok, violations
     finally:
         for p in procs.values():
             if p.poll() is None:

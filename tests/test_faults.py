@@ -1,0 +1,363 @@
+"""Chaos-plane unit tests: spec parsing/sugar, window math, the
+seeded-determinism contract, and the committee-wide invariant checkers
+(including a deliberately UNSAFE toy history that must FAIL safety —
+the checker proving it can catch what it exists to catch).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from benchmark.invariants import (
+    chaos_block,
+    check_liveness,
+    check_safety,
+    commits_from_logs,
+)
+from hotstuff_tpu.faults.plane import (
+    PASS,
+    FaultPlane,
+    FaultRule,
+    corrupt_frame,
+    expand_rules,
+)
+from hotstuff_tpu.faults.scenarios import SCENARIOS, build, last_heal
+
+EPOCH = 1_000_000.0  # injected scenario t=0 (no wall-clock in these tests)
+
+
+def _plane(spec: dict, self_addr="127.0.0.1:9000", nodes=4) -> FaultPlane:
+    spec = dict(spec)
+    spec.setdefault("epoch_unix", EPOCH)
+    spec.setdefault(
+        "nodes", {f"127.0.0.1:{9000 + i}": i for i in range(nodes)}
+    )
+    return FaultPlane(spec, self_addr, now=EPOCH)
+
+
+# ---- primitives ------------------------------------------------------------
+
+
+def test_corrupt_frame_flips_one_byte():
+    data = bytes(range(32))
+    out = corrupt_frame(data)
+    assert len(out) == len(data) and out != data
+    diff = [i for i in range(32) if out[i] != data[i]]
+    assert diff == [16]
+    assert corrupt_frame(b"") == b""
+
+
+def test_rule_window_and_flapping():
+    rule = FaultRule("r", at=5.0, until=11.0, src="*", dst="*", drop=1.0,
+                     every=3.0, for_=1.5)
+    # duty cycle: on for 1.5s of every 3s, inside [5, 11)
+    assert not rule.active(4.9)
+    assert rule.active(5.0) and rule.active(6.4)
+    assert not rule.active(6.5) and not rule.active(7.9)
+    assert rule.active(8.0)
+    assert not rule.active(11.0)
+    assert rule.reps() == [(5.0, 6.5), (8.0, 9.5)]
+
+
+def test_expand_partition_sugar():
+    link, inbound = expand_rules(
+        {"rules": [{"partition": [[0, 1], [2, 3]], "at": 5, "until": 13}]}
+    )
+    assert not inbound
+    assert len(link) == 2
+    crossings = set()
+    for rule in link:
+        assert rule.drop == 1.0
+        for s in rule.src:
+            for d in rule.dst:
+                crossings.add((s, d))
+    # every cross-group directed pair, both directions; no intra-group
+    assert crossings == {
+        (0, 2), (0, 3), (1, 2), (1, 3), (2, 0), (2, 1), (3, 0), (3, 1)
+    }
+
+
+def test_expand_isolate_sugar():
+    link, inbound = expand_rules(
+        {"rules": [{"isolate": 2, "at": 1, "until": 2}]}
+    )
+    assert len(link) == 2 and len(inbound) == 1
+    out_rule = next(r for r in link if r.src != "*")
+    in_rule = next(r for r in link if r.src == "*")
+    assert out_rule.src == frozenset({2}) and out_rule.dst == "*"
+    assert in_rule.dst == frozenset({2})
+    assert inbound[0].matches(0, 2) and not inbound[0].matches(0, 1)
+
+
+# ---- plane resolution ------------------------------------------------------
+
+
+def test_link_resolution_and_fast_path():
+    plane = _plane(
+        {"seed": 3, "rules": [{"from": [0], "to": [1], "drop": 0.5,
+                               "at": 0, "until": 10}]}
+    )
+    assert plane.self_id == 0
+    assert plane.link("127.0.0.1:9001") is not None
+    # no rule ever touches 0->2: the sender gets the None fast path
+    assert plane.link("127.0.0.1:9002") is None
+    # unknown address (a client): never intercepted
+    assert plane.link("127.0.0.1:5555") is None
+
+
+def test_inbound_cut_only_for_isolated_node():
+    spec = {"seed": 0, "rules": [{"isolate": 2, "at": 5, "until": 9}]}
+    isolated = _plane(spec, self_addr="127.0.0.1:9002")
+    other = _plane(spec, self_addr="127.0.0.1:9000")
+    assert not isolated.inbound_cut(now=EPOCH + 4)
+    assert isolated.inbound_cut(now=EPOCH + 6)
+    assert not isolated.inbound_cut(now=EPOCH + 9)
+    assert not other.inbound_cut(now=EPOCH + 6)
+    assert isolated.counts["inbound_dropped"] == 1
+
+
+def test_barrier_during_hard_cut():
+    plane = _plane(
+        {"seed": 0, "rules": [{"partition": [[0, 1], [2, 3]],
+                               "at": 6, "until": 14}]}
+    )
+    link = plane.link("127.0.0.1:9002")
+    assert not link.barrier(now=EPOCH + 5)
+    assert link.barrier(now=EPOCH + 7)
+    assert not link.barrier(now=EPOCH + 14)
+    # decisions inside the window are hard drops
+    assert link.decide(now=EPOCH + 7).drop
+    assert link.decide(now=EPOCH + 20) is PASS
+
+
+# ---- the determinism contract ----------------------------------------------
+
+
+def _spec_probabilistic(seed):
+    return {
+        "seed": seed,
+        "rules": [
+            {"from": [0], "to": [1], "drop": 0.3, "delay_ms": 5,
+             "jitter_pct": 50, "duplicate": 0.2, "corrupt": 0.1,
+             "at": 0, "until": 1e9},
+        ],
+    }
+
+
+def test_same_seed_same_decision_stream():
+    stream = []
+    for _ in range(2):
+        plane = _plane(_spec_probabilistic(seed=42))
+        link = plane.link("127.0.0.1:9001")
+        stream.append([link.decide(now=EPOCH + 1) for _ in range(200)])
+    assert stream[0] == stream[1]
+    # and a different seed diverges (within 200 draws, overwhelmingly)
+    other = _plane(_spec_probabilistic(seed=43)).link("127.0.0.1:9001")
+    assert [other.decide(now=EPOCH + 1) for _ in range(200)] != stream[0]
+
+
+def test_decision_n_is_independent_of_window_state():
+    """decide() always consumes exactly 4 draws, so the n-th decision is
+    the same whether earlier frames fell inside or outside a window —
+    and barrier() consumes none at all."""
+    spec = {
+        "seed": 7,
+        "rules": [{"from": [0], "to": [1], "drop": 0.5, "at": 10,
+                   "until": 1e9}],
+    }
+    a = _plane(spec).link("127.0.0.1:9001")
+    b = _plane(spec).link("127.0.0.1:9001")
+    # a: 50 decisions before the window opens (all PASS), b: 50 inside;
+    # interleave barrier() probes on a to prove they are draw-free
+    for _ in range(50):
+        assert a.decide(now=EPOCH + 1) is PASS
+        a.barrier(now=EPOCH + 1)
+        b.decide(now=EPOCH + 11)
+    tail_a = [a.decide(now=EPOCH + 11) for _ in range(50)]
+    tail_b = [b.decide(now=EPOCH + 11) for _ in range(50)]
+    assert tail_a == tail_b
+    assert a.seq == b.seq == 100
+
+
+def test_per_link_streams_are_independent():
+    spec = {
+        "seed": 9,
+        "rules": [{"from": "*", "to": "*", "drop": 0.5, "at": 0,
+                   "until": 1e9}],
+    }
+    p = _plane(spec)
+    d1 = [p.link("127.0.0.1:9001").decide(now=EPOCH + 1) for _ in range(64)]
+    d2 = [p.link("127.0.0.1:9002").decide(now=EPOCH + 1) for _ in range(64)]
+    assert d1 != d2  # per-directed-link RNG, not a shared stream
+
+
+def test_load_inline_json_and_file(tmp_path):
+    spec = {"name": "x", "seed": 1, "nodes": {"127.0.0.1:9000": 0},
+            "rules": [], "epoch_unix": EPOCH}
+    inline = FaultPlane.load(json.dumps(spec), "127.0.0.1:9000", now=EPOCH)
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec))
+    from_file = FaultPlane.load(str(path), ("127.0.0.1", 9000), now=EPOCH)
+    assert inline.self_id == from_file.self_id == 0
+    assert inline.name == from_file.name == "x"
+
+
+def test_stale_epoch_falls_back_to_boot():
+    spec = {"seed": 0, "nodes": {"127.0.0.1:9000": 0}, "rules": [],
+            "epoch_unix": EPOCH - 10_000}
+    plane = FaultPlane(spec, "127.0.0.1:9000", now=EPOCH)
+    assert plane.epoch == EPOCH
+
+
+def test_window_edges_dedup_and_flapping():
+    plane = _plane(
+        {"seed": 0, "rules": [{"partition": [[0, 1], [2, 3]],
+                               "at": 6, "until": 14, "label": "p"}]}
+    )
+    # partition sugar expands to 2 rules; edges dedup to one open/close
+    assert plane.window_edges() == [(6.0, "open", "p"), (14.0, "close", "p")]
+    flappy = _plane(build("flapping-link", seed=0))
+    edges = flappy.window_edges()
+    # 12s window, one rep every 3s, per direction label
+    opens = [e for e in edges if e[1] == "open" and e[2] == "flap-0-1"]
+    assert len(opens) == 4
+
+
+# ---- canned scenarios ------------------------------------------------------
+
+
+def test_all_scenarios_build_and_heal():
+    for name in SCENARIOS:
+        spec = build(name, nodes=4, seed=7)
+        assert spec["seed"] == 7 and spec["name"] == name
+        heal = last_heal(spec)
+        assert 0 < heal < math.inf
+        assert spec["liveness"]["resume_within_s"] > 0
+        # every scenario resolves to a working plane for node 0
+        plane = _plane(spec, self_addr="127.0.0.1:9000")
+        assert plane.self_id == 0
+
+
+def test_build_unknown_scenario():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown scenario"):
+        build("no-such-thing")
+
+
+def test_last_heal_unbounded():
+    assert math.isinf(
+        last_heal({"rules": [{"from": [0], "to": [1], "drop": 1.0,
+                              "at": 5}]})
+    )
+    assert math.isinf(
+        last_heal({"rules": [], "crashes": [{"node": 1, "at": 3}]})
+    )
+    # a delay-only rule with no `until` still never heals
+    assert last_heal({"rules": [{"from": [0], "to": [1], "delay_ms": 10,
+                                 "at": 0, "until": 4}]}) == 4.0
+
+
+# ---- invariants ------------------------------------------------------------
+
+
+def test_safety_passes_on_consistent_history():
+    ok, violations = check_safety({
+        "node-0": [(10.0, 1, "A"), (11.0, 2, "B")],
+        "node-1": [(10.1, 1, "A"), (11.2, 2, "B")],
+        # a restart legitimately RE-commits the same block
+        "node-2": [(10.0, 1, "A"), (15.0, 1, "A"), (15.1, 2, "B")],
+    })
+    assert ok and not violations
+
+
+def test_safety_fails_on_unsafe_toy_history():
+    """The demonstrated-FAIL case: two halves of a (hypothetically
+    broken) committee commit DIFFERENT blocks at the same round — the
+    checker must flag it, or every PASS it prints is meaningless."""
+    ok, violations = check_safety({
+        "node-0": [(10.0, 5, "AAAA")],
+        "node-1": [(10.0, 5, "AAAA")],
+        "node-2": [(10.2, 5, "ZZZZ")],
+        "node-3": [(10.2, 5, "ZZZZ")],
+    })
+    assert not ok
+    assert any("conflicting commits at round 5" in v for v in violations)
+    # single-node equivocation is also flagged
+    ok, violations = check_safety({"node-0": [(1.0, 3, "A"), (2.0, 3, "B")]})
+    assert not ok and "two blocks" in violations[0]
+
+
+def test_liveness_bounds():
+    history = {
+        "node-0": [(100.0, 1, "A"), (120.0, 9, "B")],
+        "node-1": [(100.1, 1, "A"), (120.5, 9, "B")],
+    }
+    ok, _, details = check_liveness(history, heal_unix=110.0,
+                                    resume_within_s=15.0, max_round_gap=50)
+    assert ok and abs(details["resumed_after_s"] - 10.0) < 1e-6
+    assert details["round_gap"] == 8
+    ok, violations, _ = check_liveness(history, heal_unix=110.0,
+                                       resume_within_s=5.0)
+    assert not ok and "resumed" in violations[0]
+    ok, violations, _ = check_liveness(history, heal_unix=110.0,
+                                       resume_within_s=15.0, max_round_gap=4)
+    assert not ok and "round gap" in violations[0]
+    ok, violations, _ = check_liveness(history, heal_unix=130.0)
+    assert not ok and "no new rounds" in violations[0]
+    ok, violations, _ = check_liveness({}, heal_unix=0.0)
+    assert not ok and "no commits" in violations[0]
+
+
+def test_chaos_block_rendering():
+    block = chaos_block("split-brain", 7, True, [], True, [],
+                        {"resumed_after_s": 2.5, "round_gap": 12},
+                        heal_rel=14.0)
+    assert " + CHAOS:" in block
+    assert "Scenario: split-brain (seed 7)" in block
+    assert "Safety (no conflicting commits): PASS" in block
+    assert "resumed 2.5s after heal, round gap 12" in block
+    block = chaos_block("x", 0, False, ["boom"], None, [], {})
+    assert "FAIL" in block and "! boom" in block
+    assert "n/a (scenario never heals)" in block
+
+
+def test_commits_from_logs(tmp_path):
+    (tmp_path / "node-0.log").write_text(
+        "2026-01-01T00:00:01.000Z [INFO] core Committed block 2 -> BLK1\n"
+        "2026-01-01T00:00:02.000Z [INFO] core Committed block 3 -> BLK2\n"
+    )
+    (tmp_path / "node-1.log").write_text(
+        "2026-01-01T00:00:01.500Z [INFO] core Committed block 2 -> BLK1\n"
+    )
+    commits = commits_from_logs(str(tmp_path))
+    assert set(commits) == {"node-0", "node-1"}
+    assert [(r, d) for _, r, d in commits["node-0"]] == [
+        (2, "BLK1"), (3, "BLK2")
+    ]
+    ok, _ = check_safety(commits)
+    assert ok
+
+
+# ---- the chaos runner (config only; full runs live in the slow tier) -------
+
+
+def test_chaos_bench_extends_duration_to_cover_heal(monkeypatch, tmp_path):
+    from benchmark.chaos import BOOT_MARGIN_S, ChaosBench
+
+    monkeypatch.chdir(tmp_path)
+    bench = ChaosBench(scenario="split-brain", seed=7, duration=5.0)
+    spec = bench.spec
+    need = last_heal(spec) + spec["liveness"]["resume_within_s"] + 4.0
+    assert bench.duration == need
+    # config writes the spec with the committee map and a future epoch
+    bench._config()
+    assert "HOTSTUFF_FAULTS" in bench.extra_env
+    with open(bench.extra_env["HOTSTUFF_FAULTS"]) as f:
+        written = json.load(f)
+    assert written["nodes"] == {
+        f"127.0.0.1:{bench.base_port + i}": i for i in range(4)
+    }
+    assert written["epoch_unix"] == bench._epoch
+    assert bench._epoch > written["epoch_unix"] - BOOT_MARGIN_S - 1

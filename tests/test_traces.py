@@ -257,6 +257,59 @@ def test_uncorrected_skew_would_dominate():
     assert mean(ts.edge_gaps()["propose_to_recv"]) < 5.0
 
 
+# ---- producer edges and chaos-plane spans --------------------------------
+
+
+def test_producer_waits_and_fault_spans():
+    """Synthetic journal exercising the PR 3 record kinds: the
+    recv.producer -> payload.first wait lands in payload_waits, and
+    fault.open/close edges pair into labelled spans (a never-closed
+    window survives with end=None and stretches to the horizon in the
+    Perfetto export)."""
+    s = 1_000_000_000  # 1 s in ns
+    recs = [
+        _rec("recv.producer", d="PAY1000000000000", p="client", m=s, w=s),
+        _rec("payload.first", 3, "PAY1000000000000", m=s + s // 4, w=s + s // 4),
+        # payload.first with no matching producer record: ignored
+        _rec("payload.first", 4, "PAY2000000000000", m=2 * s, w=2 * s),
+        _rec("fault.open", p="split", m=3 * s, w=3 * s),
+        _rec("fault.close", p="split", m=8 * s, w=8 * s),
+        # close without a prior open for that label: ignored
+        _rec("fault.close", p="ghost", m=8 * s, w=8 * s),
+        _rec("fault.open", p="flap", m=9 * s, w=9 * s),
+        # an anchor block so the summary/export paths see real traffic
+        _rec("propose", 5, "blk5000000000000", m=10 * s, w=10 * s),
+        _rec("commit", 5, "blk5000000000000", m=11 * s, w=11 * s),
+    ]
+    ts = TraceSet({"A": recs})
+    assert ts.payload_waits == pytest.approx([250.0])
+    assert ts.fault_spans == [
+        ("split", 3 * s, 8 * s),
+        ("flap", 9 * s, None),
+    ]
+    text = ts.summary()
+    assert "producer recv -> proposed" in text
+    assert "mean  250.00 ms" in text
+    assert "Fault windows journaled: 2 (flap, split)" in text
+
+    doc = ts.chrome_trace()
+    chaos = [e for e in doc["traceEvents"] if e.get("cat") == "fault"]
+    assert {e["name"] for e in chaos} == {"split", "flap"}
+    by_name = {e["name"]: e for e in chaos}
+    assert by_name["split"]["args"]["closed"] is True
+    assert by_name["split"]["dur"] == pytest.approx(5e6)  # 5 s in us
+    # the open window runs to the horizon (the 11 s commit anchor is
+    # not a span anchor; the last anchor is the 10 s propose)
+    assert by_name["flap"]["args"]["closed"] is False
+    assert by_name["flap"]["dur"] == pytest.approx(1e6)
+    tracks = [
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("name") == "process_name"
+    ]
+    assert "chaos plane" in tracks
+
+
 # ---- golden Perfetto export ---------------------------------------------
 
 
