@@ -37,6 +37,7 @@ def task_local(args) -> int:
         payload_homes=args.payload_homes,
         no_claim_dedup=args.no_claim_dedup,
         journal=args.journal,
+        profile=args.profile,
     )
     if args.wait_weather is not None:
         bench.wait_weather(threshold_ms=args.wait_weather)
@@ -145,6 +146,32 @@ def task_traces(args) -> int:
     return 0
 
 
+def task_profile(args) -> int:
+    """Span-level verify-pipeline waterfall (benchmark/profile.py):
+    QC-shaped claim waves through the production dispatch path with the
+    profiler on, per-stage p50/p99 + %-of-e2e SUMMARY per batch size."""
+    from .profile import format_waterfall, run_profile
+
+    result = run_profile(
+        sizes=tuple(int(s) for s in args.sizes.split(",")),
+        waves=args.waves,
+        verifier=args.verifier,
+        route=args.route,
+        capture_dir=args.capture,
+    )
+    print(format_waterfall(result))
+    worst = min(
+        (res["coverage_pct"] for res in result["sizes"].values()),
+        default=0.0,
+    )
+    if worst < 90.0:
+        Print.warn(
+            f"waterfall coverage {worst:.1f}% < 90% — a pipeline stage "
+            "is missing instrumentation for this route"
+        )
+    return 0
+
+
 def task_tpu(args) -> int:
     """Committee sweep with the TPU crypto backend, co-located on this
     host (one TPU VM)."""
@@ -208,6 +235,8 @@ def task_remote_bench(args) -> int:
         runs=args.runs,
         faults=args.faults,
         verifier=args.verifier,
+        journal=args.journal,
+        profile=args.profile,
     )
     return 0
 
@@ -352,6 +381,13 @@ def main(argv=None) -> int:
         "(journals under logs/journals/, Chrome trace in logs/trace.json)",
     )
     p.add_argument(
+        "--profile",
+        action="store_true",
+        help="verify-pipeline span profiler on in every node "
+        "(HOTSTUFF_PROFILE); combine with --journal to get the "
+        "'verify pipeline' track in logs/trace.json",
+    )
+    p.add_argument(
         "--no-claim-dedup",
         action="store_true",
         help="give every core a PRIVATE verify service (no cross-core "
@@ -419,6 +455,35 @@ def main(argv=None) -> int:
     )
     p.set_defaults(fn=task_tpu)
 
+    p = sub.add_parser(
+        "profile",
+        help="verify-pipeline span waterfall: where a QC verify wave's "
+        "wall time goes, stage by stage (docs/TELEMETRY.md)",
+    )
+    p.add_argument("--sizes", default="16,64,256", help="QC sizes to profile")
+    p.add_argument("--waves", type=int, default=20)
+    p.add_argument(
+        "--verifier",
+        choices=["cpu", "tpu", "tpu-sharded"],
+        default="tpu",
+    )
+    p.add_argument(
+        "--route",
+        choices=["device", "auto"],
+        default="device",
+        help="device = pin warmed-up waves to the device "
+        "(HOTSTUFF_FORCE_DEVICE_ROUTE); auto = adaptive cost-model "
+        "routing as in production",
+    )
+    p.add_argument(
+        "--capture",
+        default=None,
+        metavar="DIR",
+        help="wrap the largest size's waves in jax.profiler.trace(DIR) "
+        "for XLA-op-level inspection",
+    )
+    p.set_defaults(fn=task_profile)
+
     p = sub.add_parser("scaling")
     p.add_argument("--sizes", default="4,8,16,32")
     p.add_argument("--rate", type=int, default=1_000)
@@ -478,6 +543,18 @@ def main(argv=None) -> int:
         "--verifier",
         choices=["cpu", "tpu", "tpu-sharded"],
         default="tpu",
+    )
+    p.add_argument(
+        "--journal",
+        action="store_true",
+        help="flight recorder on in every remote node; journal dirs are "
+        "pulled per host and merged before the cross-node trace",
+    )
+    p.add_argument(
+        "--profile",
+        action="store_true",
+        help="verify-pipeline span profiler on in every remote node "
+        "(spans land in the pulled journals when --journal is also set)",
     )
     p.set_defaults(fn=task_remote_bench)
 

@@ -47,6 +47,7 @@ class LocalBench:
         payload_homes: int = 1,
         no_claim_dedup: bool = False,
         journal: bool = False,
+        profile: bool = False,
     ):
         self.nodes = nodes
         self.rate = rate
@@ -77,6 +78,10 @@ class LocalBench:
         # journal=True: flight recorder on in every node (JSONL ring
         # segments under logs/journals/, merged by benchmark/traces.py)
         self.journal = journal
+        # profile=True: verify-pipeline span profiler on in every node;
+        # with journal also on, the spans land in the journals and the
+        # merged trace grows a "verify pipeline" track per node process
+        self.profile = profile
         # in_process=True: the whole committee co-locates in ONE node
         # process (`run-many`, the reference's in-process testbed shape,
         # main.rs:102-148).  On a host with fewer cores than nodes the
@@ -169,6 +174,8 @@ class LocalBench:
             wan_env["HOTSTUFF_JOURNAL_DIR"] = os.path.abspath(
                 PathMaker.journals_path()
             )
+        if self.profile:
+            wan_env["HOTSTUFF_PROFILE"] = "1"
         proc = subprocess.Popen(
             cmd,
             stdout=f,

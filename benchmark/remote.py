@@ -59,6 +59,15 @@ class RemoteBench:
             + [f"{name}:{remote}", local, f"--zone={s.zone}"]
         )
 
+    def _download_dir(self, name: str, remote: str, local: str) -> None:
+        """Recursive scp (journal directories hold one ring segment per
+        node process, names unknown to the driver)."""
+        s = self.settings
+        self._runner(
+            list(s.scp_command)
+            + ["--recurse", f"{name}:{remote}", local, f"--zone={s.zone}"]
+        )
+
     # ---- lifecycle ---------------------------------------------------------
 
     def install(self) -> None:
@@ -145,10 +154,20 @@ class RemoteBench:
         duration: float,
         faults: int,
         verifier: str,
+        journal: bool = False,
+        profile: bool = False,
     ) -> None:
         """Boot clients then nodes in detached remote shells
         (reference remote.py:177-219)."""
         repo = self.settings.repo_name
+        # flight recorder / span profiler ride on the node CLI flags so
+        # the remote env stays untouched; journal dir is repo-relative
+        # (the node cmd below cd's into the repo first)
+        tel_flags = ""
+        if journal:
+            tel_flags += " --journal-dir logs/journals"
+        if profile:
+            tel_flags += " --profile"
         # Detached-launch shape matters: `mkdir && cd && nohup CMD &`
         # backgrounds the ENTIRE and-list, so the background shell's own
         # un-redirected stdout/stderr keep the ssh channel open until
@@ -168,6 +187,7 @@ class RemoteBench:
                 f" --store .db_{i}"
                 f" --parameters {PathMaker.parameters_file()}"
                 f" --verifier {verifier}"
+                f"{tel_flags}"
                 f" ) > {repo}/logs/node-{i}.log 2>&1 < /dev/null &"
             )
             self._ssh(host["name"], node_cmd)
@@ -201,6 +221,42 @@ class RemoteBench:
         )
         return LogParser.process(PathMaker.logs_path())
 
+    def _journals(self, hosts: list[dict], nodes: int, faults: int) -> int:
+        """Pull every live host's journal directory BEFORE the trace
+        merge, staging per host (``logs/journals-<host>``) then merging
+        the ring segments into ``logs/journals/`` for TraceSet.load.
+        Segment filenames embed the sanitized node id, which is unique
+        committee-wide, so the merge is a flat copy.  Returns the number
+        of segments merged."""
+        import glob
+
+        merged_dir = PathMaker.journals_path()
+        shutil.rmtree(merged_dir, ignore_errors=True)
+        os.makedirs(merged_dir, exist_ok=True)
+        repo = self.settings.repo_name
+        merged = 0
+        live = {hosts[i % len(hosts)]["name"] for i in range(nodes - faults)}
+        for name in sorted(live):
+            staging = os.path.join(
+                PathMaker.logs_path(), f"journals-{name}"
+            )
+            shutil.rmtree(staging, ignore_errors=True)
+            os.makedirs(staging, exist_ok=True)
+            try:
+                self._download_dir(name, f"{repo}/logs/journals", staging)
+            except Exception as e:  # noqa: BLE001 — a host that died
+                Print.warn(  # mid-run has no journals; merge the rest
+                    f"no journals from {name}: {e}"
+                )
+                continue
+            # scp --recurse lands the dir itself under staging/
+            for seg in glob.glob(
+                os.path.join(staging, "**", "*.jsonl"), recursive=True
+            ):
+                shutil.copy(seg, merged_dir)
+                merged += 1
+        return merged
+
     def run(
         self,
         nodes_list: list[int],
@@ -209,6 +265,8 @@ class RemoteBench:
         runs: int = 1,
         faults: int = 0,
         verifier: str = "tpu",
+        journal: bool = False,
+        profile: bool = False,
     ) -> None:
         """The sweep driver (reference remote.py:237-298)."""
         hosts = [h for h in self.manager.hosts() if h["state"] == "READY"]
@@ -226,7 +284,8 @@ class RemoteBench:
                     self.kill()
                     self._config(hosts, nodes)
                     self._run_single(
-                        hosts, nodes, rate, duration, faults, verifier
+                        hosts, nodes, rate, duration, faults, verifier,
+                        journal=journal, profile=profile,
                     )
                     time.sleep(duration + 20)
                     self.kill()
@@ -234,6 +293,27 @@ class RemoteBench:
                     summary = parser.result(
                         faults=faults, nodes=nodes, verifier=verifier
                     )
+                    if journal:
+                        n_segs = self._journals(hosts, nodes, faults)
+                        if n_segs:
+                            from .traces import TraceSet
+
+                            traces = TraceSet.load(
+                                PathMaker.journals_path()
+                            )
+                            summary += traces.summary()
+                            out = traces.export_chrome_trace(
+                                PathMaker.trace_file()
+                            )
+                            Print.info(
+                                f"Merged {n_segs} journal segments; "
+                                f"trace written to {out}"
+                            )
+                        else:
+                            Print.warn(
+                                "journaling requested but no segments "
+                                "downloaded"
+                            )
                     print(summary)
                     save_result(summary, faults, nodes, rate, verifier,
                                 ok=parser.has_window())

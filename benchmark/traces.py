@@ -175,6 +175,10 @@ class TraceSet:
         # taken from the node that journaled the most fault edges (every
         # node journals the same scenario schedule)
         self.fault_spans: list[tuple[str, int, int | None]] = []
+        # verify-pipeline profiler spans (ISSUE 4): node -> list of
+        # (stage, w_end_corr, dur_ns).  A span record's timestamps mark
+        # the span's END; its duration rides in the "u" field.
+        self.verify_spans: dict[str, list[tuple[str, int, int]]] = {}
         self._reconstruct()
 
     @classmethod
@@ -218,6 +222,15 @@ class TraceSet:
                     got = producer_seen.get(r["d"])
                     if got is not None:
                         self.payload_waits.append((r["m"] - got) / 1e6)
+                    continue
+                if e == "span":
+                    # profiler record: stage name in "p", duration in
+                    # "u"; must not reach _block (d is empty)
+                    dur = r.get("u")
+                    if dur is not None:
+                        self.verify_spans.setdefault(node, []).append(
+                            (r["p"], self._corr(node, r["w"]), int(dur))
+                        )
                     continue
                 if e in ("fault.open", "fault.close"):
                     fault_edges.append(
@@ -398,6 +411,21 @@ class TraceSet:
                 f" Fault windows journaled: {len(self.fault_spans)}"
                 f" ({shown})\n"
             )
+        if self.verify_spans:
+            total: Counter = Counter()
+            count = 0
+            for rows in self.verify_spans.values():
+                count += len(rows)
+                for stage, _w, dur in rows:
+                    total[stage] += dur
+            top = ", ".join(
+                f"{stage} {ns / 1e6:.1f} ms"
+                for stage, ns in total.most_common(3)
+            )
+            lines.append(
+                f" Verify-pipeline spans journaled: {count}"
+                f" (busiest stages: {top})\n"
+            )
         return "".join(lines)
 
     # ---- Perfetto export ---------------------------------------------------
@@ -429,6 +457,9 @@ class TraceSet:
         anchors.extend(w for _, w in self.timeouts.values())
         anchors.extend(w for _, w, _ in self.fault_spans)
         anchors.extend(w for _, _, w in self.fault_spans if w is not None)
+        for rows in self.verify_spans.values():
+            # a span's start = its end stamp minus its duration
+            anchors.extend(w - dur for _, w, dur in rows)
         if not anchors:
             return {"traceEvents": events, "displayTimeUnit": "ms"}
         base = min(anchors)
@@ -545,6 +576,36 @@ class TraceSet:
                         "ts": us(w_open),
                         "dur": max(1.0, us(end) - us(w_open)),
                         "args": {"label": label, "closed": w_close is not None},
+                    }
+                )
+        for node, rows in sorted(self.verify_spans.items()):
+            # verify-pipeline profiler track (ISSUE 4): one thread lane
+            # under the journaling node's process, so the dispatch
+            # waterfall lines up against the same node's consensus
+            # rounds on the shared timeline
+            pid = pid_of.get(node)
+            if pid is None:
+                continue
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 1,
+                    "args": {"name": "verify pipeline"},
+                }
+            )
+            for stage, w_end, dur in rows:
+                events.append(
+                    {
+                        "name": stage,
+                        "cat": "verify",
+                        "ph": "X",
+                        "pid": pid,
+                        "tid": 1,
+                        "ts": us(w_end - dur),
+                        "dur": max(0.1, dur / 1e3),
+                        "args": {"stage": stage, "dur_ms": dur / 1e6},
                     }
                 )
         return {"traceEvents": events, "displayTimeUnit": "ms"}

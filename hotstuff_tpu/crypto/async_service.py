@@ -48,6 +48,7 @@ import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..telemetry import spans as _spans
 from .digest import DIGEST_SIZE
 from .native_ed25519 import NATIVE_BATCH_MIN
 
@@ -106,36 +107,41 @@ def eval_claims_sync(backend, claims: list) -> list[bool]:
     aggregate check when it prefers one (BLS); otherwise everything
     flattens into a single ``verify_many`` batch."""
     if getattr(backend, "prefers_aggregate", False):
-        from .digest import Digest
-        from .keys import PublicKey
-        from .signature import Signature
+        with _spans.span("agg.verify"):
+            from .digest import Digest
+            from .keys import PublicKey
+            from .signature import Signature
 
-        out: list[bool] = []
-        singles: list[tuple[int, tuple]] = []
-        for claim in claims:
-            if claim[0] == "shared":
-                votes = [
-                    (PublicKey(pk), Signature(sig)) for pk, sig in claim[2]
-                ]
-                # zero signatures prove nothing (see flatten path below)
-                out.append(
-                    bool(votes)
-                    and bool(backend.verify_shared_msg(Digest(claim[1]), votes))
+            out: list[bool] = []
+            singles: list[tuple[int, tuple]] = []
+            for claim in claims:
+                if claim[0] == "shared":
+                    votes = [
+                        (PublicKey(pk), Signature(sig))
+                        for pk, sig in claim[2]
+                    ]
+                    # zero signatures prove nothing (flatten path below)
+                    out.append(
+                        bool(votes)
+                        and bool(
+                            backend.verify_shared_msg(Digest(claim[1]), votes)
+                        )
+                    )
+                else:
+                    singles.append((len(out), claim))
+                    out.append(False)  # placeholder
+            if singles:
+                ok = backend.verify_many(
+                    [c[1] for _, c in singles],
+                    [c[2] for _, c in singles],
+                    [c[3] for _, c in singles],
                 )
-            else:
-                singles.append((len(out), claim))
-                out.append(False)  # placeholder
-        if singles:
-            ok = backend.verify_many(
-                [c[1] for _, c in singles],
-                [c[2] for _, c in singles],
-                [c[3] for _, c in singles],
-            )
-            for (pos, _), valid in zip(singles, ok):
-                out[pos] = bool(valid)
-        return out
+                for (pos, _), valid in zip(singles, ok):
+                    out[pos] = bool(valid)
+            return out
 
-    digests, pks, sigs, spans = flatten_claims(claims)
+    with _spans.span("flatten"):
+        digests, pks, sigs, spans = flatten_claims(claims)
     if not digests:
         # every claim here is an empty "shared" (zero members): a
         # certificate with no signatures proves nothing — vacuous truth
@@ -155,14 +161,16 @@ def eval_claims_sync(backend, claims: list) -> list[bool]:
     ):
         from . import native_ed25519
 
-        if native_ed25519.available() and native_ed25519.batch_verify(
-            b"".join(digests),
-            DIGEST_SIZE,
-            b"".join(pks),
-            b"".join(sigs),
-            len(digests),
-            shared=False,
-        ):
+        with _spans.span("host.verify"):
+            fast_ok = native_ed25519.available() and native_ed25519.batch_verify(
+                b"".join(digests),
+                DIGEST_SIZE,
+                b"".join(pks),
+                b"".join(sigs),
+                len(digests),
+                shared=False,
+            )
+        if fast_ok:
             return [e > s for s, e in spans]
     ok = backend.verify_many(digests, pks, sigs)
     return [all(ok[s:e]) if e > s else False for s, e in spans]
@@ -193,10 +201,11 @@ class AsyncVerifyService:
         # class counter at 1, and the parser sums the last line per tag)
         import os
 
-        self._stats_tag = (
-            f"{getattr(backend, 'async_kind', None) or getattr(backend, 'name', 'cpu')}"
-            f"#{os.getpid()}.{AsyncVerifyService._serial}"
+        kind = getattr(backend, "async_kind", None) or getattr(
+            backend, "name", "cpu"
         )
+        self._backend_kind = kind
+        self._stats_tag = f"{kind}#{os.getpid()}.{AsyncVerifyService._serial}"
         # For inline services ``backend`` is the VerifierBackend itself.
         # For device services it is the HOST (node.LazyDeviceVerifier):
         # ``host.device_ready`` gates routing (never materialize jax or
@@ -205,6 +214,10 @@ class AsyncVerifyService:
         self.backend = backend
         self.device = device
         self._pending: list[tuple[list, asyncio.Future]] = []
+        # profiling: perf_counter_ns stamps of device-path submissions in
+        # the current coalescing window (empty unless HOTSTUFF_PROFILE)
+        self._arrivals: list[int] = []
+        self._worker_end_ns: int | None = None
         self._task: asyncio.Task | None = None
         self._executor: ThreadPoolExecutor | None = None
         # adaptive routing state
@@ -226,11 +239,15 @@ class AsyncVerifyService:
         self._tel_claims_unique = None
         self._tel_device_wall = None
         self._tel_host_wall = None
+        self._tel_route = None
         from .. import telemetry
 
         if telemetry.enabled():
             reg = telemetry.registry()
-            labels = {"svc": self._stats_tag}
+            # the backend label keeps multi-backend runs (cpu + tpu + bls
+            # services in one process) from aliasing into one series when
+            # dashboards aggregate away the per-instance svc tag
+            labels = {"svc": self._stats_tag, "backend": kind}
             self._tel_claims_submitted = reg.counter(
                 "verify_claims_submitted",
                 "Verification claims submitted (pre-dedup, all cores)",
@@ -257,6 +274,14 @@ class AsyncVerifyService:
                 "Wall seconds spent in host (CPU) claim evaluation",
                 labels,
             )
+            self._tel_route = {
+                r: reg.counter(
+                    "verify_route",
+                    "Dispatch waves by routing decision",
+                    {**labels, "route": r},
+                )
+                for r in ("device", "cpu", "probe")
+            }
             reg.gauge(
                 "verify_pending_batches",
                 "Submissions queued for the next dispatch wave",
@@ -352,6 +377,8 @@ class AsyncVerifyService:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._pending.append((claims, fut))
+        if _spans.recorder() is not None:
+            self._arrivals.append(time.perf_counter_ns())
         if self._task is None or self._task.done():
             # the dispatcher task drains all pending batches then exits —
             # no long-lived task to leak across loops or shutdowns
@@ -385,6 +412,12 @@ class AsyncVerifyService:
             return "cpu"
         if self._device_busy:
             return "cpu"
+        if os.environ.get("HOTSTUFF_FORCE_DEVICE_ROUTE"):
+            # profiling knob (benchmark profile --route device): pin
+            # warmed-up waves to the device so the waterfall measures the
+            # dispatch pipeline, not the cost-model's mood — gated AFTER
+            # the readiness/busy checks, which stay load-bearing
+            return "device"
         if getattr(self.backend, "always_offload", False):
             # backends whose offload frees the loop unconditionally
             # (BLS native pairings: ctypes releases the GIL) — no
@@ -423,7 +456,12 @@ class AsyncVerifyService:
                 max_workers=1, thread_name_prefix="verify"
             )
         self._device_busy = True
-        fut = loop.run_in_executor(self._executor, self._dispatch_sync, claims)
+        t_spawn = (
+            time.perf_counter_ns() if _spans.recorder() is not None else None
+        )
+        fut = loop.run_in_executor(
+            self._executor, self._dispatch_sync, claims, t_spawn
+        )
 
         def _done(f):
             self._device_busy = False
@@ -436,13 +474,24 @@ class AsyncVerifyService:
         fut.add_done_callback(_done)
         return fut
 
-    def _dispatch_sync(self, claims: list) -> list[bool]:
+    def _dispatch_sync(self, claims: list, t_spawn: int | None = None) -> list[bool]:
         """Worker-thread body: evaluate on the forced-device dispatch
         view, timing the dispatch for the routing EWMA."""
+        rec = _spans.recorder()
+        if rec is not None:
+            t_enter = time.perf_counter_ns()
+            if t_spawn is not None:
+                # executor handoff -> worker entry (thread wakeup + any
+                # queueing behind a previous dispatch)
+                rec.add("queue.wait", t_spawn, t_enter - t_spawn)
         target = getattr(self.backend, "async_backend", self.backend)
         t0 = time.perf_counter()
         out = eval_claims_sync(target, claims)
         wall = time.perf_counter() - t0
+        if rec is not None:
+            end_ns = time.perf_counter_ns()
+            rec.add("dispatch.wall", t_enter, end_ns - t_enter)
+            self._worker_end_ns = end_ns
         if self._tel_device_wall is not None:
             self._tel_device_wall.add(wall)
         ewma = self._device_ewma_s
@@ -460,8 +509,18 @@ class AsyncVerifyService:
             await asyncio.sleep(0)
             await asyncio.sleep(0)
             batch, self._pending = self._pending, []
+            arrivals, self._arrivals = self._arrivals, []
             if not batch:
                 return  # drained — the next submit respawns the task
+            rec = _spans.recorder()
+            self._worker_end_ns = None  # per-wave; set by _dispatch_sync
+            wave_t0 = min(arrivals) if (rec is not None and arrivals) else None
+            if wave_t0 is not None:
+                rec.add(
+                    "coalesce.wait",
+                    wave_t0,
+                    time.perf_counter_ns() - wave_t0,
+                )
             # Deduplicate identical claims across submissions: a claim's
             # verdict is a PURE function of (digest, pk, sig) bytes, so
             # one evaluation serves every submitter — in a co-located
@@ -510,7 +569,10 @@ class AsyncVerifyService:
                     await asyncio.sleep(0)
 
             try:
-                route = self._route_device(n_sigs)
+                with _spans.span("route.decide"):
+                    route = self._route_device(n_sigs)
+                if self._tel_route is not None:
+                    self._tel_route[route].inc()
                 if route == "probe":
                     # measurement-only device dispatch: results are
                     # discarded (EWMA updates when it lands); the batch
@@ -544,11 +606,21 @@ class AsyncVerifyService:
                             deadline * 1e3,
                         )
                         await serve_cpu(batch)
+                        if wave_t0 is not None:
+                            rec.add(
+                                "e2e",
+                                wave_t0,
+                                time.perf_counter_ns() - wave_t0,
+                            )
                         self._log_stats()
                         continue
                 else:
                     self.cpu_sigs += n_sigs
                     await serve_cpu(batch)
+                    if wave_t0 is not None:
+                        rec.add(
+                            "e2e", wave_t0, time.perf_counter_ns() - wave_t0
+                        )
                     self._log_stats()
                     continue
             except asyncio.CancelledError:
@@ -566,9 +638,18 @@ class AsyncVerifyService:
                         )
                 continue
             verdict = dict(zip(claims, results))
+            fan_t0 = self._worker_end_ns if rec is not None else None
             for cs, fut in batch:
                 if not fut.done():
                     fut.set_result([verdict[c] for c in cs])
+            if rec is not None:
+                end_ns = time.perf_counter_ns()
+                if fan_t0 is not None:
+                    # worker completion -> every waiter's future resolved
+                    # (captures the executor -> loop wakeup gap)
+                    rec.add("verdict.fanout", fan_t0, end_ns - fan_t0)
+                if wave_t0 is not None:
+                    rec.add("e2e", wave_t0, end_ns - wave_t0)
             self._log_stats()
 
     def _log_stats(self) -> None:

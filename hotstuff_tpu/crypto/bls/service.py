@@ -15,6 +15,7 @@ crypto/src/lib.rs:232-257; BASELINE config 5's threshold variant uses
 
 from __future__ import annotations
 
+from ...telemetry import spans as _spans
 from . import (
     BlsPublicKey,
     BlsSecretKey,
@@ -220,9 +221,10 @@ class BlsVerifier:
             if agg_sig is None:
                 return False
             agg_pk = aggregate_public_keys(pubs)
-            return self._native.verify_one(
-                msg, agg_pk.to_bytes(), agg_sig, check_pk_subgroup=False
-            )
+            with _spans.span("host.pairing"):
+                return self._native.verify_one(
+                    msg, agg_pk.to_bytes(), agg_sig, check_pk_subgroup=False
+                )
         pks, sig_points = [], []
         for pk, sig in votes:
             pub = self._pk(pk if isinstance(pk, bytes) else pk.to_bytes())
@@ -243,18 +245,20 @@ class BlsVerifier:
             # the native verifier subgroup-checks the aggregate SIGNATURE
             # itself; the aggregate PK is a sum of individually
             # subgroup-checked cached keys, so its ladder is skipped
-            return self._native_verify(
-                msg,
-                agg_pk.to_bytes(),
-                BlsSignature(agg).to_bytes(),
-                check_pk_subgroup=False,
-            )
+            with _spans.span("host.pairing"):
+                return self._native_verify(
+                    msg,
+                    agg_pk.to_bytes(),
+                    BlsSignature(agg).to_bytes(),
+                    check_pk_subgroup=False,
+                )
         # ONE subgroup check on the aggregate (the device kernel's
         # in-kernel r-ladder is still future work, so the host checks
         # its result too — ~2 ms once per QC)
         if not agg.in_subgroup():
             return False
-        return agg_pk.verify(msg, BlsSignature(agg))
+        with _spans.span("host.pairing"):
+            return agg_pk.verify(msg, BlsSignature(agg))
 
     def _grouped_batch(self, db, pb, sb):
         """Group a distinct-message batch by digest and aggregate each

@@ -22,6 +22,7 @@ import hashlib
 import os
 import struct
 
+from ..telemetry import spans as _spans
 from .keys import (
     PublicKey,
     SecretKey,
@@ -182,14 +183,18 @@ class DualSchemeVerifier:
     def verify_shared_msg(self, digest, votes) -> bool:
         if not votes:
             return False
-        return self._route(votes[0][0].data).verify_shared_msg(digest, votes)
+        with _spans.span("scheme.route"):
+            backend = self._route(votes[0][0].data)
+        return backend.verify_shared_msg(digest, votes)
 
     def verify_many(
         self, digests, pks, sigs, aggregate_ok: bool = False
     ) -> list[bool]:
         if not pks:
             return []
-        return self._route(pks[0]).verify_many(
+        with _spans.span("scheme.route"):
+            backend = self._route(pks[0])
+        return backend.verify_many(
             digests, pks, sigs, aggregate_ok=aggregate_ok
         )
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Iterable, Protocol
 
+from ..telemetry import spans as _spans
 from .digest import Digest
 from .keys import PublicKey, SecretKey
 from .signature import CryptoError, Signature
@@ -82,22 +83,26 @@ class CpuVerifier:
     def verify_shared_msg(
         self, digest: Digest, votes: list[tuple[PublicKey, Signature]]
     ) -> bool:
-        if len(votes) >= NATIVE_BATCH_MIN:
-            from . import native_ed25519
+        with _spans.span("host.verify"):
+            if len(votes) >= NATIVE_BATCH_MIN:
+                from . import native_ed25519
 
-            if native_ed25519.available():
-                # cofactored batch acceptance — dalek-batch parity; the
-                # certificate verdict is all-or-nothing, same as the
-                # reference's QC::verify
-                return native_ed25519.batch_verify_shared(
-                    digest.to_bytes(),
-                    [(pk.to_bytes(), sig.to_bytes()) for pk, sig in votes],
-                )
-        try:
-            Signature.verify_batch(digest, votes)
-            return True
-        except CryptoError:
-            return False
+                if native_ed25519.available():
+                    # cofactored batch acceptance — dalek-batch parity;
+                    # the certificate verdict is all-or-nothing, same as
+                    # the reference's QC::verify
+                    return native_ed25519.batch_verify_shared(
+                        digest.to_bytes(),
+                        [
+                            (pk.to_bytes(), sig.to_bytes())
+                            for pk, sig in votes
+                        ],
+                    )
+            try:
+                Signature.verify_batch(digest, votes)
+                return True
+            except CryptoError:
+                return False
 
     def verify_many(
         self,
@@ -108,28 +113,29 @@ class CpuVerifier:
     ) -> list[bool]:
         from .signature import batch_verify_arrays
 
-        n = len(digests)
-        if aggregate_ok and n >= NATIVE_BATCH_MIN:
-            # Certificate-shaped call (TC verify): the all-pass verdict
-            # may be established collectively.  One batch equation
-            # replaces n verifies; on a failure fall through to the
-            # loop for per-item attribution.
-            from . import native_ed25519
+        with _spans.span("host.verify"):
+            n = len(digests)
+            if aggregate_ok and n >= NATIVE_BATCH_MIN:
+                # Certificate-shaped call (TC verify): the all-pass
+                # verdict may be established collectively.  One batch
+                # equation replaces n verifies; on a failure fall
+                # through to the loop for per-item attribution.
+                from . import native_ed25519
 
-            if (
-                native_ed25519.available()
-                and all(len(d) == Digest.SIZE for d in digests)
-                and native_ed25519.batch_verify(
-                    b"".join(digests),
-                    Digest.SIZE,
-                    b"".join(pks),
-                    b"".join(sigs),
-                    n,
-                    shared=False,
-                )
-            ):
-                return [True] * n
-        return batch_verify_arrays(digests, pks, sigs)
+                if (
+                    native_ed25519.available()
+                    and all(len(d) == Digest.SIZE for d in digests)
+                    and native_ed25519.batch_verify(
+                        b"".join(digests),
+                        Digest.SIZE,
+                        b"".join(pks),
+                        b"".join(sigs),
+                        n,
+                        shared=False,
+                    )
+                ):
+                    return [True] * n
+            return batch_verify_arrays(digests, pks, sigs)
 
 
 class SignatureService:

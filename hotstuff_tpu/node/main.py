@@ -157,6 +157,20 @@ def _apply_journal_dir(args) -> None:
         telemetry.set_journal_dir(jdir)
 
 
+def _apply_profile(args) -> None:
+    """Turn the verify-pipeline span profiler on when ``--profile`` was
+    given: sets HOTSTUFF_PROFILE (so worker threads and any child
+    processes inherit the switch) and force-enables the recorder (env
+    check may already have been consumed by an earlier import)."""
+    if getattr(args, "profile", False):
+        import os
+
+        from .. import telemetry
+
+        os.environ["HOTSTUFF_PROFILE"] = "1"
+        telemetry.spans.enable()
+
+
 def _apply_fault_plane(args) -> None:
     """Activate the chaos plane when ``--fault-plane`` was given: the
     flag value (a spec file path or inline JSON) lands in
@@ -176,6 +190,7 @@ async def _run_node(args) -> None:
     # and the nodes booted below only pick telemetry up at boot
     _apply_journal_dir(args)
     _apply_fault_plane(args)
+    _apply_profile(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -229,6 +244,7 @@ async def _run_many(args) -> None:
 
     _apply_journal_dir(args)
     _apply_fault_plane(args)
+    _apply_profile(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -407,6 +423,13 @@ def main(argv=None) -> int:
         "journals with `python -m benchmark traces`)"
     )
     p_run.add_argument("--journal-dir", default=None, help=journal_help)
+    profile_help = (
+        "enable the verify-pipeline span profiler (ring-buffered "
+        "per-stage spans, verify_stage_ms metrics, and — with the "
+        "flight recorder on — a 'verify pipeline' Perfetto track; "
+        "default: off, or the HOTSTUFF_PROFILE env knob)"
+    )
+    p_run.add_argument("--profile", action="store_true", help=profile_help)
     faults_help = (
         "activate the chaos plane from this fault-spec file (or inline "
         "JSON): seeded deterministic drop/delay/duplicate/corrupt per "
@@ -433,6 +456,7 @@ def main(argv=None) -> int:
         "--metrics-port", type=int, default=None, help=metrics_help
     )
     p_many.add_argument("--journal-dir", default=None, help=journal_help)
+    p_many.add_argument("--profile", action="store_true", help=profile_help)
     p_many.add_argument("--fault-plane", default=None, help=faults_help)
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
@@ -445,6 +469,7 @@ def main(argv=None) -> int:
         "--metrics-port", type=int, default=None, help=metrics_help
     )
     p_dep.add_argument("--journal-dir", default=None, help=journal_help)
+    p_dep.add_argument("--profile", action="store_true", help=profile_help)
     p_dep.add_argument("--fault-plane", default=None, help=faults_help)
 
     args = parser.parse_args(argv)
@@ -464,6 +489,7 @@ def main(argv=None) -> int:
         return 0
     if args.command == "deploy":
         _apply_fault_plane(args)
+        _apply_profile(args)
         asyncio.run(
             _deploy_testbed(
                 args.nodes,

@@ -275,6 +275,10 @@ class Node:
 
             self._journal = Journal(tel.node, jdir)
             tel.attach_journal(self._journal)
+            if telemetry.spans.enabled():
+                # verify-pipeline spans render as one per-process track
+                # in the merged trace (first journaled node wins)
+                telemetry.spans.attach_journal(self._journal)
             log.info("Flight recorder journaling to %s", jdir)
         stats_task = None
         probe_running = False

@@ -45,6 +45,7 @@ from .metrics import (
     Registry,
 )
 from .trace import EDGES, TraceRecorder
+from . import spans
 
 _REGISTRY = Registry()
 _NODES: dict[str, "NodeTelemetry"] = {}
@@ -76,6 +77,10 @@ def enabled() -> bool:
     if journal_enabled():
         # the flight recorder rides on the NodeTelemetry handle, so
         # journaling implies collection
+        return True
+    if spans.enabled():
+        # the span profiler feeds verify_stage_ms histograms, so
+        # profiling implies collection too
         return True
     env = os.environ.get("HOTSTUFF_TELEMETRY")
     if env is not None:
@@ -147,6 +152,7 @@ def reset() -> None:
     _NODES.clear()
     _FORCED = False
     _JOURNAL_DIR = None
+    spans.disable()
 
 
 async def maybe_start_server(port: int | None, host: str = "0.0.0.0"):
@@ -426,6 +432,7 @@ __all__ = [
     "LATENCY_BOUNDS_S",
     "SIZE_BOUNDS",
     "PEER_GAUGE_MAX_COMMITTEE",
+    "spans",
     "registry",
     "enable",
     "enabled",

@@ -170,12 +170,30 @@ class Journal:
 
     # ---- hot path --------------------------------------------------------
 
-    def record(self, event: str, round_: int = 0, digest=None, peer: str = "") -> None:
+    def record(
+        self,
+        event: str,
+        round_: int = 0,
+        digest=None,
+        peer: str = "",
+        dur_ns: int | None = None,
+    ) -> None:
         """Append one event.  ``digest`` is a crypto value object (or
-        None); its base64 rendering is deferred to flush time."""
+        None); its base64 rendering is deferred to flush time.
+        ``dur_ns`` (optional) marks a DURATION record — a span ending at
+        this record's timestamps (the verify-pipeline profiler's
+        ``span`` events); it lands in the wire format as ``"u"``."""
         buf = self._buf
         buf.append(
-            (event, round_, digest, peer, time.monotonic_ns(), time.time_ns())
+            (
+                event,
+                round_,
+                digest,
+                peer,
+                time.monotonic_ns(),
+                time.time_ns(),
+                dur_ns,
+            )
         )
         if len(buf) >= self.buffer_records:
             self.flush()
@@ -190,10 +208,12 @@ class Journal:
             return
         self._buf = []
         parts = []
-        for e, r, d, p, m, w in buf:
+        for e, r, d, p, m, w, u in buf:
             ds = d.encode_base64()[:16] if d is not None else ""
+            tail = f',"u":{u}' if u is not None else ""
             parts.append(
-                f'{{"e":"{e}","r":{r},"d":"{ds}","p":"{p}","m":{m},"w":{w}}}\n'
+                f'{{"e":"{e}","r":{r},"d":"{ds}","p":"{p}","m":{m},"w":{w}'
+                f"{tail}}}\n"
             )
         data = "".join(parts)
         try:

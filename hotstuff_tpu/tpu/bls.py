@@ -63,6 +63,7 @@ import jax.numpy as jnp
 
 from ..crypto.bls.curve import G1Point
 from ..crypto.bls.fields import P as Q
+from ..telemetry import spans as _spans
 
 NLIMBS = 30
 LIMB_BITS = 13
@@ -330,25 +331,38 @@ class TpuG1Aggregator:
         real = [pt for pt in points if not pt.inf]
         if not real:
             return G1Point.identity()
-        padded = self._padded_size(len(real))
-        xs = np.zeros((padded, NLIMBS), np.int32)
-        ys = np.zeros((padded, NLIMBS), np.int32)
-        zs = np.zeros((padded, NLIMBS), np.int32)
-        one = to_mont_limbs(1)
-        for i, pt in enumerate(real):
-            xs[i] = to_mont_limbs(pt.x)
-            ys[i] = to_mont_limbs(pt.y)
-            zs[i] = one
-        for i in range(len(real), padded):
-            ys[i] = one  # identity rows: (0 : 1 : 0)
+        with _spans.span("prepare"):
+            padded = self._padded_size(len(real))
+            xs = np.zeros((padded, NLIMBS), np.int32)
+            ys = np.zeros((padded, NLIMBS), np.int32)
+            zs = np.zeros((padded, NLIMBS), np.int32)
+            one = to_mont_limbs(1)
+            for i, pt in enumerate(real):
+                xs[i] = to_mont_limbs(pt.x)
+                ys[i] = to_mont_limbs(pt.y)
+                zs[i] = one
+            for i in range(len(real), padded):
+                ys[i] = one  # identity rows: (0 : 1 : 0)
 
         kernel = self._sharded if self._sharded is not None else _aggregate_kernel
-        x, y, z = kernel(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
-        return self._projective_to_affine(
-            np.asarray(x).reshape(NLIMBS),
-            np.asarray(y).reshape(NLIMBS),
-            np.asarray(z).reshape(NLIMBS),
-        )
+        rec = _spans.recorder()
+        if rec is None:
+            x, y, z = kernel(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
+        else:
+            # profiling: the block_until_ready fence exists only under
+            # the profiler (production lets np.asarray block)
+            with rec.span("dispatch"):
+                x, y, z = kernel(
+                    jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs)
+                )
+            with rec.span("device.execute"):
+                x, y, z = jax.block_until_ready((x, y, z))
+        with _spans.span("readback"):
+            return self._projective_to_affine(
+                np.asarray(x).reshape(NLIMBS),
+                np.asarray(y).reshape(NLIMBS),
+                np.asarray(z).reshape(NLIMBS),
+            )
 
     @staticmethod
     def _projective_to_affine(x, y, z) -> G1Point:

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto import ed25519_ref as ref
+from ..telemetry import spans as _spans
 from . import curve, field as F
 
 MASK255 = (1 << 255) - 1
@@ -287,7 +288,8 @@ class BatchVerifier:
                 from ..crypto.signature import batch_verify_arrays
 
                 self._cpu = batch_verify_arrays
-            return np.asarray(self._cpu(messages, pubkeys, signatures))
+            with _spans.span("host.verify"):
+                return np.asarray(self._cpu(messages, pubkeys, signatures))
         return self.verify_device(messages, pubkeys, signatures)
 
     def verify_device(
@@ -317,9 +319,27 @@ class BatchVerifier:
                 ]
             )
 
-        kernel, arrays, valid_host = self.stage(messages, pubkeys, signatures)
-        ok = kernel(*arrays)
-        return np.asarray(ok)[:n] & valid_host
+        rec = _spans.recorder()
+        if rec is None:
+            kernel, arrays, valid_host = self.stage(
+                messages, pubkeys, signatures
+            )
+            ok = kernel(*arrays)
+            return np.asarray(ok)[:n] & valid_host
+        # profiling: split the dispatch into its waterfall stages.  The
+        # block_until_ready fence exists ONLY under the profiler — the
+        # production path lets np.asarray block, overlapping transfer
+        # with whatever XLA still has in flight.
+        with rec.span("prepare"):
+            kernel, arrays, valid_host = self.stage(
+                messages, pubkeys, signatures
+            )
+        with rec.span("dispatch"):
+            ok = kernel(*arrays)
+        with rec.span("device.execute"):
+            ok = jax.block_until_ready(ok)
+        with rec.span("readback"):
+            return np.asarray(ok)[:n] & valid_host
 
     def stage(self, messages, pubkeys, signatures):
         """(kernel_fn, kernel arrays, host_validity) for this batch —

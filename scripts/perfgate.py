@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""Performance regression gate for the verify rig.
+
+Compares a FRESH ``bench.py`` run against the committed reference
+(latest ``BENCH_r*.json``, falling back to ``BASELINE.json``) and exits
+non-zero when either guarded metric regresses past the threshold
+(default 15%):
+
+  * ``qc_verify_ms.256.rig_p50_ms``  — QC-256 end-to-end verify latency
+    (the number the span waterfall decomposes; may not rise >15%)
+  * ``value``                        — batch-1024 verify throughput in
+    sigs/s (may not fall >15%)
+
+Usage:
+
+    python scripts/perfgate.py                 # runs bench.py itself
+    python scripts/perfgate.py --fresh out.txt # pre-captured output
+    python scripts/perfgate.py --fresh -       # ... from stdin
+    PERFGATE=1 scripts/trace.sh                # opt-in after a trace run
+
+The comparison logic is import-safe pure functions so tests can drive
+it without spawning a benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (human name, extractor, direction) — direction +1 means "higher is a
+#: regression" (latency), -1 means "lower is a regression" (throughput)
+GUARDS = (
+    (
+        "qc_verify_ms.256.rig_p50_ms",
+        lambda doc: doc.get("qc_verify_ms", {}).get("256", {}).get(
+            "rig_p50_ms"
+        ),
+        +1,
+    ),
+    ("value (sigs/s)", lambda doc: doc.get("value"), -1),
+)
+
+
+def last_json_line(text: str) -> dict | None:
+    """The bench contract: the result is the LAST parseable JSON object
+    line of stdout (jax warnings etc. precede it)."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+def load_reference(repo: str = REPO) -> tuple[dict, str] | None:
+    """Latest ``BENCH_r*.json``'s metrics (its ``parsed`` dict, or the
+    JSON line inside ``tail``), else ``BASELINE.json`` if it carries
+    published numbers.  Returns (metrics, source-path) or None."""
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        doc = rec.get("parsed") or last_json_line(rec.get("tail", ""))
+        if isinstance(doc, dict) and any(
+            fn(doc) is not None for _, fn, _ in GUARDS
+        ):
+            return doc, path
+    base = os.path.join(repo, "BASELINE.json")
+    try:
+        with open(base) as f:
+            doc = json.load(f).get("published") or {}
+    except (OSError, ValueError):
+        return None
+    if any(fn(doc) is not None for _, fn, _ in GUARDS):
+        return doc, base
+    return None
+
+
+def compare(fresh: dict, ref: dict, threshold: float = 0.15) -> list[str]:
+    """Failure messages for every guarded metric past the threshold.
+    A metric missing on either side is skipped (a bench that stopped
+    publishing a number is a review problem, not a perf gate's)."""
+    failures = []
+    for name, fn, direction in GUARDS:
+        f, r = fn(fresh), fn(ref)
+        if f is None or r is None or r <= 0:
+            continue
+        delta = (f - r) / r * direction
+        if delta > threshold:
+            word = "rose" if direction > 0 else "fell"
+            failures.append(
+                f"{name} {word} {abs(f - r) / r:.1%} past the "
+                f"{threshold:.0%} gate (fresh {f:g} vs reference {r:g})"
+            )
+    return failures
+
+
+def run_bench(repo: str = REPO) -> str:
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench.py exited {proc.returncode}:\n{proc.stderr[-2000:]}"
+        )
+    return proc.stdout
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--fresh",
+        default=None,
+        metavar="FILE",
+        help="pre-captured bench.py stdout ('-' for stdin) instead of "
+        "running the benchmark",
+    )
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="allowed relative regression (default 0.15)")
+    args = ap.parse_args(argv)
+
+    ref = load_reference()
+    if ref is None:
+        print("perfgate: no usable reference (BENCH_r*.json / "
+              "BASELINE.json) — nothing to gate against")
+        return 0
+    ref_doc, ref_path = ref
+
+    if args.fresh == "-":
+        text = sys.stdin.read()
+    elif args.fresh:
+        with open(args.fresh) as f:
+            text = f.read()
+    else:
+        print("perfgate: running bench.py ...")
+        text = run_bench()
+    fresh = last_json_line(text)
+    if fresh is None:
+        print("perfgate: FAIL — no JSON result line in the fresh bench "
+              "output")
+        return 1
+
+    failures = compare(fresh, ref_doc, args.threshold)
+    rel = os.path.relpath(ref_path, REPO)
+    if failures:
+        print(f"perfgate: FAIL vs {rel}")
+        for msg in failures:
+            print(f"  - {msg}")
+        return 1
+    checked = [n for n, fn, _ in GUARDS
+               if fn(fresh) is not None and fn(ref_doc) is not None]
+    print(f"perfgate: OK vs {rel} ({', '.join(checked) or 'nothing'} "
+          f"within {args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,10 +8,18 @@
 # Output: logs/journals/ (per-node JSONL ring segments) and
 # logs/trace.json — open the latter at https://ui.perfetto.dev.
 # Timeout-bounded so a hung committee cannot wedge a CI job.
+#
+#   PERFGATE=1 scripts/trace.sh   # also run the perf regression gate
+#                                 # (scripts/perfgate.py) afterwards
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-exec timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m benchmark local \
     --nodes 4 --rate 500 --duration 10 --journal "$@"
+
+if [ "${PERFGATE:-0}" = "1" ]; then
+    timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/perfgate.py
+fi
