@@ -149,7 +149,22 @@ def task_traces(args) -> int:
 def task_profile(args) -> int:
     """Span-level verify-pipeline waterfall (benchmark/profile.py):
     QC-shaped claim waves through the production dispatch path with the
-    profiler on, per-stage p50/p99 + %-of-e2e SUMMARY per batch size."""
+    profiler on, per-stage p50/p99 + %-of-e2e SUMMARY per batch size.
+    ``--train N`` switches to the sustained wave-train mode instead:
+    N distinct-digest waves back to back through the dispatch pipeline,
+    amortized per-wave latency and overlap efficiency at depth 1 vs the
+    configured pipeline depth."""
+    if args.train:
+        from .profile import format_train, run_train
+
+        result = run_train(
+            size=max(int(s) for s in args.sizes.split(",")),
+            train=args.train,
+            verifier=args.verifier,
+        )
+        print(format_train(result))
+        return 0
+
     from .profile import format_waterfall, run_profile
 
     result = run_profile(
@@ -464,8 +479,20 @@ def main(argv=None) -> int:
     p.add_argument("--waves", type=int, default=20)
     p.add_argument(
         "--verifier",
-        choices=["cpu", "tpu", "tpu-sharded"],
+        choices=["cpu", "tpu", "tpu-sharded", "bls"],
         default="tpu",
+        help="bls = the BLS claims path (device G1 aggregation + host "
+        "pairing equality per QC)",
+    )
+    p.add_argument(
+        "--train",
+        type=int,
+        default=0,
+        metavar="N",
+        help="sustained wave-train mode: N distinct-digest waves back "
+        "to back through the dispatch pipeline (largest --sizes entry), "
+        "amortized per-wave latency + overlap efficiency at depth 1 vs "
+        "HOTSTUFF_VERIFY_PIPELINE",
     )
     p.add_argument(
         "--route",

@@ -53,7 +53,9 @@ RE_RATE_HIGH = re.compile(r"rate too high")
 # its total
 RE_VERIFY_STATS = re.compile(
     r"Verify service stats \[(\S+)\]: dispatches=(\d+) device=(\d+) "
+    r"(?:cpu=(\d+) probe=(\d+) )?"
     r"device_sigs=(\d+) cpu_sigs=(\d+) deadline_misses=(\d+) "
+    r"(?:waits=(\d+) depth=(\d+) )?"
     r"ewma_ms=([\d.]+)"
 )
 # periodic per-node telemetry snapshot (telemetry/exporter.py) — a
@@ -110,19 +112,35 @@ class LogParser:
         # keyed by (log file, tag): tags embed pid+serial, which is
         # unique within a host but can collide across hosts in a remote
         # sweep — the log file disambiguates
-        per_tag: dict[tuple, tuple[int, int, int, int, float]] = {}
+        # pre-pipeline logs omit the cpu=/probe=/waits=/depth= fields
+        # (optional regex groups come back as '') — treat them as 0
+        per_tag: dict[tuple, tuple] = {}
         for log_idx, content in enumerate(node_logs):
-            for tag, disp, dev, dsig, csig, miss, ewma in (
-                RE_VERIFY_STATS.findall(content)
-            ):
+            for (
+                tag, disp, dev, cpu, probe, dsig, csig, miss, waits,
+                depth, ewma,
+            ) in RE_VERIFY_STATS.findall(content):
                 per_tag[(log_idx, tag)] = (
-                    int(disp), int(dsig), int(csig), int(miss), float(ewma)
+                    int(disp), int(dsig), int(csig), int(miss),
+                    float(ewma), int(dev), int(cpu or 0), int(probe or 0),
+                    int(waits or 0), int(depth or 1),
                 )
         self.device_sigs = sum(v[1] for v in per_tag.values())
         self.cpu_route_sigs = sum(v[2] for v in per_tag.values())
         self.deadline_misses = sum(v[3] for v in per_tag.values())
         self.verify_ewma_ms = (
             max(v[4] for v in per_tag.values()) if per_tag else None
+        )
+        # dispatch-wave routing split (ISSUE 5): waves by final route,
+        # plus depth-cap queue events and the configured pipeline depth
+        self.route_waves = {
+            "device": sum(v[5] for v in per_tag.values()),
+            "cpu": sum(v[6] for v in per_tag.values()),
+            "probe": sum(v[7] for v in per_tag.values()),
+        }
+        self.pipeline_waits = sum(v[8] for v in per_tag.values())
+        self.pipeline_depth = (
+            max(v[9] for v in per_tag.values()) if per_tag else None
         )
 
         # telemetry snapshots (cumulative): last document per node log
@@ -332,12 +350,30 @@ class LogParser:
             if self.verify_ewma_ms is not None
             else "n/a"
         )
-        return (
+        out = (
             f" Verify sigs device-routed: {self.device_sigs:,} of {total:,}"
             f" ({pct:.0f}%)\n"
             f" Verify deadline misses: {self.deadline_misses}\n"
             f" Verify dispatch EWMA (worst service): {ewma}\n"
         )
+        # per-route wave split (ISSUE 5): route flapping shows up here
+        # as a device/cpu share that moves across rates
+        waves = sum(self.route_waves.values())
+        if waves:
+            shares = "/".join(
+                f"{r} {100.0 * n / waves:.0f}%"
+                for r, n in self.route_waves.items()
+            )
+            depth = (
+                f", pipeline depth {self.pipeline_depth}"
+                if self.pipeline_depth
+                else ""
+            )
+            out += (
+                f" Verify route waves: {shares} of {waves:,}"
+                f" (queued {self.pipeline_waits}{depth})\n"
+            )
+        return out
 
     def _telemetry_breakdown_txt(self) -> str:
         """Commit-latency breakdown from the per-node telemetry

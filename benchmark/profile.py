@@ -31,6 +31,10 @@ from hotstuff_tpu.telemetry import spans as _spans
 DEFAULT_WAVES = 20
 WARMUP_WAVES = 3
 
+#: sustained wave-train mode: waves per train / trains measured
+DEFAULT_TRAIN_WAVES = 8
+DEFAULT_TRAIN_REPS = 10
+
 
 def _percentile(values: list[float], pct: float) -> float:
     """Nearest-rank percentile over the raw per-wave samples (no
@@ -42,19 +46,75 @@ def _percentile(values: list[float], pct: float) -> float:
     return ordered[k]
 
 
-def make_qc_claim(n: int):
+def make_qc_claim(n: int, scheme: str = "ed25519"):
     """One "shared" claim with n committee signatures over one digest —
-    the QC verify shape (bench.py's make_qc_batch, claim-shaped)."""
-    from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+    the QC verify shape (bench.py's make_qc_batch, claim-shaped).
+    ``scheme="bls"`` builds the same claim over BLS12-381 material
+    (96-byte G2 pubkeys, 48-byte G1 signatures)."""
+    from hotstuff_tpu.crypto import Digest
 
     shared = Digest.of(b"profile block digest")
     votes = []
     pks = []
-    for i in range(n):
-        pk, sk = generate_keypair(b"\x33" * 32, i)
-        pks.append(pk.to_bytes())
-        votes.append((pk.to_bytes(), Signature.new(shared, sk).to_bytes()))
+    if scheme == "bls":
+        from hotstuff_tpu.crypto.bls import keygen as bls_keygen
+
+        for i in range(n):
+            pk, sk = bls_keygen(b"profile-bls" + i.to_bytes(4, "little"))
+            pks.append(pk.to_bytes())
+            votes.append(
+                (pk.to_bytes(), sk.sign(shared.to_bytes()).to_bytes())
+            )
+    else:
+        from hotstuff_tpu.crypto import Signature, generate_keypair
+
+        for i in range(n):
+            pk, sk = generate_keypair(b"\x33" * 32, i)
+            pks.append(pk.to_bytes())
+            votes.append(
+                (pk.to_bytes(), Signature.new(shared, sk).to_bytes())
+            )
     return ("shared", shared.to_bytes(), tuple(votes)), pks
+
+
+def make_train_claims(n: int, waves: int, scheme: str = "ed25519"):
+    """``waves`` distinct-digest QC claims over ONE committee.  Distinct
+    digests defeat the service's cross-wave claim dedup (every wave is
+    real work); a single committee keeps the device-resident key cache
+    hot across the whole train."""
+    from hotstuff_tpu.crypto import Digest
+
+    if scheme == "bls":
+        from hotstuff_tpu.crypto.bls import keygen as bls_keygen
+
+        keys = [
+            bls_keygen(b"train-bls" + i.to_bytes(4, "little"))
+            for i in range(n)
+        ]
+        pks = [pk.to_bytes() for pk, _ in keys]
+        claims = []
+        for w in range(waves):
+            d = Digest.of(b"train wave %d" % w)
+            votes = tuple(
+                (pk.to_bytes(), sk.sign(d.to_bytes()).to_bytes())
+                for pk, sk in keys
+            )
+            claims.append(("shared", d.to_bytes(), votes))
+        return claims, pks
+
+    from hotstuff_tpu.crypto import Signature, generate_keypair
+
+    keys = [generate_keypair(b"\x44" * 32, i) for i in range(n)]
+    pks = [pk.to_bytes() for pk, _ in keys]
+    claims = []
+    for w in range(waves):
+        d = Digest.of(b"train wave %d" % w)
+        votes = tuple(
+            (pk.to_bytes(), Signature.new(d, sk).to_bytes())
+            for pk, sk in keys
+        )
+        claims.append(("shared", d.to_bytes(), votes))
+    return claims, pks
 
 
 def waterfall(span_rows: list[tuple], e2e_ms: list[float]) -> dict:
@@ -163,7 +223,9 @@ def run_profile(
     HOTSTUFF_FORCE_DEVICE_ROUTE (the waterfall should measure the
     dispatch pipeline, not the adaptive router's weather calls);
     ``route="auto"`` leaves the cost-model routing in charge.
-    ``verifier="cpu"`` profiles the inline host path instead.
+    ``verifier="cpu"`` profiles the inline host path instead;
+    ``verifier="bls"`` profiles the BLS claims path (device G1
+    aggregation + host pairing equality per QC).
     """
     import asyncio
 
@@ -177,7 +239,8 @@ def run_profile(
     if forced:
         os.environ["HOTSTUFF_FORCE_DEVICE_ROUTE"] = "1"
 
-    claims = {n: make_qc_claim(n) for n in sizes}
+    scheme = "bls" if verifier == "bls" else "ed25519"
+    claims = {n: make_qc_claim(n, scheme=scheme) for n in sizes}
     out: dict = {
         "verifier": verifier,
         "route": route if verifier != "cpu" else "inline",
@@ -188,6 +251,20 @@ def run_profile(
     async def drive() -> None:
         if verifier == "cpu":
             svc = AsyncVerifyService(CpuVerifier())
+        elif verifier == "bls":
+            from hotstuff_tpu.crypto.async_service import eval_claims_sync
+            from hotstuff_tpu.crypto.bls.service import BlsVerifier
+
+            # device G1 vote-signature aggregation, host pairing — the
+            # production BLS committee backend (crypto/scheme.py)
+            backend = BlsVerifier(aggregator="tpu")
+            backend.precompute(claims[max(sizes)][1])
+            # warm every aggregation kernel shape through the claims
+            # path (same cold-compile argument as the ed25519 branch)
+            for n in sizes:
+                assert eval_claims_sync(backend, [claims[n][0]]) == [True]
+            backend.dispatch_deadline_s = 30.0
+            svc = AsyncVerifyService(backend, device=True)
         else:
             from hotstuff_tpu.crypto.async_service import eval_claims_sync
             from hotstuff_tpu.node.node import LazyDeviceVerifier
@@ -250,10 +327,160 @@ def run_profile(
     return out
 
 
+def run_train(
+    size: int = 256,
+    train: int = DEFAULT_TRAIN_WAVES,
+    reps: int = DEFAULT_TRAIN_REPS,
+    depth: int | None = None,
+    verifier: str = "tpu",
+) -> dict:
+    """Sustained wave-train mode (ISSUE 5): drive ``train``
+    distinct-digest QC waves BACK TO BACK through the dispatch pipeline
+    and compare the amortized per-wave latency against the sequential
+    single-wave p50 — overlap efficiency is the share of the per-wave
+    round trip the staging/execute overlap hides.  Runs at depth 1 (the
+    old single-in-flight behavior) and at ``depth`` (default:
+    HOTSTUFF_VERIFY_PIPELINE) so the comparison is self-contained."""
+    import asyncio
+
+    from hotstuff_tpu.crypto.async_service import (
+        AsyncVerifyService,
+        eval_claims_sync,
+        pipeline_depth_from_env,
+    )
+
+    depth = depth or pipeline_depth_from_env()
+    scheme = "bls" if verifier == "bls" else "ed25519"
+    claims, pks = make_train_claims(size, train, scheme=scheme)
+    os.environ["HOTSTUFF_FORCE_DEVICE_ROUTE"] = "1"
+    out: dict = {
+        "verifier": verifier,
+        "qc_size": size,
+        "train_waves": train,
+        "reps": reps,
+        "depths": {},
+    }
+
+    if verifier == "bls":
+        from hotstuff_tpu.crypto.bls.service import BlsVerifier
+
+        backend = BlsVerifier(aggregator="tpu")
+        backend.precompute(pks)
+        assert eval_claims_sync(backend, [claims[0]]) == [True]
+        backend.dispatch_deadline_s = 30.0
+    else:
+        from hotstuff_tpu.node.node import LazyDeviceVerifier
+
+        backend = LazyDeviceVerifier(verifier)
+        backend.precompute(pks)
+        backend.warmup(batch=size)
+        assert eval_claims_sync(backend.async_backend, [claims[0]]) == [True]
+        # a slow simulated device must be MEASURED, not deadline-demoted
+        backend.dispatch_deadline_s = 30.0
+
+    async def drive(d: int) -> dict:
+        svc = AsyncVerifyService(backend, device=True, pipeline_depth=d)
+        try:
+            for _ in range(WARMUP_WAVES):
+                assert (await svc.verify_claims([claims[0]])) == [True]
+            # singles: sequential fully-awaited waves — zero overlap,
+            # the baseline the train amortization is measured against
+            singles: list[float] = []
+            for claim in claims:
+                t0 = time.perf_counter()
+                assert (await svc.verify_claims([claim])) == [True]
+                singles.append((time.perf_counter() - t0) * 1e3)
+            # trains: each wave submitted as its OWN dispatch (yield
+            # until the dispatcher has taken the pending submission
+            # before staging the next), whole train awaited at once
+            trains: list[float] = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                futs = []
+                for claim in claims:
+                    futs.append(
+                        asyncio.ensure_future(svc.verify_claims([claim]))
+                    )
+                    await asyncio.sleep(0)
+                    while svc._pending:
+                        await asyncio.sleep(0)
+                results = await asyncio.gather(*futs)
+                trains.append((time.perf_counter() - t0) * 1e3)
+                assert all(r == [True] for r in results), "train wave failed"
+            single_p50 = _percentile(singles, 50)
+            train_p50 = _percentile(trains, 50)
+            return {
+                "single_wave_p50_ms": round(single_p50, 3),
+                "train_p50_ms": round(train_p50, 3),
+                "amortized_wave_ms": round(train_p50 / train, 3),
+                "peak_inflight": svc.peak_inflight,
+                "pipeline_waits": svc.pipeline_waits,
+                "train_sigs_per_s": round(
+                    size * train / (train_p50 / 1e3), 1
+                )
+                if train_p50 > 0
+                else 0.0,
+            }
+        finally:
+            svc.close()
+
+    try:
+        for d in sorted({1, depth}):
+            out["depths"][d] = asyncio.run(drive(d))
+    finally:
+        os.environ.pop("HOTSTUFF_FORCE_DEVICE_ROUTE", None)
+    base = out["depths"].get(1)
+    top = out["depths"].get(depth)
+    if base and top and depth > 1 and top["amortized_wave_ms"] > 0:
+        out["overlap_speedup"] = round(
+            base["amortized_wave_ms"] / top["amortized_wave_ms"], 3
+        )
+        out["overlap_efficiency_pct"] = round(
+            100.0
+            * (1 - top["amortized_wave_ms"] / base["amortized_wave_ms"]),
+            1,
+        )
+    return out
+
+
+def format_train(result: dict) -> str:
+    """The wave-train SUMMARY block (one row per pipeline depth)."""
+    lines = [
+        "-" * 64,
+        " PROFILE SUMMARY — sustained verify wave-train",
+        f" Verifier: {result['verifier']}  QC size {result['qc_size']}  "
+        f"{result['train_waves']} waves/train x {result['reps']} trains",
+        "-" * 64,
+        f"   {'depth':>5} {'single p50':>12} {'train p50':>11} "
+        f"{'amortized':>11} {'peak':>5} {'sigs/s':>9}",
+    ]
+    for d, res in sorted(result["depths"].items()):
+        lines.append(
+            f"   {d:>5} {res['single_wave_p50_ms']:>10.3f}ms "
+            f"{res['train_p50_ms']:>9.3f}ms "
+            f"{res['amortized_wave_ms']:>9.3f}ms {res['peak_inflight']:>5} "
+            f"{res['train_sigs_per_s']:>9.0f}"
+        )
+    if "overlap_speedup" in result:
+        top = max(result["depths"])
+        lines.append(
+            f"   overlap: depth-{top} amortized wave is "
+            f"{result['overlap_speedup']:.2f}x depth-1 "
+            f"({result['overlap_efficiency_pct']:.1f}% of the per-wave "
+            "round trip hidden by staging/execute overlap)"
+        )
+    lines.append("-" * 64)
+    return "\n".join(lines)
+
+
 __all__ = [
     "run_profile",
+    "run_train",
     "waterfall",
     "format_waterfall",
+    "format_train",
     "make_qc_claim",
+    "make_train_claims",
     "DEFAULT_WAVES",
+    "DEFAULT_TRAIN_WAVES",
 ]

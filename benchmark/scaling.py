@@ -120,6 +120,11 @@ def run_scaling(
                         sum(lag_means) / len(lag_means) if lag_means else 0.0
                     ),
                     "stats_nodes": len(stats),
+                    # dispatch-wave routing split (ISSUE 5): scraped
+                    # from the verify-service stats lines, so route
+                    # flapping is visible per rate in the SUMMARY
+                    "route_waves": dict(parser.route_waves),
+                    "pipeline_waits": parser.pipeline_waits,
                 }
             )
     finally:
@@ -136,7 +141,8 @@ def format_report(
         f"{rate}/s input, {duration:.0f}s, verifier={verifier})",
         "",
         f"{'nodes':>6} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
-        f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'pred 1-core/node':>17}",
+        f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p':>11} "
+        f"{'pred 1-core/node':>17}",
     ]
     for r in rows:
         window = max(r["window_s"], 1e-9)
@@ -147,10 +153,20 @@ def format_report(
         events = max(r["payloads"] * r["nodes"], 1)
         c_us = window / events * 1e6
         predicted = 1e6 / c_us  # payloads/s with a dedicated core/node
+        waves = r.get("route_waves") or {}
+        total_waves = sum(waves.values())
+        if total_waves:
+            route = "/".join(
+                f"{100 * waves.get(k, 0) // total_waves}"
+                for k in ("device", "cpu", "probe")
+            )
+        else:
+            route = "-"
         lines.append(
             f"{r['nodes']:>6} {r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
-            f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {predicted:>17.0f}"
+            f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>11} "
+            f"{predicted:>17.0f}"
         )
     lines += [
         "",

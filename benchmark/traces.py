@@ -179,6 +179,11 @@ class TraceSet:
         # (stage, w_end_corr, dur_ns).  A span record's timestamps mark
         # the span's END; its duration rides in the "u" field.
         self.verify_spans: dict[str, list[tuple[str, int, int]]] = {}
+        # pipeline occupancy annotations (ISSUE 5): node -> list of
+        # (w_corr, in-flight depth).  Value-encoded span records (the
+        # "u" field carries the depth, not a duration) — kept apart so
+        # the waterfall rows above never treat a depth as nanoseconds.
+        self.occupancy_samples: dict[str, list[tuple[int, int]]] = {}
         self._reconstruct()
 
     @classmethod
@@ -228,9 +233,15 @@ class TraceSet:
                     # "u"; must not reach _block (d is empty)
                     dur = r.get("u")
                     if dur is not None:
-                        self.verify_spans.setdefault(node, []).append(
-                            (r["p"], self._corr(node, r["w"]), int(dur))
-                        )
+                        if r["p"] == "pipeline.occupancy":
+                            # value annotation: "u" is in-flight depth
+                            self.occupancy_samples.setdefault(
+                                node, []
+                            ).append((self._corr(node, r["w"]), int(dur)))
+                        else:
+                            self.verify_spans.setdefault(node, []).append(
+                                (r["p"], self._corr(node, r["w"]), int(dur))
+                            )
                     continue
                 if e in ("fault.open", "fault.close"):
                     fault_edges.append(
@@ -460,6 +471,8 @@ class TraceSet:
         for rows in self.verify_spans.values():
             # a span's start = its end stamp minus its duration
             anchors.extend(w - dur for _, w, dur in rows)
+        for samples in self.occupancy_samples.values():
+            anchors.extend(w for w, _ in samples)
         if not anchors:
             return {"traceEvents": events, "displayTimeUnit": "ms"}
         base = min(anchors)
@@ -606,6 +619,25 @@ class TraceSet:
                         "ts": us(w_end - dur),
                         "dur": max(0.1, dur / 1e3),
                         "args": {"stage": stage, "dur_ms": dur / 1e6},
+                    }
+                )
+        for node, samples in sorted(self.occupancy_samples.items()):
+            # dispatch-pipeline occupancy (ISSUE 5): a counter series on
+            # the same node process as the verify-pipeline lane, so
+            # in-flight depth reads directly against the waterfall
+            pid = pid_of.get(node)
+            if pid is None:
+                continue
+            for w, depth in samples:
+                events.append(
+                    {
+                        "name": "verify inflight",
+                        "cat": "verify",
+                        "ph": "C",
+                        "pid": pid,
+                        "tid": 1,
+                        "ts": us(w),
+                        "args": {"inflight": depth},
                     }
                 )
         return {"traceEvents": events, "displayTimeUnit": "ms"}

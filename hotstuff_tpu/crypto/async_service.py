@@ -39,6 +39,23 @@ service degrades to the CPU path instead of stalling consensus — and
 keeps probing the device so it recovers when the weather does (the
 reference's graceful best-effort philosophy at the FFI boundary,
 SURVEY.md §7 "hard parts").
+
+Pipelined dispatch (ISSUE 5): up to ``pipeline_depth`` device waves may
+be in flight at once (default 2, ``HOTSTUFF_VERIFY_PIPELINE`` /
+``--verify-pipeline``).  While wave N parks on the device, wave N+1
+flattens, pads and transfers on a second worker thread, so the fixed
+tunnel round trip amortizes across in-flight waves instead of gating
+the committee per wave (the "16 in flight ≈ the cost of 1" measurement
+above is exactly why this works).  Each wave lands through its own
+completion future — out-of-order completion resolves each batch's own
+waiters, and a failed wave poisons only its own futures.  The cost
+model learns the marginal device cost: with waves already in flight,
+an extra wave rides the occupied tunnel, so the EWMA is discounted by
+``PIPELINE_MARGINAL_COST``.  At full occupancy a device-preferred wave
+QUEUES for a slot (bounded by the earliest in-flight deadline) rather
+than spilling to the CPU; an OVERDUE in-flight wave routes everything
+to the CPU, preserving the anti-stall behavior of the old
+single-in-flight gate.
 """
 
 from __future__ import annotations
@@ -77,6 +94,30 @@ _EWMA_ALPHA = 0.3
 # When the device EWMA says "lose", still probe the device this often so
 # a recovered tunnel is noticed (seconds).
 _PROBE_INTERVAL_S = 3.0
+
+# Default dispatch pipeline depth: waves in flight on the device at
+# once.  2 gives staging/execute overlap without queueing enough work
+# behind a tunnel stall to hurt (the deadline + overdue routing below
+# bound the damage to one deadline regardless of depth).
+DEFAULT_PIPELINE_DEPTH = 2
+
+# Marginal cost factor for a device dispatch when waves are already in
+# flight: concurrent dispatches pipeline (measured: 16 in flight ≈ the
+# cost of 1), so the route cost model discounts the EWMA for every wave
+# after the first instead of charging each a full round trip.
+PIPELINE_MARGINAL_COST = 0.25
+
+
+def pipeline_depth_from_env() -> int:
+    """Dispatch pipeline depth from HOTSTUFF_VERIFY_PIPELINE (min 1)."""
+    import os
+
+    raw = os.environ.get("HOTSTUFF_VERIFY_PIPELINE", "")
+    try:
+        depth = int(raw)
+    except ValueError:
+        depth = DEFAULT_PIPELINE_DEPTH
+    return max(1, depth)
 
 
 def flatten_claims(claims: list) -> tuple[list, list, list, list]:
@@ -191,7 +232,9 @@ class AsyncVerifyService:
     _registry: dict[tuple, tuple] = {}  # (loop id, kind) -> (loop, service)
     _serial = 0  # distinguishes private services' cumulative stat lines
 
-    def __init__(self, backend, device: bool = False):
+    def __init__(
+        self, backend, device: bool = False, pipeline_depth: int | None = None
+    ):
         AsyncVerifyService._serial += 1
         # stable tag for the scraped stats line: kind#pid.serial —
         # cumulative counters from different service instances must be
@@ -217,18 +260,33 @@ class AsyncVerifyService:
         # profiling: perf_counter_ns stamps of device-path submissions in
         # the current coalescing window (empty unless HOTSTUFF_PROFILE)
         self._arrivals: list[int] = []
-        self._worker_end_ns: int | None = None
         self._task: asyncio.Task | None = None
         self._executor: ThreadPoolExecutor | None = None
         # adaptive routing state
         self._device_ewma_s: float | None = None
         self._last_probe = 0.0
-        self._device_busy = False
+        # dispatch pipeline (ISSUE 5): wave serial -> monotonic deadline
+        # stamp for every device dispatch currently in flight.  Routing
+        # reads occupancy (len) and overdue-ness; landers and probe
+        # done-callbacks remove their wave and signal _slot_free.
+        self.pipeline_depth = (
+            max(1, int(pipeline_depth))
+            if pipeline_depth
+            else pipeline_depth_from_env()
+        )
+        self._inflight: dict[int, float] = {}
+        self._wave_serial = 0
+        self._slot_free: asyncio.Event | None = None
+        self._landers: set[asyncio.Task] = set()
         self.dispatches = 0
         self.device_dispatches = 0
+        self.cpu_dispatches = 0
+        self.probe_dispatches = 0
         self.device_sigs = 0
         self.cpu_sigs = 0
         self.deadline_misses = 0
+        self.pipeline_waits = 0
+        self.peak_inflight = 0
         self._next_stats_log = 0.0
         # Telemetry instruments (ISSUE 1), labelled by the service tag.
         # All None when telemetry is off — every hot-path touch below is
@@ -280,7 +338,7 @@ class AsyncVerifyService:
                     "Dispatch waves by routing decision",
                     {**labels, "route": r},
                 )
-                for r in ("device", "cpu", "probe")
+                for r in ("device", "cpu", "probe", "wait")
             }
             reg.gauge(
                 "verify_pending_batches",
@@ -288,6 +346,18 @@ class AsyncVerifyService:
                 labels,
                 fn=lambda: len(self._pending),
             )
+            reg.gauge(
+                "verify_inflight_waves",
+                "Device dispatch waves currently in flight",
+                labels,
+                fn=lambda: len(self._inflight),
+            )
+
+    @property
+    def _device_busy(self) -> bool:
+        """Compat view of the pre-pipeline single-in-flight gate: true
+        while ANY device dispatch is in flight."""
+        return bool(self._inflight)
 
     # ---- acquisition -------------------------------------------------------
 
@@ -346,6 +416,9 @@ class AsyncVerifyService:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        for lander in list(self._landers):
+            lander.cancel()
+        self._landers.clear()
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
@@ -389,82 +462,140 @@ class AsyncVerifyService:
 
     # ---- the dispatcher ----------------------------------------------------
 
-    def _route_device(self, n_sigs: int) -> str:
-        """Route this batch: "device", "cpu", or "probe".
+    def _deadline_s(self) -> float:
+        """Per-dispatch deadline: a tunnel stall mid-dispatch must not
+        stall the committee.  Backends may raise the floor (BLS: an
+        adversarial storm legitimately takes ~0.4 s off-loop;
+        re-running it inline would BE the stall)."""
+        return max(
+            getattr(self.backend, "dispatch_deadline_s", 0.1),
+            4 * (self._device_ewma_s or 0.1),
+        )
 
-        Never the device before its backend is materialized AND warm (a
-        cold jax import or Mosaic compile mid-consensus would blow the
-        round timeout — the host sets ``device_ready`` at warmup), and
-        never while a previous device dispatch is still in flight: the
-        worker is one thread, and queueing waves behind a
-        tunnel-stalled dispatch was measured to stall the whole
-        committee (32-node run collapsed to 1/3 the CPU rate on one
-        stall).  Then compare the device-dispatch EWMA against the CPU
-        estimate.  "probe": the EWMA says the device loses, but it's
-        time to re-measure — the caller dispatches a measurement-only
-        copy and serves the batch from the CPU, so probing a degraded
-        tunnel never adds wave latency."""
-        import os
-
-        if os.environ.get("HOTSTUFF_FORCE_CPU_ROUTE"):
-            return "cpu"  # diagnostic: keep jax warm but never dispatch
-        if not getattr(self.backend, "device_ready", True):
-            return "cpu"
-        if self._device_busy:
-            return "cpu"
-        if os.environ.get("HOTSTUFF_FORCE_DEVICE_ROUTE"):
-            # profiling knob (benchmark profile --route device): pin
-            # warmed-up waves to the device so the waterfall measures the
-            # dispatch pipeline, not the cost-model's mood — gated AFTER
-            # the readiness/busy checks, which stay load-bearing
-            return "device"
-        if getattr(self.backend, "always_offload", False):
-            # backends whose offload frees the loop unconditionally
-            # (BLS native pairings: ctypes releases the GIL) — no
-            # cost-model routing needed
-            return "device"
-        if self._device_ewma_s is None:
-            return "device"  # optimistic first dispatch
+    def _cpu_estimate_s(self, n_sigs: int) -> float:
         # the CPU alternative is the batched equation for large waves
         # (eval_claims_sync flat fast path) — but only when that path
         # actually exists on this host; else the per-sig loop
         from .native_ed25519 import available as _native_available
 
         if n_sigs >= NATIVE_BATCH_MIN and _native_available():
-            cpu_est = cpu_batch_estimate_s(n_sigs)
-        else:
-            cpu_est = n_sigs * CPU_US_PER_SIG * 1e-6
-        if self._device_ewma_s <= cpu_est:
-            return "device"
+            return cpu_batch_estimate_s(n_sigs)
+        return n_sigs * CPU_US_PER_SIG * 1e-6
+
+    def _route_device(self, n_sigs: int) -> str:
+        """Route this batch: "device", "cpu", "probe", or "wait".
+
+        Never the device before its backend is materialized AND warm (a
+        cold jax import or Mosaic compile mid-consensus would blow the
+        round timeout — the host sets ``device_ready`` at warmup), and
+        never while any in-flight dispatch is OVERDUE: queueing waves
+        behind a tunnel-stalled dispatch was measured to stall the
+        whole committee (32-node run collapsed to 1/3 the CPU rate on
+        one stall), so a stall pushes traffic to the CPU exactly like
+        the old single-in-flight busy gate did.  Below the depth cap,
+        compare the occupancy-discounted device EWMA (waves already in
+        flight share the tunnel round trip) against the CPU estimate.
+        "wait": the pipeline is full but healthy and the device is
+        still the right answer — the dispatcher queues for a slot
+        (bounded by the earliest in-flight deadline) instead of
+        spilling to the CPU.  "probe": the EWMA says the device loses,
+        but it's time to re-measure — the caller dispatches a
+        measurement-only copy and serves the batch from the CPU, so
+        probing a degraded tunnel never adds wave latency; probes take
+        a pipeline slot, so a full pipeline never probes."""
+        import os
+
+        if os.environ.get("HOTSTUFF_FORCE_CPU_ROUTE"):
+            return "cpu"  # diagnostic: keep jax warm but never dispatch
+        if not getattr(self.backend, "device_ready", True):
+            return "cpu"
         now = time.monotonic()
+        if any(stamp < now for stamp in self._inflight.values()):
+            # an in-flight dispatch blew its deadline — the tunnel is
+            # stalling; route around it until the stuck wave lands
+            return "cpu"
+        occupancy = len(self._inflight)
+        forced = bool(os.environ.get("HOTSTUFF_FORCE_DEVICE_ROUTE"))
+        offload = getattr(self.backend, "always_offload", False)
+        if occupancy >= self.pipeline_depth:
+            # depth cap: queue when the device is (or is forced to be)
+            # the right route, otherwise serve from the CPU.  No probe
+            # here — a probe would need the slot we don't have.
+            if forced or offload or self._device_ewma_s is None:
+                return "wait"
+            marginal = self._device_ewma_s * PIPELINE_MARGINAL_COST
+            if marginal <= self._cpu_estimate_s(n_sigs):
+                return "wait"
+            return "cpu"
+        if forced:
+            # profiling knob (benchmark profile --route device): pin
+            # warmed-up waves to the device so the waterfall measures the
+            # dispatch pipeline, not the cost-model's mood — gated AFTER
+            # the readiness/overdue/depth checks, which stay load-bearing
+            return "device"
+        if offload:
+            # backends whose offload frees the loop unconditionally
+            # (BLS native pairings: ctypes releases the GIL) — no
+            # cost-model routing needed
+            return "device"
+        if self._device_ewma_s is None:
+            return "device"  # optimistic first dispatch
+        marginal = self._device_ewma_s * (
+            1.0 if occupancy == 0 else PIPELINE_MARGINAL_COST
+        )
+        if marginal <= self._cpu_estimate_s(n_sigs):
+            return "device"
         if now - self._last_probe >= _PROBE_INTERVAL_S:
             self._last_probe = now
             return "probe"
         return "cpu"
 
-    def _spawn_device(self, loop, claims: list, measure_only: bool = False):
-        """Start a device dispatch on the worker thread.  The busy flag
-        keeps further waves off the device until it lands (one worker; a
-        queue behind a stalled dispatch would stall the committee); the
-        done-callback retrieves any exception so a failed
-        measurement-only dispatch never warns about unretrieved
-        exceptions."""
+    def _spawn_device(
+        self,
+        loop,
+        claims: list,
+        measure_only: bool = False,
+        deadline: float | None = None,
+    ):
+        """Start a device dispatch on a worker thread and register it in
+        the in-flight table (occupancy + deadline stamp drive routing).
+        The done-callback frees the slot, wakes any dispatcher queued in
+        _wait_for_slot, and retrieves the exception of measurement-only
+        dispatches so they never warn about unretrieved exceptions.
+        Returns ``(executor_future, end_holder)``; the worker appends
+        its completion stamp to ``end_holder`` under the profiler so the
+        lander can charge the executor->loop wakeup gap to
+        verdict.fanout."""
         if self._executor is None:
-            # one worker: the device serializes dispatches anyway, and a
-            # single thread keeps the backend free of data races
+            # one worker per pipeline slot: jax.block_until_ready
+            # releases the GIL, so while wave N parks on the device,
+            # wave N+1 stages on the next thread — that overlap IS the
+            # pipeline.  The backends are thread-compatible (table
+            # rebuilds publish atomically under their own lock).
             self._executor = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="verify"
+                max_workers=self.pipeline_depth, thread_name_prefix="verify"
             )
-        self._device_busy = True
-        t_spawn = (
-            time.perf_counter_ns() if _spans.recorder() is not None else None
+        self._wave_serial += 1
+        wave = self._wave_serial
+        self._inflight[wave] = time.monotonic() + (
+            deadline if deadline is not None else self._deadline_s()
         )
+        self.peak_inflight = max(self.peak_inflight, len(self._inflight))
+        rec = _spans.recorder()
+        t_spawn = time.perf_counter_ns() if rec is not None else None
+        if rec is not None:
+            # occupancy annotation (value encoded in the dur field, not
+            # a duration — rendered as a counter on the Perfetto track)
+            rec.add("pipeline.occupancy", t_spawn, len(self._inflight))
+        end_holder: list[int] = []
         fut = loop.run_in_executor(
-            self._executor, self._dispatch_sync, claims, t_spawn
+            self._executor, self._dispatch_sync, claims, t_spawn, end_holder
         )
 
         def _done(f):
-            self._device_busy = False
+            self._inflight.pop(wave, None)
+            if self._slot_free is not None:
+                self._slot_free.set()
             if f.cancelled():
                 return
             exc = f.exception()
@@ -472,9 +603,14 @@ class AsyncVerifyService:
                 log.warning("device measurement dispatch failed: %s", exc)
 
         fut.add_done_callback(_done)
-        return fut
+        return fut, end_holder
 
-    def _dispatch_sync(self, claims: list, t_spawn: int | None = None) -> list[bool]:
+    def _dispatch_sync(
+        self,
+        claims: list,
+        t_spawn: int | None = None,
+        end_holder: list | None = None,
+    ) -> list[bool]:
         """Worker-thread body: evaluate on the forced-device dispatch
         view, timing the dispatch for the routing EWMA."""
         rec = _spans.recorder()
@@ -491,7 +627,8 @@ class AsyncVerifyService:
         if rec is not None:
             end_ns = time.perf_counter_ns()
             rec.add("dispatch.wall", t_enter, end_ns - t_enter)
-            self._worker_end_ns = end_ns
+            if end_holder is not None:
+                end_holder.append(end_ns)
         if self._tel_device_wall is not None:
             self._tel_device_wall.add(wall)
         ewma = self._device_ewma_s
@@ -500,8 +637,27 @@ class AsyncVerifyService:
         )
         return out
 
+    async def _wait_for_slot(self) -> None:
+        """Depth-cap backpressure: park until an in-flight wave lands or
+        the earliest in-flight deadline expires (the wave went overdue —
+        the next routing pass serves from the CPU)."""
+        if self._slot_free is None:
+            self._slot_free = asyncio.Event()
+        self._slot_free.clear()
+        if len(self._inflight) < self.pipeline_depth:
+            return  # a wave landed between the route decision and here
+        earliest = min(self._inflight.values())
+        timeout = max(0.005, earliest - time.monotonic() + 0.005)
+        try:
+            await asyncio.wait_for(self._slot_free.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
+        # fresh per dispatcher spawn: the event must belong to the loop
+        # this dispatcher runs on (services can outlive benchmark loops)
+        self._slot_free = asyncio.Event()
         while True:
             # let every task woken by the same network wave enqueue its
             # claims before the batch departs (two passes: receiver ->
@@ -513,7 +669,6 @@ class AsyncVerifyService:
             if not batch:
                 return  # drained — the next submit respawns the task
             rec = _spans.recorder()
-            self._worker_end_ns = None  # per-wave; set by _dispatch_sync
             wave_t0 = min(arrivals) if (rec is not None and arrivals) else None
             if wave_t0 is not None:
                 rec.add(
@@ -544,32 +699,30 @@ class AsyncVerifyService:
                 self._tel_claims_unique.inc(len(claims))
                 self._tel_wave.observe(n_sigs)
 
-            async def serve_cpu(batch) -> None:
-                # CPU serving holds the GIL either way (measured) — run
-                # inline, but per SUBMISSION with yields between, so a
-                # large coalesced wave doesn't block the loop in one
-                # chunk (each core's future resolves as soon as its own
-                # claims are done, matching the inline service's latency
-                # profile).  The memo carries each unique claim's
-                # verdict across the wave's submissions (same purity
-                # argument as the batch dedup above).
-                cpu = getattr(self.backend, "cpu_backend", self.backend)
-                memo: dict = {}
-                for cs, fut in batch:
-                    todo = [c for c in cs if c not in memo]
-                    if todo:
-                        t0 = time.perf_counter()
-                        results = eval_claims_sync(cpu, todo)
-                        if self._tel_host_wall is not None:
-                            self._tel_host_wall.add(time.perf_counter() - t0)
-                        for c, r in zip(todo, results):
-                            memo[c] = r
-                    if not fut.done():
-                        fut.set_result([memo[c] for c in cs])
-                    await asyncio.sleep(0)
-
             try:
                 with _spans.span("route.decide"):
+                    route = self._route_device(n_sigs)
+                waited = False
+                while route == "wait":
+                    # full pipeline, healthy and device-preferred: queue
+                    # for a slot (wave K+1 backpressure) instead of
+                    # spilling to the CPU, then re-route — a freed slot
+                    # goes to the device, an expired deadline to the CPU
+                    if not waited:
+                        waited = True
+                        self.pipeline_waits += 1
+                        if self._tel_route is not None:
+                            self._tel_route["wait"].inc()
+                    t_w = (
+                        time.perf_counter_ns() if rec is not None else None
+                    )
+                    await self._wait_for_slot()
+                    if t_w is not None:
+                        rec.add(
+                            "pipeline.wait",
+                            t_w,
+                            time.perf_counter_ns() - t_w,
+                        )
                     route = self._route_device(n_sigs)
                 if self._tel_route is not None:
                     self._tel_route[route].inc()
@@ -578,51 +731,40 @@ class AsyncVerifyService:
                     # discarded (EWMA updates when it lands); the batch
                     # itself is served from the CPU so a degraded tunnel
                     # never adds wave latency
+                    self.probe_dispatches += 1
                     self._spawn_device(loop, claims, measure_only=True)
                 if route == "device":
                     self.device_dispatches += 1
                     self.device_sigs += n_sigs
-                    exec_fut = self._spawn_device(loop, claims)
-                    # Deadline: a tunnel stall mid-dispatch must not
-                    # stall the committee — on overrun, serve this batch
-                    # from the CPU and let the stuck dispatch land as a
-                    # (bad) EWMA measurement.  Backends may raise the
-                    # floor (BLS: an adversarial storm legitimately
-                    # takes ~0.4 s off-loop; re-running it inline would
-                    # BE the stall).
-                    deadline = max(
-                        getattr(self.backend, "dispatch_deadline_s", 0.1),
-                        4 * (self._device_ewma_s or 0.1),
+                    deadline = self._deadline_s()
+                    exec_fut, end_holder = self._spawn_device(
+                        loop, claims, deadline=deadline
                     )
-                    done, _ = await asyncio.wait({exec_fut}, timeout=deadline)
-                    if exec_fut in done:
-                        results = exec_fut.result()
-                    else:
-                        self.deadline_misses += 1
-                        self._last_probe = time.monotonic()
-                        log.warning(
-                            "device verify dispatch overran its %.0f ms "
-                            "deadline; serving the batch from the CPU",
-                            deadline * 1e3,
-                        )
-                        await serve_cpu(batch)
-                        if wave_t0 is not None:
-                            rec.add(
-                                "e2e",
-                                wave_t0,
-                                time.perf_counter_ns() - wave_t0,
-                            )
-                        self._log_stats()
-                        continue
-                else:
-                    self.cpu_sigs += n_sigs
-                    await serve_cpu(batch)
-                    if wave_t0 is not None:
-                        rec.add(
-                            "e2e", wave_t0, time.perf_counter_ns() - wave_t0
-                        )
-                    self._log_stats()
+                    # async readback (ISSUE 5): the dispatcher does NOT
+                    # await the device — a per-wave lander task lands
+                    # this wave's verdicts when its completion future
+                    # resolves, so waves complete out of order and a
+                    # failure poisons only its own batch.  The
+                    # dispatcher loops straight back to staging the
+                    # next wave.
+                    lander = loop.create_task(
+                        self._land_device(
+                            batch, claims, exec_fut, end_holder,
+                            wave_t0, deadline,
+                        ),
+                        name="verify-lander",
+                    )
+                    self._landers.add(lander)
+                    lander.add_done_callback(self._landers.discard)
                     continue
+                self.cpu_dispatches += 1
+                self.cpu_sigs += n_sigs
+                await self._serve_cpu(batch)
+                if wave_t0 is not None:
+                    rec.add(
+                        "e2e", wave_t0, time.perf_counter_ns() - wave_t0
+                    )
+                self._log_stats()
             except asyncio.CancelledError:
                 for _, fut in batch:
                     if not fut.done():
@@ -637,20 +779,93 @@ class AsyncVerifyService:
                             RuntimeError(f"verify dispatch failed: {e}")
                         )
                 continue
-            verdict = dict(zip(claims, results))
-            fan_t0 = self._worker_end_ns if rec is not None else None
-            for cs, fut in batch:
+
+    async def _serve_cpu(self, batch) -> None:
+        # CPU serving holds the GIL either way (measured) — run
+        # inline, but per SUBMISSION with yields between, so a
+        # large coalesced wave doesn't block the loop in one
+        # chunk (each core's future resolves as soon as its own
+        # claims are done, matching the inline service's latency
+        # profile).  The memo carries each unique claim's
+        # verdict across the wave's submissions (same purity
+        # argument as the batch dedup in _run).
+        cpu = getattr(self.backend, "cpu_backend", self.backend)
+        memo: dict = {}
+        for cs, fut in batch:
+            todo = [c for c in cs if c not in memo]
+            if todo:
+                t0 = time.perf_counter()
+                results = eval_claims_sync(cpu, todo)
+                if self._tel_host_wall is not None:
+                    self._tel_host_wall.add(time.perf_counter() - t0)
+                for c, r in zip(todo, results):
+                    memo[c] = r
+            if not fut.done():
+                fut.set_result([memo[c] for c in cs])
+            await asyncio.sleep(0)
+
+    async def _land_device(
+        self,
+        batch,
+        claims: list,
+        exec_fut,
+        end_holder: list,
+        wave_t0: int | None,
+        deadline: float,
+    ) -> None:
+        """Land one in-flight device wave: await its completion future
+        (bounded by the dispatch deadline), fan its verdicts out to this
+        wave's waiters ONLY.  Deadline overrun serves this batch from
+        the CPU and lets the stuck dispatch land as a (bad) EWMA
+        measurement; a backend exception poisons this wave's futures and
+        nothing else (per-wave error isolation)."""
+        rec = _spans.recorder()
+        try:
+            done, _ = await asyncio.wait({exec_fut}, timeout=deadline)
+            if exec_fut not in done:
+                self.deadline_misses += 1
+                self._last_probe = time.monotonic()
+                log.warning(
+                    "device verify dispatch overran its %.0f ms "
+                    "deadline; serving the batch from the CPU",
+                    deadline * 1e3,
+                )
+                await self._serve_cpu(batch)
+                if rec is not None and wave_t0 is not None:
+                    rec.add(
+                        "e2e", wave_t0, time.perf_counter_ns() - wave_t0
+                    )
+                self._log_stats()
+                return
+            results = exec_fut.result()
+        except asyncio.CancelledError:
+            for _, fut in batch:
                 if not fut.done():
-                    fut.set_result([verdict[c] for c in cs])
-            if rec is not None:
-                end_ns = time.perf_counter_ns()
-                if fan_t0 is not None:
-                    # worker completion -> every waiter's future resolved
-                    # (captures the executor -> loop wakeup gap)
-                    rec.add("verdict.fanout", fan_t0, end_ns - fan_t0)
-                if wave_t0 is not None:
-                    rec.add("e2e", wave_t0, end_ns - wave_t0)
-            self._log_stats()
+                    fut.cancel()
+            raise
+        except Exception as e:  # noqa: BLE001 — a failed wave must reach
+            # its own waiters, and ONLY its own waiters
+            log.warning("verify dispatch failed: %s", e)
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"verify dispatch failed: {e}")
+                    )
+            return
+        verdict = dict(zip(claims, results))
+        fan_t0 = end_holder[0] if (rec is not None and end_holder) else None
+        for cs, fut in batch:
+            if not fut.done():
+                fut.set_result([verdict[c] for c in cs])
+        if rec is not None:
+            end_ns = time.perf_counter_ns()
+            if fan_t0 is not None:
+                # worker completion -> every waiter's future resolved
+                # (captures the executor -> loop wakeup gap)
+                rec.add("verdict.fanout", fan_t0, end_ns - fan_t0)
+            if wave_t0 is not None:
+                rec.add("e2e", wave_t0, end_ns - wave_t0)
+        self._log_stats()
 
     def _log_stats(self) -> None:
         now = time.monotonic()
@@ -661,14 +876,18 @@ class AsyncVerifyService:
             self._next_stats_log = now + 5.0
             log.info(
                 "Verify service stats [%s]: dispatches=%d device=%d "
-                "device_sigs=%d cpu_sigs=%d deadline_misses=%d "
-                "ewma_ms=%.1f",
+                "cpu=%d probe=%d device_sigs=%d cpu_sigs=%d "
+                "deadline_misses=%d waits=%d depth=%d ewma_ms=%.1f",
                 self._stats_tag,
                 self.dispatches,
                 self.device_dispatches,
+                self.cpu_dispatches,
+                self.probe_dispatches,
                 self.device_sigs,
                 self.cpu_sigs,
                 self.deadline_misses,
+                self.pipeline_waits,
+                self.pipeline_depth,
                 (self._device_ewma_s or 0.0) * 1e3,
             )
 
@@ -677,5 +896,8 @@ __all__ = [
     "AsyncVerifyService",
     "eval_claims_sync",
     "flatten_claims",
+    "pipeline_depth_from_env",
     "CPU_US_PER_SIG",
+    "DEFAULT_PIPELINE_DEPTH",
+    "PIPELINE_MARGINAL_COST",
 ]

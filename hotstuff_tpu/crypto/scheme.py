@@ -146,7 +146,14 @@ def make_device_verifier(scheme: str, kind: str) -> VerifierBackend:
         # sharded over the mesh with an all_gather partial-point combine
         # (docs/BLS_TPU_DESIGN.md step 4).  BlsVerifier rejects anything
         # else.
-        return BlsVerifier(aggregator=kind)
+        v = BlsVerifier(aggregator=kind)
+        if not hasattr(v, "dispatch_deadline_s"):
+            # pure-Python pairing fallback (native lib absent): one
+            # equality legitimately takes ~100 ms — the dispatch
+            # pipeline's default 100 ms deadline would demote every
+            # healthy wave back onto the loop it exists to protect
+            v.dispatch_deadline_s = 30.0
+        return v
     raise ValueError(
         "ed25519 device verifiers are constructed by node.make_verifier "
         "(lazy-import hybrid)"

@@ -19,7 +19,30 @@ from .signature import CryptoError, Signature
 
 
 class VerifierBackend(Protocol):
-    """Where batched verification work executes."""
+    """Where batched verification work executes.
+
+    Beyond the three methods, the async dispatch pipeline
+    (crypto/async_service.py) consults OPTIONAL capability attributes
+    via ``getattr``; a backend advertises only what it supports, and
+    absence means the default shown:
+
+    - ``name = "?"`` — backend label for stats/telemetry tags;
+    - ``supports_flat_batch = False`` — ``eval_claims_sync`` may
+      collapse a whole claim wave into one native batch equation;
+    - ``prefers_aggregate = False`` — shared-message claims must route
+      through ``verify_shared_msg`` (BLS: one pairing per claim);
+    - ``async_kind`` (unset) — advertises the off-loop coalescing claim
+      path; one shared service per (event loop, kind);
+    - ``always_offload = False`` — worker-thread offload is always
+      worthwhile (the backend releases the GIL), skip cost-model routing;
+    - ``device_ready = True`` — the device kernel is warm; the service
+      never routes to a backend that would cold-compile mid-consensus;
+    - ``dispatch_deadline_s = 0.1`` — floor for the per-dispatch
+      deadline (raised adaptively from the dispatch EWMA);
+    - ``device_key_cache = False`` — committee key tables are staged
+      device-resident once per rebuild and gathered by row id per wave
+      (tpu/ed25519.BatchVerifier).
+    """
 
     def verify_one(self, digest: Digest, pk: PublicKey, sig: Signature) -> bool: ...
 

@@ -171,6 +171,18 @@ def _apply_profile(args) -> None:
         telemetry.spans.enable()
 
 
+def _apply_verify_pipeline(args) -> None:
+    """Bridge ``--verify-pipeline N`` into HOTSTUFF_VERIFY_PIPELINE (the
+    env-first pattern every other knob uses) so the async verify
+    service — and any child node processes — pick the dispatch pipeline
+    depth up at service construction."""
+    depth = getattr(args, "verify_pipeline", None)
+    if depth is not None:
+        import os
+
+        os.environ["HOTSTUFF_VERIFY_PIPELINE"] = str(max(1, depth))
+
+
 def _apply_fault_plane(args) -> None:
     """Activate the chaos plane when ``--fault-plane`` was given: the
     flag value (a spec file path or inline JSON) lands in
@@ -191,6 +203,7 @@ async def _run_node(args) -> None:
     _apply_journal_dir(args)
     _apply_fault_plane(args)
     _apply_profile(args)
+    _apply_verify_pipeline(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -245,6 +258,7 @@ async def _run_many(args) -> None:
     _apply_journal_dir(args)
     _apply_fault_plane(args)
     _apply_profile(args)
+    _apply_verify_pipeline(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -437,6 +451,18 @@ def main(argv=None) -> int:
         "default: off, or the HOTSTUFF_FAULTS env knob)"
     )
     p_run.add_argument("--fault-plane", default=None, help=faults_help)
+    pipeline_help = (
+        "verify dispatch pipeline depth: device waves in flight at once "
+        "(default: 2, or the HOTSTUFF_VERIFY_PIPELINE env knob; 1 "
+        "restores the single-in-flight dispatch gate)"
+    )
+    p_run.add_argument(
+        "--verify-pipeline",
+        type=int,
+        default=None,
+        metavar="N",
+        help=pipeline_help,
+    )
 
     p_many = sub.add_parser(
         "run-many",
@@ -458,6 +484,13 @@ def main(argv=None) -> int:
     p_many.add_argument("--journal-dir", default=None, help=journal_help)
     p_many.add_argument("--profile", action="store_true", help=profile_help)
     p_many.add_argument("--fault-plane", default=None, help=faults_help)
+    p_many.add_argument(
+        "--verify-pipeline",
+        type=int,
+        default=None,
+        metavar="N",
+        help=pipeline_help,
+    )
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
@@ -471,6 +504,13 @@ def main(argv=None) -> int:
     p_dep.add_argument("--journal-dir", default=None, help=journal_help)
     p_dep.add_argument("--profile", action="store_true", help=profile_help)
     p_dep.add_argument("--fault-plane", default=None, help=faults_help)
+    p_dep.add_argument(
+        "--verify-pipeline",
+        type=int,
+        default=None,
+        metavar="N",
+        help=pipeline_help,
+    )
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
@@ -490,6 +530,7 @@ def main(argv=None) -> int:
     if args.command == "deploy":
         _apply_fault_plane(args)
         _apply_profile(args)
+        _apply_verify_pipeline(args)
         asyncio.run(
             _deploy_testbed(
                 args.nodes,

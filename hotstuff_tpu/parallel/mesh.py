@@ -155,6 +155,11 @@ class ShardedBatchVerifier(BatchVerifier):
             # same power-of-4 progression as the base class
             self.pad_sizes = tuple(m * p for p in (1, 4, 16, 64, 256, 1024))
 
+    # the shard_map kernel owns array placement: committee rows must
+    # arrive as host arrays for the in_specs sharding, not pre-committed
+    # to a single device by the base class's staged gather
+    device_key_cache = False
+
     def _run_kernel(self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
         return self._kernel(
             jnp.asarray(ax),

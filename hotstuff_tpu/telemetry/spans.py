@@ -69,6 +69,7 @@ STAGE_BOUNDS_MS: tuple[float, ...] = tuple(1e-3 * 2**i for i in range(28))
 LEAF_STAGES: tuple[str, ...] = (
     "coalesce.wait",
     "route.decide",
+    "pipeline.wait",
     "queue.wait",
     "flatten",
     "prepare",
@@ -87,6 +88,12 @@ PARENT_STAGES: tuple[str, ...] = (
     "agg.verify",
     "scheme.route",
 )
+
+#: value annotations (ISSUE 5): span records whose "dur" field encodes
+#: a VALUE, not a duration — pipeline.occupancy carries the in-flight
+#: wave depth at each device spawn.  Excluded from waterfall sums and
+#: rendered as a counter series on the Perfetto verify-pipeline track.
+ANNOTATION_STAGES: tuple[str, ...] = ("pipeline.occupancy",)
 
 _RECORDER: "SpanRecorder | None" = None
 _ENV_CHECKED = False
@@ -226,7 +233,11 @@ class SpanRecorder:
                     {"stage": name},
                     bounds=STAGE_BOUNDS_MS,
                 )
-            hist.observe(dur_ns / 1e6)
+            # annotation stages carry a value in the dur field, not
+            # nanoseconds — observe it raw (e.g. in-flight wave depth)
+            hist.observe(
+                dur_ns if name in ANNOTATION_STAGES else dur_ns / 1e6
+            )
         sink = _SINK
         if sink is not None:
             try:
@@ -259,6 +270,7 @@ __all__ = [
     "STAGE_BOUNDS_MS",
     "LEAF_STAGES",
     "PARENT_STAGES",
+    "ANNOTATION_STAGES",
     "recorder",
     "span",
     "enabled",

@@ -111,6 +111,31 @@ def to_mont_limbs(x: int) -> np.ndarray:
     return _int_to_limbs((x % Q) * R_MONT % Q)
 
 
+# R^2 mod q in limb form: mont_mul(a_plain, R2) = REDC(a * R^2) = a*R,
+# i.e. one device multiply converts a PLAIN limb vector to Montgomery
+# form — the hook that lets staging ship raw byte-split limbs (ISSUE 5)
+R2_LIMBS = _int_to_limbs(RADIX * RADIX % Q)
+
+_BLS_LIMB_WEIGHTS = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(
+    np.int32
+)
+
+
+def ints_to_limbs_batch(vals: list[int]) -> np.ndarray:
+    """[n] integers mod q -> [n, NLIMBS] PLAIN (non-Montgomery) limb
+    rows, vectorized: one bytes join + bit-matrix split replaces n
+    Python bignum multiplies (the old per-point ``to_mont_limbs`` loop
+    held the GIL for the whole staging pass)."""
+    n = len(vals)
+    rows = np.frombuffer(
+        b"".join(v.to_bytes(48, "big") for v in vals), np.uint8
+    ).reshape(n, 48)
+    bits = np.unpackbits(rows[:, ::-1], axis=1, bitorder="little")
+    bits = np.pad(bits, [(0, 0), (0, NLIMBS * LIMB_BITS - 384)])
+    groups = bits.reshape(n, NLIMBS, LIMB_BITS).astype(np.int32)
+    return groups @ _BLS_LIMB_WEIGHTS
+
+
 def from_mont_int(limbs) -> int:
     """Host: loose Montgomery-form limbs -> canonical integer mod q."""
     return limbs_to_int(limbs) * pow(R_MONT, -1, Q) % Q
@@ -234,6 +259,21 @@ def _aggregate_kernel(xs, ys, zs):
     return tuple(c[0] for c in _tree_reduce((xs, ys, zs)))
 
 
+@partial(jax.jit, static_argnames=())
+def _aggregate_plain_kernel(xs, ys, zs):
+    """Same contract as ``_aggregate_kernel`` but over PLAIN limb rows:
+    the Montgomery conversion (one mont_mul by R^2 per coordinate) rides
+    inside the same dispatch, so the host stages raw byte-split limbs
+    and never does per-point bignum arithmetic (ISSUE 5).  Identity pads
+    are plain (0 : 1 : 0).  mont_mul output is < 3.2q loose — well
+    inside the < ~60q input bound of the point-add tree."""
+    r2 = jnp.broadcast_to(jnp.asarray(R2_LIMBS), xs.shape)
+    return tuple(
+        c[0]
+        for c in _tree_reduce(tuple(mont_mul(c, r2) for c in (xs, ys, zs)))
+    )
+
+
 def make_sharded_g1_aggregate(mesh):
     """Cross-device G1 aggregation (docs/BLS_TPU_DESIGN.md step 4):
     the batch axis is sharded over the mesh's ``dp`` axis; each device
@@ -333,24 +373,43 @@ class TpuG1Aggregator:
             return G1Point.identity()
         with _spans.span("prepare"):
             padded = self._padded_size(len(real))
+            m = len(real)
             xs = np.zeros((padded, NLIMBS), np.int32)
             ys = np.zeros((padded, NLIMBS), np.int32)
             zs = np.zeros((padded, NLIMBS), np.int32)
-            one = to_mont_limbs(1)
-            for i, pt in enumerate(real):
-                xs[i] = to_mont_limbs(pt.x)
-                ys[i] = to_mont_limbs(pt.y)
-                zs[i] = one
-            for i in range(len(real), padded):
-                ys[i] = one  # identity rows: (0 : 1 : 0)
-
-        kernel = self._sharded if self._sharded is not None else _aggregate_kernel
+            if self._sharded is None:
+                # vectorized staging (ISSUE 5): ship PLAIN byte-split
+                # limbs; the kernel Montgomery-converts on device, so
+                # prepare does no per-point bignum arithmetic.  Real
+                # rows are (x : y : 1) plain, identity pads (0 : 1 : 0)
+                # plain — both mont-convert correctly in-kernel.
+                xs[:m] = ints_to_limbs_batch([pt.x for pt in real])
+                ys[:m] = ints_to_limbs_batch([pt.y for pt in real])
+                zs[:m, 0] = 1
+                ys[m:, 0] = 1
+                kernel = _aggregate_plain_kernel
+            else:
+                # sharded path: the shard_map kernel's contract is
+                # Montgomery-form rows — keep the host conversion
+                one = to_mont_limbs(1)
+                for i, pt in enumerate(real):
+                    xs[i] = to_mont_limbs(pt.x)
+                    ys[i] = to_mont_limbs(pt.y)
+                    zs[i] = one
+                for i in range(m, padded):
+                    ys[i] = one  # identity rows: (0 : 1 : 0)
+                kernel = self._sharded
         rec = _spans.recorder()
         if rec is None:
             x, y, z = kernel(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
+            # same fence as the profiled path (ISSUE 5): the dispatch
+            # pipeline parks this worker thread here with the GIL
+            # released while the next wave stages — the profiler
+            # measures exactly what production runs
+            x, y, z = jax.block_until_ready((x, y, z))
         else:
-            # profiling: the block_until_ready fence exists only under
-            # the profiler (production lets np.asarray block)
+            # profiling: split the dispatch into its waterfall stages;
+            # structurally identical to the production path above
             with rec.span("dispatch"):
                 x, y, z = kernel(
                     jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs)

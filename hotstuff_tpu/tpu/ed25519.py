@@ -79,6 +79,14 @@ def _verify_kernel_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
 PALLAS_PAD_SIZES = (128, 256, 1024)
 
 
+@jax.jit
+def _gather_rows(tables, idxs):
+    """Device-side committee-key gather (ISSUE 5): index the
+    device-resident stacked point tables by row id, so a wave transfers
+    [n] int64 indices instead of 4x[n,20] int32 coordinate rows."""
+    return tuple(t[idxs] for t in tables)
+
+
 def _bytes_to_limbs(b: bytes, lo_bits: int = 255) -> np.ndarray:
     v = int.from_bytes(b, "little") & ((1 << lo_bits) - 1)
     out = np.zeros(F.NLIMBS, np.int32)
@@ -89,6 +97,9 @@ def _bytes_to_limbs(b: bytes, lo_bits: int = 255) -> np.ndarray:
 
 
 _LIMB_WEIGHTS = (1 << np.arange(F.LIMB_BITS, dtype=np.int32)).astype(np.int32)
+
+# big-endian bytes of the group order, for the vectorized s < L check
+_L_BE = np.frombuffer(ref.L.to_bytes(32, "big"), np.uint8)
 
 
 _WIN_WEIGHTS = (1 << np.arange(curve.WINDOW - 1, -1, -1)).astype(np.int32)
@@ -147,6 +158,19 @@ class BatchVerifier:
 
         self._table_lock = threading.Lock()
         self._tables: tuple | None = None
+        # Device-resident committee key cache (ISSUE 5): the stacked
+        # coordinate tables staged on device ONCE per rebuild (committee
+        # keys are static per epoch), so each wave ships only the [n]
+        # row indices and gathers coordinates device-side instead of
+        # re-transferring 4x[n,20] int32 every dispatch.  _device_src
+        # identifies the host build the staged copy mirrors.
+        self._device_tables: tuple | None = None
+        self._device_src: tuple | None = None
+        # Per-thread staging scratch, keyed by padded size: the pipeline
+        # runs prepare() on up to pipeline_depth worker threads at once,
+        # so buffers are thread-local rather than shared (reuse across
+        # waves without a lock).
+        self._scratch = threading.local()
         # The Pallas VMEM-resident kernel is the fast path on real TPU
         # hardware; the XLA kernel is the portable fallback (CPU tests,
         # sharded-mesh subclass).  use_pallas=None defers autodetection
@@ -229,6 +253,41 @@ class BatchVerifier:
                 self._tables = None  # stacked table is stale
         return hit
 
+    # staged device-side committee gather; the mesh-sharded subclass
+    # disables it (its shard_map kernel owns array placement)
+    device_key_cache = True
+
+    def _device_build(self, build):
+        """The device-resident copy of ``build``'s stacked tables,
+        staged on first use after each rebuild.  Idempotent and safe
+        without a lock: concurrent stagers both produce a valid copy of
+        the same immutable build and last-write-wins."""
+        if self._device_src is not build:
+            tables, _ = build
+            self._device_tables = tuple(jnp.asarray(t) for t in tables)
+            self._device_src = build
+        return self._device_tables
+
+    def _scratch_for(self, padded: int) -> dict:
+        """Preallocated per-thread staging buffers for this pad shape,
+        zeroed for reuse (one memset replaces the per-item Python
+        writes the old prepare loop did)."""
+        pool = getattr(self._scratch, "pool", None)
+        if pool is None:
+            pool = self._scratch.pool = {}
+        bufs = pool.get(padded)
+        if bufs is None:
+            bufs = pool[padded] = {
+                "sig": np.zeros((padded, 64), np.uint8),
+                "k": np.zeros((padded, 32), np.uint8),
+                "r_sign": np.zeros(padded, np.int32),
+                "idxs": np.zeros(padded, np.int64),
+            }
+        else:
+            for a in bufs.values():
+                a.fill(0)
+        return bufs
+
     def _rebuild_tables(self):
         """Build (tables, row_index) FULLY in locals, then publish with
         one atomic assignment — this object is shared across the event
@@ -256,20 +315,6 @@ class BatchVerifier:
             self._tables = build
             self._row_index = row_index
             return build
-
-    def _prepare_item(self, msg, pk, sig):
-        """Per-item acceptance rules for batch preparation.  Returns
-        None if the item is invalid, else (neg_point, s, k)."""
-        if len(sig) != 64 or len(pk) != 32:
-            return None
-        pt = self._neg_point(pk)
-        if pt is None:
-            return None
-        s = int.from_bytes(sig[32:], "little")
-        if s >= ref.L:
-            return None
-        k = ref.verify_challenge(sig, pk, msg)
-        return pt, s, k
 
     def verify(
         self,
@@ -325,11 +370,15 @@ class BatchVerifier:
                 messages, pubkeys, signatures
             )
             ok = kernel(*arrays)
+            # same fence as the profiled path (ISSUE 5): overlap now
+            # happens at the WAVE level — the dispatch pipeline parks
+            # this worker thread here (GIL released) while the next
+            # wave stages on another thread — so the profiler measures
+            # exactly what production runs
+            ok = jax.block_until_ready(ok)
             return np.asarray(ok)[:n] & valid_host
-        # profiling: split the dispatch into its waterfall stages.  The
-        # block_until_ready fence exists ONLY under the profiler — the
-        # production path lets np.asarray block, overlapping transfer
-        # with whatever XLA still has in flight.
+        # profiling: split the dispatch into its waterfall stages;
+        # structurally identical to the production path above
         with rec.span("prepare"):
             kernel, arrays, valid_host = self.stage(
                 messages, pubkeys, signatures
@@ -369,75 +418,96 @@ class BatchVerifier:
         challenge hashing, limb/bit decomposition, shape padding —
         vectorized with numpy so prep never outruns the device kernel.
         Returns (host_validity[n], kernel_arrays) where kernel_arrays feed
-        ``_run_kernel`` directly."""
+        ``_run_kernel`` directly.
+
+        Vectorized staging (ISSUE 5): buffers are preallocated at the
+        PADDED shape per worker thread and reused across waves, so a
+        wave costs one memset + block numpy ops; the only remaining
+        per-item Python is key decompression (cached, epoch-static) and
+        the SHA-512 challenge hash (no batch API on the host)."""
         n = len(messages)
-        valid_host = np.ones(n, bool)  # host-side rejections
-        scalar_bytes_s = np.zeros((n, 32), np.uint8)
-        scalar_bytes_k = np.zeros((n, 32), np.uint8)
-        r_bytes = np.zeros((n, 32), np.uint8)
-        r_sign = np.zeros(n, np.int32)
+        padded = next(p for p in self._padded_sizes() if p >= n)
+        bufs = self._scratch_for(padded)
+        sig_rows = bufs["sig"]
+        k_rows = bufs["k"]
+        r_sign = bufs["r_sign"]
+        idxs = bufs["idxs"]
 
-        for i, (msg, pk, sig) in enumerate(zip(messages, pubkeys, signatures)):
-            item = self._prepare_item(msg, pk, sig)
-            if item is None:
-                valid_host[i] = False
-                continue
-            _pt, s, k = item
-            scalar_bytes_s[i] = np.frombuffer(sig[32:], np.uint8)
-            scalar_bytes_k[i] = np.frombuffer(
-                k.to_bytes(32, "little"), np.uint8
-            )
-            r_bytes[i] = np.frombuffer(sig[:32], np.uint8)
-            r_sign[i] = sig[31] >> 7
+        # malformed-length rejections (rare; everything else vectorizes)
+        valid_host = np.array(
+            [
+                len(sig) == 64 and len(pk) == 32
+                for sig, pk in zip(signatures, pubkeys)
+            ],
+            dtype=bool,
+        )
+        if valid_host.all():
+            sig_rows[:n] = np.frombuffer(
+                b"".join(signatures), np.uint8
+            ).reshape(n, 64)
+        else:
+            for i in np.flatnonzero(valid_host):
+                sig_rows[i] = np.frombuffer(signatures[i], np.uint8)
 
-        # point rows: ONE fancy-index gather from the stacked committee
-        # tables (index 0 = zero dummy for invalid items — their scalars
-        # are zero too, so the kernel computes the identity and
-        # valid_host masks the lane out, exactly as before).  Snapshot
-        # the build once: any build taken here post-dates this batch's
-        # cache inserts (the item loop above decompressed every key
-        # BEFORE invalidating), so row_of covers every valid pk in the
-        # batch even if another thread rebuilds concurrently.
+        # s >= L rejection, vectorized: lexicographic compare of each
+        # scalar (big-endian view of sig[32:]) against L; rows equal to
+        # L have no differing byte and are rejected too
+        s_be = sig_rows[:n, :31:-1]
+        diff = s_be != _L_BE
+        any_diff = diff.any(axis=1)
+        first = np.where(any_diff, diff.argmax(axis=1), 0)
+        valid_host &= (s_be[np.arange(n), first] < _L_BE[first]) & any_diff
+
+        # committee points: decompress any unseen key once (the cache
+        # insert marks the stacked build stale), THEN snapshot one
+        # build — it post-dates this batch's inserts, so row_of covers
+        # every valid pk here even if another thread rebuilds
+        # concurrently.  Index 0 is the zero dummy row: invalid items
+        # keep it, their scalars are zeroed below, and the kernel
+        # computes the identity while valid_host masks the lane out.
+        for i in np.flatnonzero(valid_host):
+            if pubkeys[i] not in self._point_cache:
+                self._neg_point(pubkeys[i])
         build = self._tables
         if build is None:
             build = self._rebuild_tables()
         tables, row_of = build
-        idxs = np.zeros(n, np.int64)
-        for i, pk in enumerate(pubkeys):
-            if valid_host[i]:
-                idxs[i] = row_of.get(pk, 0)
-        ax, ay, az, at = (t[idxs] for t in tables)
+        for i in np.flatnonzero(valid_host):
+            row = row_of.get(pubkeys[i], 0)
+            if row:
+                idxs[i] = row
+            else:
+                valid_host[i] = False  # key decompresses to no point
 
-        # scalars -> MSB-first window planes [n, NWIN]
-        s_bits = _bytes_to_windows_msb(scalar_bytes_s)
-        k_bits = _bytes_to_windows_msb(scalar_bytes_k)
-        # R encodings -> raw 13-bit limb split of the low 255 bits
-        r_y = _bytes_rows_to_limbs(r_bytes)
+        # challenge hashes: the irreducible per-item host work
+        for i in np.flatnonzero(valid_host):
+            k = ref.verify_challenge(signatures[i], pubkeys[i], messages[i])
+            k_rows[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+        bad = ~valid_host
+        if bad.any():
+            sig_rows[:n][bad] = 0  # zero scalars -> identity lanes
+        r_sign[:n] = sig_rows[:n, 31] >> 7
 
-        # pad to a static shape; padding rows are s=0,k=0 -> P=identity,
-        # which compresses to y=1,sign=0 — set r_y accordingly so pads pass.
-        padded = next(p for p in self._padded_sizes() if p >= n)
+        # decompositions run at the padded shape directly — pad lanes
+        # are all-zero rows (s=0,k=0 -> P=identity, which compresses to
+        # y=1,sign=0; r_y gets the matching 'one' rows so pads pass)
+        s_bits = _bytes_to_windows_msb(sig_rows[:, 32:])
+        k_bits = _bytes_to_windows_msb(k_rows)
+        r_y = _bytes_rows_to_limbs(sig_rows[:, :32])
         if padded > n:
-            pad = padded - n
+            r_y[n:, 0] = 1
 
-            def padrows(a, fill_rows):
-                return np.concatenate([a, fill_rows], axis=0)
+        # point rows by row id: device-resident gather when the staged
+        # committee table is usable (one [padded] index transfer instead
+        # of 4x[padded,20] coordinate rows), host fancy-index otherwise
+        if self.device_key_cache:
+            ax, ay, az, at = _gather_rows(self._device_build(build), idxs)
+        else:
+            ax, ay, az, at = (t[idxs] for t in tables)
 
-            one = np.zeros((pad, F.NLIMBS), np.int32)
-            one[:, 0] = 1
-            zero = np.zeros((pad, F.NLIMBS), np.int32)
-            ax, ay, az, at = (
-                padrows(ax, zero),
-                padrows(ay, one),
-                padrows(az, one),
-                padrows(at, zero),
-            )
-            s_bits = padrows(s_bits, np.zeros((pad, curve.NWIN), np.int32))
-            k_bits = padrows(k_bits, np.zeros((pad, curve.NWIN), np.int32))
-            r_y = padrows(r_y, one)
-            r_sign = np.concatenate([r_sign, np.zeros(pad, np.int32)])
-
-        return valid_host, (ax, ay, az, at, s_bits.T, k_bits.T, r_y, r_sign)
+        return valid_host, (
+            ax, ay, az, at, s_bits.T, k_bits.T, r_y, r_sign.copy(),
+        )
 
     def _run_kernel(self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
         """Device dispatch — overridden by the mesh-sharded verifier."""

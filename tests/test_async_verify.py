@@ -433,6 +433,227 @@ def test_registry_prunes_closed_loops():
         loop2.close()
 
 
+class _GatedDeviceHost:
+    """Device host whose every dispatch BLOCKS until its per-wave gate
+    is released — drives out-of-order completion, per-wave failure
+    injection, and in-flight concurrency tracking for the pipeline
+    tests."""
+
+    def __init__(self, kind):
+        import threading
+
+        self.async_kind = kind
+        self.device_ready = True
+        self.cpu_backend = CpuVerifier()
+        # gates held open mid-test must not trip the dispatch deadline
+        self.dispatch_deadline_s = 5.0
+        self.gates: list = []
+        self.fail_waves: set = set()
+        self.concurrent = 0
+        self.max_concurrent = 0
+        self._lock = threading.Lock()
+        host = self
+
+        class _Dispatch:
+            def verify_many(self, digests, pks, sigs, aggregate_ok=False):
+                import threading as _threading
+
+                with host._lock:
+                    idx = len(host.gates)
+                    gate = _threading.Event()
+                    host.gates.append(gate)
+                    host.concurrent += 1
+                    host.max_concurrent = max(
+                        host.max_concurrent, host.concurrent
+                    )
+                try:
+                    assert gate.wait(5.0), "test gate never released"
+                    if idx in host.fail_waves:
+                        raise RuntimeError(f"wave {idx} failed")
+                    return CpuVerifier().verify_many(digests, pks, sigs)
+                finally:
+                    with host._lock:
+                        host.concurrent -= 1
+
+        self.async_backend = _Dispatch()
+
+
+async def _until(cond, timeout=2.0):
+    import time as _time
+
+    t0 = _time.perf_counter()
+    while not cond():
+        assert _time.perf_counter() - t0 < timeout, "condition not reached"
+        await asyncio.sleep(0.005)
+
+
+@async_test
+async def test_out_of_order_completion_resolves_right_futures():
+    """Two waves in flight at depth 2: the LATER wave lands first and
+    resolves its own waiters with its own verdicts while the earlier
+    wave is still on the device (async readback, ISSUE 5)."""
+    msg_a, msg_b = b"a" * 32, b"b" * 32
+    pk, sig_a = _signed(60, msg_a)
+    claim_a = ("one", msg_a, pk.to_bytes(), sig_a.to_bytes())
+    # sig_a over msg_b is INVALID — distinct verdicts prove the futures
+    # were matched to the right waves
+    claim_b = ("one", msg_b, pk.to_bytes(), sig_a.to_bytes())
+    host = _GatedDeviceHost("ooo-test")
+    service = AsyncVerifyService(host, device=True, pipeline_depth=2)
+    task_a = asyncio.ensure_future(service.verify_claims([claim_a]))
+    await _until(lambda: len(host.gates) == 1)
+    task_b = asyncio.ensure_future(service.verify_claims([claim_b]))
+    await _until(lambda: len(host.gates) == 2)
+    assert service.peak_inflight == 2
+    host.gates[1].set()  # wave B lands FIRST
+    assert (await task_b) == [False]
+    assert not task_a.done()  # A still parked on the device
+    host.gates[0].set()
+    assert (await task_a) == [True]
+    service.close()
+
+
+@async_test
+async def test_failed_wave_poisons_only_its_own_futures():
+    """A backend exception on wave N reaches wave N's waiters and ONLY
+    wave N's — the in-flight wave behind it lands normally."""
+    msg_a, msg_b = b"c" * 32, b"e" * 32
+    pk_a, sig_a = _signed(61, msg_a)
+    pk_b, sig_b = _signed(62, msg_b)
+    host = _GatedDeviceHost("poison-test")
+    host.fail_waves = {0}
+    service = AsyncVerifyService(host, device=True, pipeline_depth=2)
+    task_a = asyncio.ensure_future(
+        service.verify_claims([("one", msg_a, pk_a.to_bytes(), sig_a.to_bytes())])
+    )
+    await _until(lambda: len(host.gates) == 1)
+    task_b = asyncio.ensure_future(
+        service.verify_claims([("one", msg_b, pk_b.to_bytes(), sig_b.to_bytes())])
+    )
+    await _until(lambda: len(host.gates) == 2)
+    host.gates[0].set()
+    try:
+        await task_a
+        raise AssertionError("poisoned wave returned a verdict")
+    except RuntimeError:
+        pass
+    host.gates[1].set()
+    assert (await task_b) == [True]
+    service.close()
+
+
+@async_test
+async def test_depth_cap_backpressure_queues_next_wave(monkeypatch):
+    """Wave K+1 QUEUES for a pipeline slot at full occupancy instead of
+    dispatching past the depth cap (or spilling to the CPU when the
+    device is the forced route), and dispatches as soon as a wave
+    lands."""
+    monkeypatch.setenv("HOTSTUFF_FORCE_DEVICE_ROUTE", "1")
+    claims = []
+    for i in range(3):
+        msg = bytes([100 + i]) * 32
+        pk, sig = _signed(70 + i, msg)
+        claims.append(("one", msg, pk.to_bytes(), sig.to_bytes()))
+    host = _GatedDeviceHost("cap-test")
+    service = AsyncVerifyService(host, device=True, pipeline_depth=2)
+    tasks = []
+    for i in range(2):
+        tasks.append(asyncio.ensure_future(service.verify_claims([claims[i]])))
+        await _until(lambda i=i: len(host.gates) == i + 1)
+    tasks.append(asyncio.ensure_future(service.verify_claims([claims[2]])))
+    await asyncio.sleep(0.05)
+    # the third wave queued: never a third concurrent dispatch
+    assert len(host.gates) == 2
+    assert service.pipeline_waits == 1
+    host.gates[0].set()  # a slot frees -> the queued wave dispatches
+    await _until(lambda: len(host.gates) == 3)
+    host.gates[1].set()
+    host.gates[2].set()
+    assert await asyncio.gather(*tasks) == [[True]] * 3
+    assert host.max_concurrent <= 2
+    assert service.peak_inflight == 2
+    service.close()
+
+
+@async_test
+async def test_depth_one_preserves_single_inflight(monkeypatch):
+    """pipeline_depth=1 restores the old single-in-flight dispatch gate:
+    at no point are two device dispatches concurrent."""
+    monkeypatch.setenv("HOTSTUFF_FORCE_DEVICE_ROUTE", "1")
+    msg_a, msg_b = b"f" * 32, b"g" * 32
+    pk_a, sig_a = _signed(80, msg_a)
+    pk_b, sig_b = _signed(81, msg_b)
+    host = _GatedDeviceHost("depth1-test")
+    service = AsyncVerifyService(host, device=True, pipeline_depth=1)
+    task_a = asyncio.ensure_future(
+        service.verify_claims([("one", msg_a, pk_a.to_bytes(), sig_a.to_bytes())])
+    )
+    await _until(lambda: len(host.gates) == 1)
+    task_b = asyncio.ensure_future(
+        service.verify_claims([("one", msg_b, pk_b.to_bytes(), sig_b.to_bytes())])
+    )
+    await asyncio.sleep(0.05)
+    assert len(host.gates) == 1  # second wave queued behind the gate
+    host.gates[0].set()
+    await _until(lambda: len(host.gates) == 2)
+    host.gates[1].set()
+    assert await asyncio.gather(task_a, task_b) == [[True], [True]]
+    assert host.max_concurrent == 1
+    assert service.peak_inflight == 1
+    service.close()
+
+
+def test_route_under_full_occupancy(monkeypatch):
+    """Routing at the depth cap: device-preferred waves queue ("wait"),
+    device-losing waves spill to the CPU, a due probe NEVER fires (it
+    would need the slot we don't have), and an overdue in-flight wave
+    routes everything to the CPU."""
+    import time as _time
+
+    class DeviceBackend(CpuVerifier):
+        async_kind = "occupancy-route-test"
+        device_ready = True
+
+    monkeypatch.delenv("HOTSTUFF_FORCE_DEVICE_ROUTE", raising=False)
+    service = AsyncVerifyService(DeviceBackend(), device=True, pipeline_depth=2)
+    now = _time.monotonic()
+    service._inflight = {1: now + 10.0, 2: now + 10.0}
+    service._last_probe = 0.0  # a probe is long overdue
+    # device EWMA wins for this batch size -> queue for a slot
+    service._device_ewma_s = 0.001
+    assert service._route_device(256) == "wait"
+    # device EWMA loses badly -> CPU, and the due probe must NOT fire
+    service._device_ewma_s = 10.0
+    assert service._route_device(1) == "cpu"
+    # the forced route queues rather than spilling
+    monkeypatch.setenv("HOTSTUFF_FORCE_DEVICE_ROUTE", "1")
+    assert service._route_device(1) == "wait"
+    monkeypatch.delenv("HOTSTUFF_FORCE_DEVICE_ROUTE")
+    # an OVERDUE in-flight wave routes everything to the CPU
+    service._inflight[1] = now - 1.0
+    service._device_ewma_s = 0.001
+    assert service._route_device(256) == "cpu"
+    service._inflight.clear()
+    # below the cap the due probe finally fires on a losing EWMA
+    service._device_ewma_s = 10.0
+    assert service._route_device(1) == "probe"
+    service.close()
+
+
+def test_pipeline_depth_from_env(monkeypatch):
+    from hotstuff_tpu.crypto.async_service import (
+        DEFAULT_PIPELINE_DEPTH,
+        pipeline_depth_from_env,
+    )
+
+    monkeypatch.delenv("HOTSTUFF_VERIFY_PIPELINE", raising=False)
+    assert pipeline_depth_from_env() == DEFAULT_PIPELINE_DEPTH
+    monkeypatch.setenv("HOTSTUFF_VERIFY_PIPELINE", "4")
+    assert pipeline_depth_from_env() == 4
+    monkeypatch.setenv("HOTSTUFF_VERIFY_PIPELINE", "0")
+    assert pipeline_depth_from_env() == 1  # floor: depth 0 is depth 1
+
+
 def test_no_claim_dedup_gives_private_services(monkeypatch):
     """HOTSTUFF_NO_CLAIM_DEDUP=1 (the --no-claim-dedup harness knob)
     must give every core a private device service: no cross-core

@@ -273,6 +273,38 @@ def test_perfgate_load_reference_prefers_latest(tmp_path):
     assert pg.load_reference(str(tmp_path / "empty")) is None
 
 
+def test_perfgate_pipeline_throughput_guard():
+    """The ISSUE 5 guard: sustained wave-train throughput may not fall
+    >15%; a reference that predates the ``pipeline`` block is skipped."""
+    pg = _perfgate()
+    ref = {"pipeline": {"train_sigs_per_s": 100_000}}
+    assert pg.compare({"pipeline": {"train_sigs_per_s": 99_000}}, ref) == []
+    assert pg.compare({"pipeline": {"train_sigs_per_s": 140_000}}, ref) == []
+    fails = pg.compare({"pipeline": {"train_sigs_per_s": 60_000}}, ref)
+    assert len(fails) == 1 and "train_sigs_per_s" in fails[0]
+    assert "fell" in fails[0]
+    # old reference without the block -> skipped, not failed
+    assert pg.compare({"pipeline": {"train_sigs_per_s": 60_000}}, {}) == []
+    assert pg.compare({}, ref) == []
+
+
+def test_perfgate_tunnel_guard_is_wide():
+    """The tunnel round trip swings ~6x between runs of the same build,
+    so its PER-GUARD gate (800%) overrides the run threshold: weather
+    passes, order-of-magnitude blowups fail."""
+    pg = _perfgate()
+    ref = {"tunnel_dispatch_p50_ms": 0.7}
+    # 0.7 -> 4.5 ms is observed weather (+543%) — inside the wide gate
+    # even at the default 15% run threshold
+    assert pg.compare({"tunnel_dispatch_p50_ms": 4.5}, ref) == []
+    fails = pg.compare({"tunnel_dispatch_p50_ms": 10.0}, ref)
+    assert len(fails) == 1 and "tunnel_dispatch_p50_ms" in fails[0]
+    # the per-guard gate also wins over a LOOSER run threshold
+    assert pg.compare(
+        {"tunnel_dispatch_p50_ms": 10.0}, ref, threshold=50.0
+    ) != []
+
+
 def test_perfgate_repo_reference_exists():
     """The committed BENCH_r*.json artifacts must keep satisfying the
     gate's reference contract."""
@@ -281,6 +313,63 @@ def test_perfgate_repo_reference_exists():
     assert ref is not None
     doc, _ = ref
     assert doc["qc_verify_ms"]["256"]["rig_p50_ms"] > 0
+
+
+# ---- wave-train mode (ISSUE 5) ------------------------------------------
+
+
+def test_make_train_claims_distinct_digests_one_committee():
+    """Every wave carries a DISTINCT digest (defeats the service's
+    cross-wave claim dedup) signed by the SAME committee (keeps the
+    device-resident key cache hot across the train)."""
+    from benchmark.profile import make_train_claims
+
+    claims, pks = make_train_claims(4, waves=3)
+    assert len(claims) == 3 and len(pks) == 4
+    digests = [c[1] for c in claims]
+    assert len(set(digests)) == 3
+    for kind, _digest, votes in claims:
+        assert kind == "shared" and len(votes) == 4
+        assert [pk for pk, _sig in votes] == pks
+    # and the claims are genuinely valid QC-shaped work
+    from hotstuff_tpu.crypto.async_service import eval_claims_sync
+    from hotstuff_tpu.crypto.service import CpuVerifier
+
+    assert eval_claims_sync(CpuVerifier(), claims) == [True] * 3
+
+
+def test_format_train_summary():
+    from benchmark.profile import format_train
+
+    result = {
+        "verifier": "tpu",
+        "qc_size": 256,
+        "train_waves": 8,
+        "reps": 3,
+        "depths": {
+            1: {
+                "single_wave_p50_ms": 2.0,
+                "train_p50_ms": 16.0,
+                "amortized_wave_ms": 2.0,
+                "peak_inflight": 1,
+                "train_sigs_per_s": 128_000.0,
+            },
+            2: {
+                "single_wave_p50_ms": 2.0,
+                "train_p50_ms": 12.0,
+                "amortized_wave_ms": 1.5,
+                "peak_inflight": 2,
+                "train_sigs_per_s": 170_000.0,
+            },
+        },
+        "overlap_speedup": 1.33,
+        "overlap_efficiency_pct": 25.0,
+    }
+    text = format_train(result)
+    assert "sustained verify wave-train" in text
+    assert "QC size 256" in text and "8 waves/train" in text
+    assert "1.33x depth-1" in text
+    assert "25.0% of the per-wave round trip hidden" in text
 
 
 # ---- overhead bound (tier-1 acceptance) ---------------------------------
