@@ -5,9 +5,9 @@ uses (AsyncVerifyService + LazyDeviceVerifier), with the span profiler
 (hotstuff_tpu/telemetry/spans.py) on, and renders where each wave's wall
 time went stage by stage:
 
-    claim arrival -> coalesce.wait -> route.decide -> queue.wait ->
-    flatten -> prepare -> dispatch -> device.execute -> readback ->
-    verdict.fanout
+    claim arrival -> coalesce.wait -> route.decide -> stage.pack ->
+    stage.slot_wait -> flatten -> prepare -> dispatch ->
+    device.execute -> readback -> verdict.fanout
 
 The SUMMARY shows per-stage p50/p99 plus each stage's share of the
 externally measured end-to-end latency, and a coverage line — the sum of
@@ -282,6 +282,10 @@ def run_profile(
             # MEASURED, not deadline-demoted mid-profile
             backend.dispatch_deadline_s = 30.0
             svc = AsyncVerifyService(backend, device=True)
+        # pre-compile every wave-bucket shape (no-op unless the backend
+        # advertises wave padding): a measured wave must never pay the
+        # cold XLA compile for its padded bucket
+        svc.warm_buckets()
         try:
             for n in sizes:
                 claim = claims[n][0]
@@ -380,6 +384,7 @@ def run_train(
 
     async def drive(d: int) -> dict:
         svc = AsyncVerifyService(backend, device=True, pipeline_depth=d)
+        svc.warm_buckets()
         try:
             for _ in range(WARMUP_WAVES):
                 assert (await svc.verify_claims([claims[0]])) == [True]

@@ -56,14 +56,34 @@ QUEUES for a slot (bounded by the earliest in-flight deadline) rather
 than spilling to the CPU; an OVERDUE in-flight wave routes everything
 to the CPU, preserving the anti-stall behavior of the old
 single-in-flight gate.
+
+Straight-line tunnel dispatch (ISSUE 6): device dispatches run on a
+dedicated dispatch loop — ``pipeline_depth`` long-lived slot threads
+over one queue — instead of a per-service ``ThreadPoolExecutor`` hop.
+Each slot thread owns its thread-local staging scratch in the device
+backend (tpu/ed25519.py pools scratch per thread), so the slots ARE a
+ring of preallocated staging buffers: wave N parks on the device from
+one slot while wave N+1 stages into the next slot's buffers.  Waves
+routed to a padding-capable backend (``supports_wave_padding``) are
+pre-padded to fixed bucket shapes (``HOTSTUFF_WAVE_BUCKETS``, default
+16/64/256/1024) with always-valid pad claims so ``route.decide ->
+dispatch`` hits a pre-compiled jitted callable every time, and an
+optional round window (``HOTSTUFF_COALESCE_WINDOW_MS``) holds the wave
+open so QC and TC claims from the same round merge into ONE tunnel
+crossing with a claim-table fanout on readback.  The device backend
+donates its staging buffers across waves (``donate_argnums`` in
+tpu/ed25519.py) so XLA reuses device allocations instead of
+re-allocating per wave.
 """
 
 from __future__ import annotations
 
 import asyncio
+import atexit
 import logging
+import queue
+import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 from ..telemetry import spans as _spans
 from .digest import DIGEST_SIZE
@@ -118,6 +138,48 @@ def pipeline_depth_from_env() -> int:
     except ValueError:
         depth = DEFAULT_PIPELINE_DEPTH
     return max(1, depth)
+
+
+# Fixed wave shapes (ISSUE 6): device-routed waves on padding-capable
+# backends are pre-padded with always-valid pad claims to the smallest
+# of these bucket sizes, so every dispatch hits a pre-compiled jitted
+# callable instead of a shape-polymorphic retrace.  Aligned with the
+# tpu/ed25519.py PAD_SIZES grid.
+DEFAULT_WAVE_BUCKETS: tuple[int, ...] = (16, 64, 256, 1024)
+
+
+def wave_buckets_from_env() -> tuple[int, ...]:
+    """Wave bucket sizes from HOTSTUFF_WAVE_BUCKETS (comma-separated,
+    e.g. "16,64,256,1024"); "0"/"off" disables fixed-shape padding
+    (returns an empty tuple).  Unset or unparsable -> the default."""
+    import os
+
+    raw = os.environ.get("HOTSTUFF_WAVE_BUCKETS")
+    if raw is None:
+        return DEFAULT_WAVE_BUCKETS
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "none", "no", "false"):
+        return ()
+    try:
+        sizes = sorted({int(tok) for tok in raw.split(",") if tok.strip()})
+    except ValueError:
+        return DEFAULT_WAVE_BUCKETS
+    return tuple(s for s in sizes if s > 0)
+
+
+def coalesce_window_s_from_env() -> float:
+    """QC+TC coalescing window from HOTSTUFF_COALESCE_WINDOW_MS, in
+    SECONDS.  Default 0: coalescing stays yield-based (two event-loop
+    passes), adding zero latency; a positive window holds each wave
+    open so both certificate kinds from one round share a dispatch."""
+    import os
+
+    raw = os.environ.get("HOTSTUFF_COALESCE_WINDOW_MS", "")
+    try:
+        ms = float(raw)
+    except ValueError:
+        ms = 0.0
+    return max(0.0, ms) * 1e-3
 
 
 def flatten_claims(claims: list) -> tuple[list, list, list, list]:
@@ -217,6 +279,85 @@ def eval_claims_sync(backend, claims: list) -> list[bool]:
     return [all(ok[s:e]) if e > s else False for s, e in spans]
 
 
+#: every live _DispatchLoop, for interpreter-exit shutdown (satellite:
+#: no leaked thread keeps the interpreter from exiting — slot threads
+#: are daemons AND get an explicit sentinel at atexit)
+_live_dispatch_loops: "set[_DispatchLoop]" = set()
+
+
+@atexit.register
+def _shutdown_dispatch_loops() -> None:
+    for dl in list(_live_dispatch_loops):
+        dl.close()
+
+
+class _DispatchLoop:
+    """The dedicated dispatch loop (ISSUE 6): ``depth`` long-lived slot
+    threads over one queue, replacing the per-service
+    ``ThreadPoolExecutor`` hop (thread-pool bookkeeping, per-submit
+    ``concurrent.futures`` machinery, idle-timeout respawn).  Each slot
+    thread keeps its own thread-local staging scratch in the device
+    backend, so a slot is one entry of a preallocated staging-buffer
+    ring: with ``depth`` slots, up to ``depth`` waves stage/execute
+    concurrently and never allocate fresh host buffers.
+
+    Completion callbacks run ON the slot thread — callers hop back to
+    their event loop with ``call_soon_threadsafe``.  Threads are lazy
+    (first ``submit`` starts them), daemonic, and shut down cleanly on
+    ``close()`` and at interpreter exit."""
+
+    def __init__(self, depth: int):
+        self.depth = max(1, int(depth))
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._threads: list[threading.Thread] = []
+        self._closed = False
+        _live_dispatch_loops.add(self)
+
+    def submit(self, fn, on_done) -> None:
+        """Queue ``fn`` for the next free slot thread;
+        ``on_done(result, exc)`` runs on that thread when it finishes."""
+        if self._closed:
+            raise RuntimeError("dispatch loop is closed")
+        if not self._threads:
+            for i in range(self.depth):
+                t = threading.Thread(
+                    target=self._worker,
+                    name=f"verify-slot-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        self._q.put((fn, on_done))
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, on_done = item
+            try:
+                result, exc = fn(), None
+            except BaseException as e:  # noqa: BLE001 — delivered to the
+                result, exc = None, e  # waiter, never raised in the slot
+            try:
+                on_done(result, exc)
+            except Exception:  # noqa: BLE001 — a delivery failure must
+                log.exception("verify dispatch delivery failed")
+
+    def close(self, wait: bool = False) -> None:
+        """Stop the slot threads after their current job (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        _live_dispatch_loops.discard(self)
+        for _ in range(len(self._threads)):
+            self._q.put(None)
+        if wait:
+            for t in self._threads:
+                t.join(timeout=1.0)
+        self._threads = []
+
+
 class AsyncVerifyService:
     """Coalesces claim batches and (for device backends) dispatches them
     from a worker thread.
@@ -261,7 +402,16 @@ class AsyncVerifyService:
         # the current coalescing window (empty unless HOTSTUFF_PROFILE)
         self._arrivals: list[int] = []
         self._task: asyncio.Task | None = None
-        self._executor: ThreadPoolExecutor | None = None
+        self._dispatch: _DispatchLoop | None = None
+        # fixed-shape wave padding + round coalescing (ISSUE 6).
+        # Packing only applies when the backend advertises
+        # supports_wave_padding (real device verifiers): synthetic test
+        # hosts and CPU backends see exactly the claims submitted.
+        self.wave_buckets = wave_buckets_from_env()
+        self.coalesce_window_s = coalesce_window_s_from_env()
+        self._pad_claim: tuple | None = None
+        self.packed_waves = 0
+        self.pad_sigs = 0
         # adaptive routing state
         self._device_ewma_s: float | None = None
         self._last_probe = 0.0
@@ -390,7 +540,7 @@ class AsyncVerifyService:
             return cls(backend, device=True)
         # prune entries bound to closed loops (repeated benchmark runs /
         # test loops in one process): each would otherwise pin its loop
-        # object plus an idle single-thread executor forever
+        # object plus an idle dispatch loop's slot threads forever
         stale = [
             (k, svc)
             for k, (stored, svc) in cls._registry.items()
@@ -398,9 +548,7 @@ class AsyncVerifyService:
         ]
         for k, svc in stale:
             cls._registry.pop(k, None)
-            if svc._executor is not None:
-                svc._executor.shutdown(wait=False)
-                svc._executor = None
+            svc._shutdown_dispatch()
         key = (id(loop), kind)
         hit = cls._registry.get(key)
         # the stored loop is compared by identity and liveness: an id()
@@ -419,12 +567,18 @@ class AsyncVerifyService:
         for lander in list(self._landers):
             lander.cancel()
         self._landers.clear()
-        if self._executor is not None:
-            self._executor.shutdown(wait=False)
-            self._executor = None
+        self._shutdown_dispatch()
         for key, (_, service) in list(self._registry.items()):
             if service is self:
                 del self._registry[key]
+
+    def _shutdown_dispatch(self) -> None:
+        """Stop this service's dispatch loop (service close / stale-loop
+        eviction in for_backend / interpreter exit via the loop's own
+        atexit hook)."""
+        if self._dispatch is not None:
+            self._dispatch.close()
+            self._dispatch = None
 
     # ---- submission --------------------------------------------------------
 
@@ -481,6 +635,69 @@ class AsyncVerifyService:
         if n_sigs >= NATIVE_BATCH_MIN and _native_available():
             return cpu_batch_estimate_s(n_sigs)
         return n_sigs * CPU_US_PER_SIG * 1e-6
+
+    # ---- fixed-shape wave packing (ISSUE 6) --------------------------------
+
+    @property
+    def _packing_on(self) -> bool:
+        """Padding applies only when buckets are configured AND the
+        backend opted in (``supports_wave_padding`` — the real ed25519
+        device verifiers).  Aggregate-preferring backends (BLS) and CPU
+        fallbacks see exactly the submitted claims."""
+        return bool(
+            self.wave_buckets
+            and getattr(self.backend, "supports_wave_padding", False)
+        )
+
+    def _pad_claim_tuple(self) -> tuple:
+        """The deterministic filler claim for fixed-shape padding: one
+        VALID self-contained ed25519 signature over a reserved digest.
+        Claim verdicts are per-claim (``all()`` over each claim's own
+        span of the flat arrays), so a valid pad can never flip a real
+        claim's verdict — and because it is valid, a packed wave that
+        falls back to the CPU batch equation still passes when every
+        real signature does."""
+        if self._pad_claim is None:
+            from .digest import Digest
+            from .keys import generate_keypair
+            from .signature import Signature
+
+            pk, sk = generate_keypair(b"\xa5" * 32, 0xFFFF)
+            digest = Digest.of(b"hotstuff_tpu wave pad claim v1")
+            sig = Signature.new(digest, sk)
+            self._pad_claim = (
+                "one", digest.to_bytes(), pk.to_bytes(), sig.to_bytes()
+            )
+        return self._pad_claim
+
+    def _pack_wave(self, claims: list, n_sigs: int) -> list:
+        """Pad a device-routed wave to the smallest bucket >= n_sigs
+        with copies of the pad claim.  Exact fits and waves past the
+        largest bucket pass through unpadded (the backend chunks
+        oversized batches on its own grid)."""
+        bucket = next((b for b in self.wave_buckets if b >= n_sigs), None)
+        if bucket is None or bucket == n_sigs:
+            return claims
+        pad = self._pad_claim_tuple()
+        self.packed_waves += 1
+        self.pad_sigs += bucket - n_sigs
+        return list(claims) + [pad] * (bucket - n_sigs)
+
+    def warm_buckets(self) -> None:
+        """Pre-compile every wave bucket shape (ISSUE 6 warmup): drive
+        one pad-only wave per bucket size through the forced-device
+        dispatch view, synchronously, so the first real wave of any
+        bucket hits a warm jitted callable instead of paying a
+        mid-consensus compile.  No-op for inline services, non-padding
+        backends, and hosts whose device isn't materialized yet."""
+        if not (self.device and self._packing_on):
+            return
+        if not getattr(self.backend, "device_ready", True):
+            return
+        target = getattr(self.backend, "async_backend", self.backend)
+        pad = self._pad_claim_tuple()
+        for bucket in self.wave_buckets:
+            eval_claims_sync(target, [pad] * bucket)
 
     def _route_device(self, n_sigs: int) -> str:
         """Route this batch: "device", "cpu", "probe", or "wait".
@@ -557,24 +774,25 @@ class AsyncVerifyService:
         measure_only: bool = False,
         deadline: float | None = None,
     ):
-        """Start a device dispatch on a worker thread and register it in
-        the in-flight table (occupancy + deadline stamp drive routing).
-        The done-callback frees the slot, wakes any dispatcher queued in
-        _wait_for_slot, and retrieves the exception of measurement-only
-        dispatches so they never warn about unretrieved exceptions.
-        Returns ``(executor_future, end_holder)``; the worker appends
-        its completion stamp to ``end_holder`` under the profiler so the
-        lander can charge the executor->loop wakeup gap to
-        verdict.fanout."""
-        if self._executor is None:
-            # one worker per pipeline slot: jax.block_until_ready
+        """Start a device dispatch on the dedicated dispatch loop and
+        register it in the in-flight table (occupancy + deadline stamp
+        drive routing).  The slot thread delivers completion back to the
+        event loop with ``call_soon_threadsafe``; delivery frees the
+        slot, wakes any dispatcher queued in _wait_for_slot, and marks
+        exceptions retrieved so abandoned waves (deadline-miss /
+        measurement-only) never warn.  Returns ``(completion_future,
+        end_holder)``; the slot thread appends its completion stamp to
+        ``end_holder`` under the profiler so the lander can charge the
+        slot-thread -> loop wakeup gap to verdict.fanout."""
+        if self._dispatch is None:
+            # one slot thread per pipeline stage: jax.block_until_ready
             # releases the GIL, so while wave N parks on the device,
-            # wave N+1 stages on the next thread — that overlap IS the
+            # wave N+1 stages on the next slot — that overlap IS the
             # pipeline.  The backends are thread-compatible (table
-            # rebuilds publish atomically under their own lock).
-            self._executor = ThreadPoolExecutor(
-                max_workers=self.pipeline_depth, thread_name_prefix="verify"
-            )
+            # rebuilds publish atomically under their own lock) and pool
+            # staging scratch per thread, so each slot reuses its own
+            # preallocated buffers wave after wave.
+            self._dispatch = _DispatchLoop(self.pipeline_depth)
         self._wave_serial += 1
         wave = self._wave_serial
         self._inflight[wave] = time.monotonic() + (
@@ -588,21 +806,41 @@ class AsyncVerifyService:
             # a duration — rendered as a counter on the Perfetto track)
             rec.add("pipeline.occupancy", t_spawn, len(self._inflight))
         end_holder: list[int] = []
-        fut = loop.run_in_executor(
-            self._executor, self._dispatch_sync, claims, t_spawn, end_holder
-        )
+        fut: asyncio.Future = loop.create_future()
 
-        def _done(f):
+        def _deliver(result, exc):
+            # on the event loop: free the slot, resolve the wave future
             self._inflight.pop(wave, None)
             if self._slot_free is not None:
                 self._slot_free.set()
-            if f.cancelled():
+            if fut.cancelled():
                 return
-            exc = f.exception()
-            if exc is not None and measure_only:
+            if exc is None:
+                fut.set_result(result)
+            elif measure_only:
                 log.warning("device measurement dispatch failed: %s", exc)
+                fut.set_result(None)
+            else:
+                fut.set_exception(exc)
+                # mark retrieved: the lander re-raises via result(), but
+                # a deadline-missed wave is abandoned — without this the
+                # GC would warn about the never-retrieved exception
+                fut.exception()
 
-        fut.add_done_callback(_done)
+        def _on_done(result, exc):
+            # on the slot thread: hop back to the service's event loop
+            try:
+                loop.call_soon_threadsafe(_deliver, result, exc)
+            except RuntimeError:
+                # the loop closed mid-flight (benchmark loop teardown /
+                # interpreter exit): free the slot directly so routing
+                # never sees a phantom in-flight wave
+                self._inflight.pop(wave, None)
+
+        self._dispatch.submit(
+            lambda: self._dispatch_sync(claims, t_spawn, end_holder),
+            _on_done,
+        )
         return fut, end_holder
 
     def _dispatch_sync(
@@ -611,15 +849,15 @@ class AsyncVerifyService:
         t_spawn: int | None = None,
         end_holder: list | None = None,
     ) -> list[bool]:
-        """Worker-thread body: evaluate on the forced-device dispatch
+        """Slot-thread body: evaluate on the forced-device dispatch
         view, timing the dispatch for the routing EWMA."""
         rec = _spans.recorder()
         if rec is not None:
             t_enter = time.perf_counter_ns()
             if t_spawn is not None:
-                # executor handoff -> worker entry (thread wakeup + any
-                # queueing behind a previous dispatch)
-                rec.add("queue.wait", t_spawn, t_enter - t_spawn)
+                # dispatch-loop handoff -> slot thread entry (thread
+                # wakeup + any queueing behind a previous dispatch)
+                rec.add("stage.slot_wait", t_spawn, t_enter - t_spawn)
         target = getattr(self.backend, "async_backend", self.backend)
         t0 = time.perf_counter()
         out = eval_claims_sync(target, claims)
@@ -664,6 +902,13 @@ class AsyncVerifyService:
             # core handoff, core -> submit)
             await asyncio.sleep(0)
             await asyncio.sleep(0)
+            if self.coalesce_window_s > 0.0 and self._pending:
+                # QC+TC coalescing (ISSUE 6): hold the wave open for a
+                # round window so both certificate kinds produced by
+                # the same round merge into ONE tunnel crossing — the
+                # verdict table fans each claim back to its own
+                # submitters on readback
+                await asyncio.sleep(self.coalesce_window_s)
             batch, self._pending = self._pending, []
             arrivals, self._arrivals = self._arrivals, []
             if not batch:
@@ -726,19 +971,26 @@ class AsyncVerifyService:
                     route = self._route_device(n_sigs)
                 if self._tel_route is not None:
                     self._tel_route[route].inc()
+                dispatch_claims = claims
+                if route in ("device", "probe") and self._packing_on:
+                    # fixed-shape wave (ISSUE 6): pad to the bucket so
+                    # the dispatch hits a warm jitted callable.  Probes
+                    # pack too — they measure the shape real waves use.
+                    with _spans.span("stage.pack"):
+                        dispatch_claims = self._pack_wave(claims, n_sigs)
                 if route == "probe":
                     # measurement-only device dispatch: results are
                     # discarded (EWMA updates when it lands); the batch
                     # itself is served from the CPU so a degraded tunnel
                     # never adds wave latency
                     self.probe_dispatches += 1
-                    self._spawn_device(loop, claims, measure_only=True)
+                    self._spawn_device(loop, dispatch_claims, measure_only=True)
                 if route == "device":
                     self.device_dispatches += 1
                     self.device_sigs += n_sigs
                     deadline = self._deadline_s()
                     exec_fut, end_holder = self._spawn_device(
-                        loop, claims, deadline=deadline
+                        loop, dispatch_claims, deadline=deadline
                     )
                     # async readback (ISSUE 5): the dispatcher does NOT
                     # await the device — a per-wave lander task lands
@@ -749,7 +1001,7 @@ class AsyncVerifyService:
                     # next wave.
                     lander = loop.create_task(
                         self._land_device(
-                            batch, claims, exec_fut, end_holder,
+                            batch, dispatch_claims, exec_fut, end_holder,
                             wave_t0, deadline,
                         ),
                         name="verify-lander",
@@ -897,7 +1149,10 @@ __all__ = [
     "eval_claims_sync",
     "flatten_claims",
     "pipeline_depth_from_env",
+    "wave_buckets_from_env",
+    "coalesce_window_s_from_env",
     "CPU_US_PER_SIG",
     "DEFAULT_PIPELINE_DEPTH",
+    "DEFAULT_WAVE_BUCKETS",
     "PIPELINE_MARGINAL_COST",
 ]

@@ -177,6 +177,11 @@ class DualSchemeVerifier:
     # per-item checks costs two pairings per SIGNATURE); the ed25519
     # side's verify_shared_msg is the same per-signature work either way.
     prefers_aggregate = True
+    # Never advertise wave padding here even when the ed25519 member
+    # does: the pad filler is an ed25519 claim, and a padded wave whose
+    # real claims are BLS would then mis-route on the filler's 32-byte
+    # key.  Fixed-shape buckets only make sense below the scheme split.
+    supports_wave_padding = False
 
     def __init__(self, backends: dict[str, "VerifierBackend"]):
         self.backends = backends

@@ -41,7 +41,14 @@ class VerifierBackend(Protocol):
       deadline (raised adaptively from the dispatch EWMA);
     - ``device_key_cache = False`` — committee key tables are staged
       device-resident once per rebuild and gathered by row id per wave
-      (tpu/ed25519.BatchVerifier).
+      (tpu/ed25519.BatchVerifier, parallel/mesh.ShardedBatchVerifier);
+    - ``supports_wave_padding = False`` — device-routed waves may be
+      pre-padded to fixed bucket shapes (``HOTSTUFF_WAVE_BUCKETS``)
+      with always-valid filler claims so every dispatch hits a warm
+      jitted callable; only backends whose per-claim verdicts are
+      independent of the other claims in the batch may opt in (the
+      ed25519 device verifiers do; aggregate-preferring backends and
+      synthetic test hosts must not).
     """
 
     def verify_one(self, digest: Digest, pk: PublicKey, sig: Signature) -> bool: ...

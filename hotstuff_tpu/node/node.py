@@ -42,6 +42,12 @@ class _DeviceDispatch:
     def __init__(self, device):
         self._device = device
         self.name = getattr(device, "name", "tpu")
+        # forward the fixed-shape padding capability (ISSUE 6): the
+        # async service pads device waves only when the real verifier
+        # behind this view opted in
+        self.supports_wave_padding = getattr(
+            device, "supports_wave_padding", False
+        )
 
     def verify_many(
         self, digests, pks, sigs, aggregate_ok: bool = False
@@ -63,6 +69,10 @@ class LazyDeviceVerifier:
     coalesces every core's claims into one dispatch stream."""
 
     min_device_batch = 64
+
+    # both lazy kinds ("tpu", "tpu-sharded") materialize ed25519
+    # BatchVerifiers, which accept fixed-shape wave padding (ISSUE 6)
+    supports_wave_padding = True
 
     _shared_device: dict[str, VerifierBackend] = {}
     _shared_dispatch: dict[str, _DeviceDispatch] = {}

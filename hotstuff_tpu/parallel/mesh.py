@@ -19,8 +19,28 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.5: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _SHARD_CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_CHECK_KW = "check_rep"
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable shard_map: forwards the replication/vma
+    consistency switch under whichever name this jax spells it."""
+    return _shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_SHARD_CHECK_KW: check_vma},
+    )
 
 from ..tpu import curve
 from ..tpu.ed25519 import BatchVerifier
@@ -154,13 +174,50 @@ class ShardedBatchVerifier(BatchVerifier):
             # equal per-device slices: multiples of the mesh size on the
             # same power-of-4 progression as the base class
             self.pad_sizes = tuple(m * p for p in (1, 4, 16, 64, 256, 1024))
+        # Per-shard device key table (ISSUE 6): the stacked committee
+        # tables replicate across the mesh once per rebuild, each wave
+        # ships only its [padded] row indices sharded over dp, and the
+        # gather runs device-side producing rows already laid out for
+        # the shard_map in_specs — the sharded backend stops restaging
+        # 4x[padded,20] coordinate rows every wave.
+        self._row_sharding = NamedSharding(self.mesh, P(DP_AXIS))
+        self._table_sharding = NamedSharding(self.mesh, P())
+        self._sharded_gather = jax.jit(
+            lambda tables, idxs: tuple(t[idxs] for t in tables),
+            out_shardings=(self._row_sharding,) * 4,
+        )
 
-    # the shard_map kernel owns array placement: committee rows must
-    # arrive as host arrays for the in_specs sharding, not pre-committed
-    # to a single device by the base class's staged gather
-    device_key_cache = False
+    # per-shard key table: the staged gather emits rows sharded to
+    # match the shard_map in_specs (see _gather_device_rows), so the
+    # PR 5 device key cache now applies to the mesh backend too
+    device_key_cache = True
 
-    def _run_kernel(self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
+    def _device_build(self, build):
+        """Replicate the stacked committee tables across the mesh once
+        per rebuild (committee keys are epoch-static)."""
+        if self._device_src is not build:
+            tables, _ = build
+            self._device_tables = tuple(
+                jax.device_put(t, self._table_sharding) for t in tables
+            )
+            self._device_src = build
+        return self._device_tables
+
+    def _gather_device_rows(self, build, idxs):
+        """Shard-aligned committee gather: [padded] indices sharded
+        over dp index the replicated tables, so each device produces
+        exactly its own slice of the coordinate rows."""
+        tables = self._device_build(build)
+        return self._sharded_gather(
+            tables, jax.device_put(idxs, self._row_sharding)
+        )
+
+    def _run_kernel(
+        self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign, donate=False
+    ):
+        # donate is accepted for interface parity and ignored: the
+        # shard_map kernel's staging arrays are already consumed
+        # per-wave and donation across shard_map is not wired up
         return self._kernel(
             jnp.asarray(ax),
             jnp.asarray(ay),

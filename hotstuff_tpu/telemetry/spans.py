@@ -11,7 +11,11 @@ Span taxonomy (leaf stages sum to the wave's end-to-end time)::
 
     coalesce.wait    first submit -> the dispatcher collects the batch
     route.decide     the device-vs-CPU routing decision
-    queue.wait       executor handoff -> worker thread entry
+    stage.pack       wave padding to the fixed bucket shape (ISSUE 6)
+    stage.slot_wait  dispatch-loop handoff -> slot thread entry
+    queue.wait       executor handoff -> worker thread entry (legacy
+                     executor paths; the dispatch loop emits
+                     stage.slot_wait instead)
     flatten          claims -> flat (digest, pk, sig) arrays
     prepare          host staging: decompress lookup, hashing, padding
     dispatch         kernel call (device enqueue; returns a future)
@@ -70,6 +74,8 @@ LEAF_STAGES: tuple[str, ...] = (
     "coalesce.wait",
     "route.decide",
     "pipeline.wait",
+    "stage.pack",
+    "stage.slot_wait",
     "queue.wait",
     "flatten",
     "prepare",

@@ -252,16 +252,14 @@ def _tree_reduce(p):
     return p
 
 
-@partial(jax.jit, static_argnames=())
-def _aggregate_kernel(xs, ys, zs):
+def _aggregate_impl(xs, ys, zs):
     """Tree-reduce a [B, NLIMBS] batch of projective points to one point.
     B must be a power of two (callers pad with the identity)."""
     return tuple(c[0] for c in _tree_reduce((xs, ys, zs)))
 
 
-@partial(jax.jit, static_argnames=())
-def _aggregate_plain_kernel(xs, ys, zs):
-    """Same contract as ``_aggregate_kernel`` but over PLAIN limb rows:
+def _aggregate_plain_impl(xs, ys, zs):
+    """Same contract as ``_aggregate_impl`` but over PLAIN limb rows:
     the Montgomery conversion (one mont_mul by R^2 per coordinate) rides
     inside the same dispatch, so the host stages raw byte-split limbs
     and never does per-point bignum arithmetic (ISSUE 5).  Identity pads
@@ -272,6 +270,35 @@ def _aggregate_plain_kernel(xs, ys, zs):
         c[0]
         for c in _tree_reduce(tuple(mont_mul(c, r2) for c in (xs, ys, zs)))
     )
+
+
+_aggregate_kernel = partial(jax.jit, static_argnames=())(_aggregate_impl)
+_aggregate_plain_kernel = partial(jax.jit, static_argnames=())(
+    _aggregate_plain_impl
+)
+# Donated variant (ISSUE 6, mirroring tpu/ed25519.py): the limb rows
+# are per-wave staging temporaries, so donating them lets XLA recycle
+# their device allocations across aggregation waves.
+_aggregate_plain_kernel_donated = jax.jit(
+    _aggregate_plain_impl, donate_argnums=(0, 1, 2)
+)
+
+_DONATE: bool | None = None
+
+
+def _donate_buffers() -> bool:
+    """Same gate as ed25519.BatchVerifier.donate_buffers: accelerator
+    backends by default, HOTSTUFF_DONATE=1/0 forces either way."""
+    global _DONATE
+    if _DONATE is None:
+        import os
+
+        env = os.environ.get("HOTSTUFF_DONATE", "").strip().lower()
+        if env:
+            _DONATE = env not in ("0", "off", "no", "false")
+        else:
+            _DONATE = jax.default_backend() in ("tpu", "gpu")
+    return _DONATE
 
 
 def make_sharded_g1_aggregate(mesh):
@@ -286,7 +313,7 @@ def make_sharded_g1_aggregate(mesh):
     power-of-two per-device slice; the driver pads with identities."""
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DP_AXIS as axis
+    from ..parallel.mesh import DP_AXIS as axis, shard_map
 
     def local(xs, ys, zs):
         part = _tree_reduce((xs, ys, zs))  # [1, NLIMBS] per device
@@ -297,7 +324,7 @@ def make_sharded_g1_aggregate(mesh):
         out = _tree_reduce(gathered)
         return out  # [1, NLIMBS] replicated
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
@@ -387,7 +414,11 @@ class TpuG1Aggregator:
                 ys[:m] = ints_to_limbs_batch([pt.y for pt in real])
                 zs[:m, 0] = 1
                 ys[m:, 0] = 1
-                kernel = _aggregate_plain_kernel
+                kernel = (
+                    _aggregate_plain_kernel_donated
+                    if _donate_buffers()
+                    else _aggregate_plain_kernel
+                )
             else:
                 # sharded path: the shard_map kernel's contract is
                 # Montgomery-form rows — keep the host conversion

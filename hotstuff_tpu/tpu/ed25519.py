@@ -45,9 +45,8 @@ MASK255 = (1 << 255) - 1
 PAD_SIZES = (1, 4, 16, 64, 256, 1024, 4096)
 
 
-@partial(jax.jit, static_argnames=())
-def _verify_kernel(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
-    """Device kernel: bool[batch] validity.
+def _verify_impl(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
+    """Device kernel body: bool[batch] validity.
 
     ax..at: [batch, 20] limbs of the NEGATED public-key points.
     s_win, k_win: [NWIN, batch] MSB-first 4-bit scalar windows.
@@ -58,9 +57,8 @@ def _verify_kernel(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
     return curve.compressed_equals(p, r_y, r_sign)
 
 
-@partial(jax.jit, static_argnames=())
-def _verify_kernel_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
-    """Same contract as _verify_kernel, with the WHOLE verification —
+def _verify_impl_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
+    """Same contract as _verify_impl, with the WHOLE verification —
     double-scalar multiplication AND the compressed-equality epilogue —
     fused into one VMEM-resident Pallas dispatch (tpu/pallas_dsm.py;
     the XLA epilogue was ~2 ms of sequential HBM round-trips).  TPU
@@ -71,6 +69,20 @@ def _verify_kernel_pallas(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
     return pallas_dsm.verify_compressed(
         s_bits, k_bits, (ax, ay, az, at), r_y, r_sign
     )
+
+
+_verify_kernel = partial(jax.jit, static_argnames=())(_verify_impl)
+_verify_kernel_pallas = partial(jax.jit, static_argnames=())(_verify_impl_pallas)
+
+# Donated variants (ISSUE 6): the scalar windows, R limbs and sign bits
+# are per-wave staging temporaries — donating them lets XLA reuse their
+# device allocations across waves instead of re-allocating per dispatch.
+# The point coordinates (args 0-3) stay un-donated: with the device key
+# cache they alias the epoch-static gather source.
+_verify_kernel_donated = jax.jit(_verify_impl, donate_argnums=(4, 5, 6, 7))
+_verify_kernel_pallas_donated = jax.jit(
+    _verify_impl_pallas, donate_argnums=(4, 5, 6, 7)
+)
 
 
 # Pallas pad shapes: lane-aligned, capped at 1024 per dispatch (larger
@@ -169,8 +181,19 @@ class BatchVerifier:
         # Per-thread staging scratch, keyed by padded size: the pipeline
         # runs prepare() on up to pipeline_depth worker threads at once,
         # so buffers are thread-local rather than shared (reuse across
-        # waves without a lock).
+        # waves without a lock).  The dispatch loop's slot threads are
+        # long-lived (ISSUE 6), so these pools ARE the preallocated
+        # staging-buffer ring: one persistent set per slot.
         self._scratch = threading.local()
+        # Challenge-hash memo: k = H(R||A||M) is a pure function of the
+        # claim bytes, and fixed-shape padding re-stages the SAME pad
+        # claim every wave — memoizing makes pad lanes (and re-verified
+        # claims) cost a dict hit instead of a SHA-512 each.  Bounded;
+        # cleared wholesale when full (GIL-atomic ops only, so the
+        # pipeline's slot threads share it without a lock).
+        self._challenge_memo: dict[tuple, bytes] = {}
+        # buffer donation decision (resolved lazily, see donate_buffers)
+        self._donate: bool | None = None
         # The Pallas VMEM-resident kernel is the fast path on real TPU
         # hardware; the XLA kernel is the portable fallback (CPU tests,
         # sharded-mesh subclass).  use_pallas=None defers autodetection
@@ -237,6 +260,15 @@ class BatchVerifier:
         ceiling = next((p for p in grid if n <= p), grid[-1])
         floor = max(self.min_device_batch, 1)  # smaller pads never reach
         # the device (the hybrid routing sends those batches to the CPU)
+        # ... EXCEPT through the async service's fixed-shape padding
+        # (ISSUE 6): a small wave the cost model routes to the device
+        # pads UP to the smallest bucket, so that shape must be warm too
+        if getattr(self, "supports_wave_padding", False):
+            from ..crypto.async_service import wave_buckets_from_env
+
+            buckets = wave_buckets_from_env()
+            if buckets:
+                floor = min(floor, buckets[0])
         sizes = [p for p in grid if floor <= p <= ceiling] or [n]
         for size in sizes:
             out = self.verify([msg] * size, [pk] * size, [sig] * size)
@@ -254,8 +286,25 @@ class BatchVerifier:
         return hit
 
     # staged device-side committee gather; the mesh-sharded subclass
-    # disables it (its shard_map kernel owns array placement)
+    # overrides the gather so rows land shard-aligned
     device_key_cache = True
+
+    @property
+    def donate_buffers(self) -> bool:
+        """Donate the per-wave staging arrays to the kernel (ISSUE 6)
+        so XLA recycles their device allocations across waves.  On by
+        default on accelerator backends; ``HOTSTUFF_DONATE=1/0``
+        forces either way (CPU jax has no donation support and warns
+        once per shape, so it stays off there unless forced)."""
+        if self._donate is None:
+            import os
+
+            env = os.environ.get("HOTSTUFF_DONATE", "").strip().lower()
+            if env:
+                self._donate = env not in ("0", "off", "no", "false")
+            else:
+                self._donate = jax.default_backend() in ("tpu", "gpu")
+        return self._donate
 
     def _device_build(self, build):
         """The device-resident copy of ``build``'s stacked tables,
@@ -364,12 +413,16 @@ class BatchVerifier:
                 ]
             )
 
+        # the internal dispatch donates its staging arrays when enabled
+        # (they are per-wave temporaries); external stage() users call
+        # the kernel with donate's default False and may reuse arrays
+        donate = self.donate_buffers
         rec = _spans.recorder()
         if rec is None:
             kernel, arrays, valid_host = self.stage(
                 messages, pubkeys, signatures
             )
-            ok = kernel(*arrays)
+            ok = kernel(*arrays, donate=donate)
             # same fence as the profiled path (ISSUE 5): overlap now
             # happens at the WAVE level — the dispatch pipeline parks
             # this worker thread here (GIL released) while the next
@@ -384,7 +437,7 @@ class BatchVerifier:
                 messages, pubkeys, signatures
             )
         with rec.span("dispatch"):
-            ok = kernel(*arrays)
+            ok = kernel(*arrays, donate=donate)
         with rec.span("device.execute"):
             ok = jax.block_until_ready(ok)
         with rec.span("readback"):
@@ -479,10 +532,22 @@ class BatchVerifier:
             else:
                 valid_host[i] = False  # key decompresses to no point
 
-        # challenge hashes: the irreducible per-item host work
+        # challenge hashes: the irreducible per-item host work —
+        # memoized, so fixed-shape pad lanes (same claim every wave)
+        # and re-verified claims skip the SHA-512
+        memo = self._challenge_memo
         for i in np.flatnonzero(valid_host):
-            k = ref.verify_challenge(signatures[i], pubkeys[i], messages[i])
-            k_rows[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+            key = (signatures[i], pubkeys[i], messages[i])
+            kb = memo.get(key)
+            if kb is None:
+                k = ref.verify_challenge(
+                    signatures[i], pubkeys[i], messages[i]
+                )
+                kb = k.to_bytes(32, "little")
+                if len(memo) >= 8192:
+                    memo.clear()
+                memo[key] = kb
+            k_rows[i] = np.frombuffer(kb, np.uint8)
         bad = ~valid_host
         if bad.any():
             sig_rows[:n][bad] = 0  # zero scalars -> identity lanes
@@ -501,7 +566,7 @@ class BatchVerifier:
         # committee table is usable (one [padded] index transfer instead
         # of 4x[padded,20] coordinate rows), host fancy-index otherwise
         if self.device_key_cache:
-            ax, ay, az, at = _gather_rows(self._device_build(build), idxs)
+            ax, ay, az, at = self._gather_device_rows(build, idxs)
         else:
             ax, ay, az, at = (t[idxs] for t in tables)
 
@@ -509,9 +574,28 @@ class BatchVerifier:
             ax, ay, az, at, s_bits.T, k_bits.T, r_y, r_sign.copy(),
         )
 
-    def _run_kernel(self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
-        """Device dispatch — overridden by the mesh-sharded verifier."""
-        kernel = _verify_kernel_pallas if self.use_pallas else _verify_kernel
+    def _gather_device_rows(self, build, idxs):
+        """Device-side committee-key gather from the staged tables —
+        the mesh-sharded verifier overrides this so the gathered rows
+        land shard-aligned instead of on one device."""
+        return _gather_rows(self._device_build(build), idxs)
+
+    def _run_kernel(
+        self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign, donate=False
+    ):
+        """Device dispatch — overridden by the mesh-sharded verifier.
+        ``donate=True`` selects the buffer-donating compilation of the
+        same kernel (callers must not reuse the staging arrays after);
+        the default keeps external stage() users (bench.py re-dispatches
+        the same staged arrays) on the non-consuming variant."""
+        if self.use_pallas:
+            kernel = (
+                _verify_kernel_pallas_donated
+                if donate
+                else _verify_kernel_pallas
+            )
+        else:
+            kernel = _verify_kernel_donated if donate else _verify_kernel
         return kernel(
             jnp.asarray(ax),
             jnp.asarray(ay),
@@ -526,6 +610,11 @@ class BatchVerifier:
     # -- VerifierBackend protocol (hotstuff_tpu.crypto.service) --------------
 
     name = "tpu"
+
+    #: the async verify service may pre-pad device waves to fixed
+    #: bucket shapes with always-valid filler claims (ISSUE 6) — real
+    #: device verifiers opt in; synthetic test hosts never set this
+    supports_wave_padding = True
 
     def verify_many(
         self,

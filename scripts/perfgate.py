@@ -10,12 +10,18 @@ non-zero when either guarded metric regresses past the threshold
     (the number the span waterfall decomposes; may not rise >15%)
   * ``value``                        — batch-1024 verify throughput in
     sigs/s (may not fall >15%)
-  * ``tunnel_dispatch_p50_ms``       — the dev-tunnel round trip; gated
-    at a wide per-guard threshold (weather swings ~6x run to run — only
-    blowups should fail the gate)
   * ``pipeline.train_sigs_per_s``    — sustained QC-256 wave-train
     throughput through the depth-2 dispatch pipeline (ISSUE 5; may not
     fall >15%)
+
+``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
+(ISSUE 6): the fresh value must stay within ``--ratchet-slack``
+(default 1.25x) of the BEST value anywhere in the committed BENCH
+series — not the latest.  The old latest-reference guard silently
+absorbed a slow drift (each round only had to beat the previous round's
+weather); the ratchet pins the series' best as the floor, with the
+slack absorbing tunnel weather.  ``--no-ratchet`` skips it (e.g. on a
+known-degraded rig).
 
 Guards missing from either side are skipped, so old references gate
 only the metrics they carry.
@@ -45,9 +51,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: (human name, extractor, direction[, threshold]) — direction +1 means
 #: "higher is a regression" (latency), -1 means "lower is a regression"
 #: (throughput).  An optional 4th element overrides the run's threshold
-#: for THAT guard: the tunnel round trip legitimately swings 0.7-4.5 ms
-#: between runs of the same build (weather), so its gate is wide and
-#: only catches order-of-magnitude blowups.
+#: for THAT guard.  The tunnel dispatch cost is NOT in this table: it is
+#: ratcheted against the best of the whole BENCH series (see below).
 GUARDS = (
     (
         "qc_verify_ms.256.rig_p50_ms",
@@ -58,17 +63,16 @@ GUARDS = (
     ),
     ("value (sigs/s)", lambda doc: doc.get("value"), -1),
     (
-        "tunnel_dispatch_p50_ms",
-        lambda doc: doc.get("tunnel_dispatch_p50_ms"),
-        +1,
-        8.0,
-    ),
-    (
         "pipeline.train_sigs_per_s",
         lambda doc: (doc.get("pipeline") or {}).get("train_sigs_per_s"),
         -1,
     ),
 )
+
+#: the ratcheted metric: lower is better, fresh must stay within
+#: RATCHET_SLACK of the series-wide best
+RATCHET_METRIC = "tunnel_dispatch_p50_ms"
+RATCHET_SLACK = 1.25
 
 
 def last_json_line(text: str) -> dict | None:
@@ -112,6 +116,51 @@ def load_reference(repo: str = REPO) -> tuple[dict, str] | None:
     if any(fn(doc) is not None for _, fn, *_ in GUARDS):
         return doc, base
     return None
+
+
+def load_best(repo: str = REPO) -> tuple[float, str] | None:
+    """The BEST (lowest) ``tunnel_dispatch_p50_ms`` anywhere in the
+    committed BENCH series — the ratchet floor.  Scans EVERY
+    ``BENCH_r*.json`` (not just the latest): the point of the ratchet is
+    that one good round permanently raises the bar.  Returns
+    (best-value, source-path) or None when no round carries the metric."""
+    best: tuple[float, str] | None = None
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        doc = rec.get("parsed") or last_json_line(rec.get("tail", ""))
+        if not isinstance(doc, dict):
+            continue
+        val = doc.get(RATCHET_METRIC)
+        if isinstance(val, (int, float)) and val > 0:
+            if best is None or val < best[0]:
+                best = (float(val), path)
+    return best
+
+
+def ratchet_check(
+    fresh: dict, best: tuple[float, str] | None, slack: float = RATCHET_SLACK
+) -> list[str]:
+    """Failure messages when the fresh ratcheted metric exceeds the
+    series best by more than ``slack``.  Missing on either side skips
+    (same philosophy as compare())."""
+    if best is None:
+        return []
+    f = fresh.get(RATCHET_METRIC)
+    if not isinstance(f, (int, float)):
+        return []
+    best_val, best_path = best
+    limit = best_val * slack
+    if f > limit:
+        return [
+            f"{RATCHET_METRIC} {f:g} ms exceeds the series-best ratchet "
+            f"{best_val:g} ms x {slack:g} = {limit:g} ms "
+            f"(best from {os.path.basename(best_path)})"
+        ]
+    return []
 
 
 def compare(fresh: dict, ref: dict, threshold: float = 0.15) -> list[str]:
@@ -160,6 +209,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--threshold", type=float, default=0.15,
                     help="allowed relative regression (default 0.15)")
+    ap.add_argument("--no-ratchet", action="store_true",
+                    help="skip the tunnel_dispatch_p50_ms series-best "
+                    "ratchet (e.g. on a known-degraded rig)")
+    ap.add_argument("--ratchet-slack", type=float, default=RATCHET_SLACK,
+                    help="allowed multiple of the series-best tunnel "
+                    f"dispatch cost (default {RATCHET_SLACK})")
     args = ap.parse_args(argv)
 
     ref = load_reference()
@@ -184,6 +239,15 @@ def main(argv=None) -> int:
         return 1
 
     failures = compare(fresh, ref_doc, args.threshold)
+    ratcheted = ""
+    if not args.no_ratchet:
+        best = load_best()
+        failures += ratchet_check(fresh, best, args.ratchet_slack)
+        if best is not None and fresh.get(RATCHET_METRIC) is not None:
+            ratcheted = (
+                f"; {RATCHET_METRIC} within {args.ratchet_slack:g}x of "
+                f"series best {best[0]:g} ms"
+            )
     rel = os.path.relpath(ref_path, REPO)
     if failures:
         print(f"perfgate: FAIL vs {rel}")
@@ -193,7 +257,7 @@ def main(argv=None) -> int:
     checked = [n for n, fn, *_ in GUARDS
                if fn(fresh) is not None and fn(ref_doc) is not None]
     print(f"perfgate: OK vs {rel} ({', '.join(checked) or 'nothing'} "
-          f"within {args.threshold:.0%})")
+          f"within {args.threshold:.0%}{ratcheted})")
     return 0
 
 

@@ -11,9 +11,20 @@
 #
 #   PERFGATE=1 scripts/trace.sh   # also run the perf regression gate
 #                                 # (scripts/perfgate.py) afterwards
+#   TUNNEL=1 scripts/trace.sh     # ONLY the dispatch-tunnel anatomy
+#                                 # check (scripts/tunnel_check.py):
+#                                 # waterfall at QC 16/64/256, e2e
+#                                 # delta vs the committed reference,
+#                                 # non-zero exit if leaf-span coverage
+#                                 # drops below 95%
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
+
+if [ "${TUNNEL:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/tunnel_check.py "$@"
+fi
 
 timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m benchmark local \

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Tunnel-anatomy check (ISSUE 6): is the dispatch tunnel crushed, and
+does the waterfall still account for where the time goes?
+
+Drives ``benchmark profile`` waves at QC sizes 16/64/256 through the
+production dispatch path (fixed-shape buckets, dispatch-loop slots,
+donation), prints the per-stage p50 waterfall for each size, and
+compares each size's e2e p50 against the committed reference round
+(``--ref``, default BENCH_r05.json — the last round before the
+fixed-shape dispatch loop landed, whose per-size ``rig_p50_ms`` were
+fully serialized dispatches).
+
+Exit status is non-zero when any size's leaf-span coverage drops below
+``--min-coverage`` (default 95%): a stage missing its instrumentation
+means the waterfall can no longer explain the wave, which is exactly
+the failure mode that let the 91 ms rig gap hide pre-ISSUE-4.
+
+Usage:
+    python scripts/tunnel_check.py              # profile + compare
+    TUNNEL=1 scripts/trace.sh                   # same, via the trace
+                                                # wrapper's env switch
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SIZES = (16, 64, 256)
+
+
+def load_ref(path: str) -> dict:
+    """Per-size reference e2e ms from a BENCH round record: the
+    serialized ``rig_p50_ms`` for old rounds, or ``blocking_p50_ms``
+    once a round carries the ISSUE 6 split."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    doc = rec.get("parsed") or {}
+    out = {}
+    for size, entry in (doc.get("qc_verify_ms") or {}).items():
+        val = entry.get("blocking_p50_ms", entry.get("rig_p50_ms"))
+        if isinstance(val, (int, float)):
+            out[int(size)] = float(val)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ref", default=os.path.join(REPO, "BENCH_r05.json"),
+                    help="reference BENCH round (default BENCH_r05.json)")
+    ap.add_argument("--waves", type=int, default=None,
+                    help="waves per size (default: profile's own)")
+    ap.add_argument("--min-coverage", type=float, default=95.0,
+                    help="minimum leaf-span coverage %% (default 95)")
+    args = ap.parse_args(argv)
+
+    from benchmark.profile import format_waterfall, run_profile
+
+    kwargs = {"sizes": SIZES, "verifier": "tpu", "route": "device"}
+    if args.waves:
+        kwargs["waves"] = args.waves
+    result = run_profile(**kwargs)
+    print(format_waterfall(result))
+
+    ref = load_ref(args.ref)
+    ref_name = os.path.basename(args.ref)
+    failures = []
+    print(f" TUNNEL CHECK — fresh e2e p50 vs {ref_name} (serialized)")
+    for n in SIZES:
+        res = result["sizes"].get(n)
+        if res is None:
+            failures.append(f"size {n}: no profile result")
+            continue
+        fresh = res["e2e_ms"]["p50"]
+        cov = res["coverage_pct"]
+        line = f"   QC {n:>4}: e2e p50 {fresh:8.3f} ms, coverage {cov:5.1f}%"
+        base = ref.get(n)
+        if base:
+            line += (
+                f"  (ref {base:.3f} ms, {base / fresh:.2f}x)"
+                if fresh > 0
+                else f"  (ref {base:.3f} ms)"
+            )
+        print(line)
+        if cov < args.min_coverage:
+            failures.append(
+                f"size {n}: coverage {cov:.1f}% < {args.min_coverage:.0f}% "
+                "— a pipeline stage is missing its instrumentation"
+            )
+    if failures:
+        print("tunnel_check: FAIL")
+        for msg in failures:
+            print(f"  - {msg}")
+        return 1
+    print("tunnel_check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

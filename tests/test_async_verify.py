@@ -654,6 +654,181 @@ def test_pipeline_depth_from_env(monkeypatch):
     assert pipeline_depth_from_env() == 1  # floor: depth 0 is depth 1
 
 
+def test_wave_buckets_from_env(monkeypatch):
+    from hotstuff_tpu.crypto.async_service import (
+        DEFAULT_WAVE_BUCKETS,
+        wave_buckets_from_env,
+    )
+
+    monkeypatch.delenv("HOTSTUFF_WAVE_BUCKETS", raising=False)
+    assert wave_buckets_from_env() == DEFAULT_WAVE_BUCKETS
+    monkeypatch.setenv("HOTSTUFF_WAVE_BUCKETS", "64,16,256")
+    assert wave_buckets_from_env() == (16, 64, 256)  # sorted, deduped
+    monkeypatch.setenv("HOTSTUFF_WAVE_BUCKETS", "off")
+    assert wave_buckets_from_env() == ()
+    monkeypatch.setenv("HOTSTUFF_WAVE_BUCKETS", "0")
+    assert wave_buckets_from_env() == ()
+    monkeypatch.setenv("HOTSTUFF_WAVE_BUCKETS", "bogus")
+    assert wave_buckets_from_env() == DEFAULT_WAVE_BUCKETS
+
+
+def test_pad_claim_is_a_valid_signature():
+    """The fixed-shape filler claim must be VALID: an invalid pad would
+    poison the CPU batch equation fallback for an otherwise all-valid
+    packed wave (eval_claims_sync's flat fast path is all-or-nothing)."""
+    service = AsyncVerifyService(CpuVerifier())
+    pad = service._pad_claim_tuple()
+    assert pad[0] == "one"
+    assert eval_claims_sync(CpuVerifier(), [pad]) == [True]
+
+
+@async_test
+async def test_fixed_shape_padding_hits_bucket_and_preserves_verdicts(
+    monkeypatch,
+):
+    """A device-routed wave on a padding-capable backend is padded to
+    the smallest bucket (ISSUE 6) — and the pads can never flip a real
+    claim's verdict, including an INVALID real claim's."""
+    monkeypatch.delenv("HOTSTUFF_WAVE_BUCKETS", raising=False)
+    monkeypatch.setenv("HOTSTUFF_FORCE_DEVICE_ROUTE", "1")
+    host = _FakeDeviceHost(kind="pack-test")
+    host.supports_wave_padding = True
+    service = AsyncVerifyService(host, device=True)
+    claims = []
+    for i in range(4):
+        m = bytes([120 + i]) * 32
+        pk, s = _signed(100 + i, m)
+        claims.append(("one", m, pk.to_bytes(), s.to_bytes()))
+    # claims[0]'s signature over a different digest is INVALID
+    bad = ("one", b"k" * 32, claims[0][2], claims[0][3])
+    out = await service.verify_claims(claims + [bad])
+    assert out == [True] * 4 + [False]
+    # 5 real sigs padded to the 16-bucket: the device saw EXACTLY 16
+    assert host.dispatched_batches == [16]
+    assert service.packed_waves == 1
+    assert service.pad_sigs == 11
+    # an exact-fit wave passes through unpadded
+    fit = []
+    for i in range(16):
+        m = bytes([10, i]) + b"\x00" * 30
+        pk, s = _signed(130, m)
+        fit.append(("one", m, pk.to_bytes(), s.to_bytes()))
+    out = await service.verify_claims(fit)
+    assert len(out) == 16
+    assert host.dispatched_batches[-1] == 16
+    assert service.packed_waves == 1  # no pads added for the exact fit
+    service.close()
+
+
+@async_test
+async def test_padding_needs_backend_opt_in(monkeypatch):
+    """Backends that do NOT advertise supports_wave_padding see exactly
+    the submitted claims (synthetic hosts, CPU fallback, aggregate
+    backends) — no silent filler rides their dispatches."""
+    monkeypatch.delenv("HOTSTUFF_WAVE_BUCKETS", raising=False)
+    monkeypatch.setenv("HOTSTUFF_FORCE_DEVICE_ROUTE", "1")
+    msg = b"l" * 32
+    pk, sig = _signed(105, msg)
+    host = _FakeDeviceHost(kind="no-pack-test")  # no opt-in attribute
+    service = AsyncVerifyService(host, device=True)
+    out = await service.verify_claims(
+        [("one", msg, pk.to_bytes(), sig.to_bytes())]
+    )
+    assert out == [True]
+    assert host.dispatched_batches == [1]
+    assert service.packed_waves == 0 and service.pad_sigs == 0
+    service.close()
+
+
+def test_warm_buckets_drives_every_bucket_shape(monkeypatch):
+    """warm_buckets() pre-compiles each configured bucket size through
+    the forced-device dispatch view, so the first real wave of any
+    bucket never pays a cold compile mid-consensus."""
+    monkeypatch.setenv("HOTSTUFF_WAVE_BUCKETS", "4,8")
+    host = _FakeDeviceHost(kind="warm-test")
+    host.supports_wave_padding = True
+    service = AsyncVerifyService(host, device=True)
+    service.warm_buckets()
+    assert host.dispatched_batches == [4, 8]
+    # non-padding backends and inline services are no-ops
+    plain = AsyncVerifyService(CpuVerifier())
+    plain.warm_buckets()
+    service.close()
+    plain.close()
+
+
+@async_test
+async def test_round_window_coalesces_qc_and_tc_into_one_wave(monkeypatch):
+    """HOTSTUFF_COALESCE_WINDOW_MS holds the wave open so the QC and TC
+    claims of one round merge into ONE tunnel crossing, with the claim
+    table fanning each submitter its own verdicts on readback."""
+    monkeypatch.setenv("HOTSTUFF_COALESCE_WINDOW_MS", "80")
+    monkeypatch.setenv("HOTSTUFF_FORCE_DEVICE_ROUTE", "1")
+    msg = b"i" * 32
+    qc_pairs = [_signed(91 + i, msg) for i in range(4)]
+    qc_claim = (
+        "shared",
+        msg,
+        tuple((pk.to_bytes(), s.to_bytes()) for pk, s in qc_pairs),
+    )
+    tc_claims = []
+    for i in range(3):
+        m = bytes([110 + i]) * 32
+        pk, s = _signed(95 + i, m)
+        tc_claims.append(("one", m, pk.to_bytes(), s.to_bytes()))
+    # one INVALID TC entry proves the merged wave's per-claim fanout
+    bad = ("one", b"j" * 32, tc_claims[0][2], tc_claims[0][3])
+    host = _FakeDeviceHost(kind="window-test")
+    service = AsyncVerifyService(host, device=True)
+    assert abs(service.coalesce_window_s - 0.08) < 1e-9
+    qc_fut = asyncio.ensure_future(service.verify_claims([qc_claim]))
+    await asyncio.sleep(0.02)  # well inside the window
+    tc_fut = asyncio.ensure_future(
+        service.verify_claims(tc_claims + [bad])
+    )
+    assert (await qc_fut) == [True]
+    assert (await tc_fut) == [True, True, True, False]
+    # 4 QC sigs + 4 TC sigs crossed the tunnel ONCE
+    assert host.dispatched_batches == [8]
+    assert service.device_dispatches == 1
+    service.close()
+
+
+@async_test
+async def test_dispatch_loop_shuts_down_on_close():
+    """Service close stops the dedicated dispatch loop's slot threads
+    (and deregisters it from the atexit shutdown set) — no leaked
+    thread outlives its service."""
+    import hotstuff_tpu.crypto.async_service as asv
+
+    msg = b"h" * 32
+    pk, sig = _signed(90, msg)
+    host = _FakeDeviceHost(kind="lifecycle-test")
+    service = AsyncVerifyService.for_backend(host)
+    out = await service.verify_claims(
+        [("one", msg, pk.to_bytes(), sig.to_bytes())]
+    )
+    assert out == [True]
+    dl = service._dispatch
+    assert dl is not None and dl in asv._live_dispatch_loops
+    threads = list(dl._threads)
+    assert threads and all(t.is_alive() for t in threads)
+    assert all(t.name.startswith("verify-slot-") for t in threads)
+    assert len(threads) == service.pipeline_depth
+    service.close()
+    assert service._dispatch is None
+    assert dl not in asv._live_dispatch_loops
+    for t in threads:
+        t.join(timeout=2.0)
+    assert not any(t.is_alive() for t in threads)
+    # a closed loop refuses new work instead of silently dropping it
+    try:
+        dl.submit(lambda: None, lambda r, e: None)
+        raise AssertionError("closed dispatch loop accepted a submit")
+    except RuntimeError:
+        pass
+
+
 def test_no_claim_dedup_gives_private_services(monkeypatch):
     """HOTSTUFF_NO_CLAIM_DEDUP=1 (the --no-claim-dedup harness knob)
     must give every core a private device service: no cross-core

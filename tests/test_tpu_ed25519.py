@@ -233,6 +233,51 @@ def test_pallas_fused_epilogue_parity_interpret():
     assert ok.tolist() == expect.tolist()
 
 
+def test_donate_buffers_env_gate(monkeypatch):
+    """HOTSTUFF_DONATE forces buffer donation on/off; unset defers to
+    the backend platform (accelerators donate, CPU jax would warn)."""
+    monkeypatch.setenv("HOTSTUFF_DONATE", "0")
+    assert not BatchVerifier(min_device_batch=0).donate_buffers
+    monkeypatch.setenv("HOTSTUFF_DONATE", "1")
+    assert BatchVerifier(min_device_batch=0).donate_buffers
+    monkeypatch.delenv("HOTSTUFF_DONATE")
+    v = BatchVerifier(min_device_batch=0)
+    assert v.donate_buffers == (jax.default_backend() in ("tpu", "gpu"))
+
+
+def test_donated_dispatch_verdict_parity(monkeypatch):
+    """With donation forced on, staging buffers are consumed per wave —
+    and because verify() restages every wave, back-to-back waves of
+    different shapes (and a repeat of the first) keep exact verdict
+    parity.  The committee gather source (args 0-3) is NOT donated, so
+    the epoch-static key tables survive every wave."""
+    monkeypatch.setenv("HOTSTUFF_DONATE", "1")
+    v = BatchVerifier(min_device_batch=0)
+    assert v.donate_buffers
+    items = _sign_many(6, lambda i: b"donate-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+    sigs[2] = bytes([sigs[2][0] ^ 1]) + sigs[2][1:]
+    expected = [True, True, False, True, True, True]
+    assert v.verify(msgs, pks, sigs).tolist() == expected
+    # a different wave shape in between...
+    items2 = _sign_many(3, lambda i: b"other-%d" % i)
+    assert v.verify(*map(list, zip(*items2))).tolist() == [True] * 3
+    # ...then the first wave again: donation corrupted nothing cached
+    assert v.verify(msgs, pks, sigs).tolist() == expected
+
+
+def test_challenge_hash_memo():
+    """The per-(sig, pk, msg) challenge-hash memo serves repeated rows
+    (pad claims, re-verified certificates) without re-hashing — and
+    never changes a verdict."""
+    v = BatchVerifier(min_device_batch=0)
+    items = _sign_many(4, lambda i: b"memo-%d" % i)
+    msgs, pks, sigs = map(list, zip(*items))
+    assert v.verify(msgs, pks, sigs).all()
+    assert len(v._challenge_memo) == 4
+    assert v.verify(msgs, pks, sigs).all()  # served from the memo
+
+
 def test_stage_routing_thresholds():
     """stage() contract after the split-kernel deletion: every batch
     goes through prepare() to _run_kernel (overridden by the
