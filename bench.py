@@ -526,6 +526,24 @@ def main() -> int:
 
     tc_latency = bench_tc(BatchVerifier(min_device_batch=0))
     sharded = bench_sharded(msgs, pks, sigs)
+    if platform == "cpu" and sharded.get("mesh_devices", 0) <= 1:
+        # CPU hosts see ONE XLA device unless the count is forced before
+        # jax loads — re-measure the sharded route in a child on the
+        # virtual 8-device mesh so this block stops reporting
+        # mesh_devices: 1 (ISSUE 7 satellite); keep the in-process
+        # number if the child fails
+        from benchmark.meshtrain import run_sharded_virtual
+
+        virtual = run_sharded_virtual()
+        if virtual is not None:
+            sharded = virtual
+
+    # multi-chip wave-train scaling (ISSUE 7): per-mesh-size sustained
+    # train sigs/s through the production dispatch pipeline, batches up
+    # to 4096, on the virtual CPU mesh when no real multi-chip is present
+    from benchmark.meshtrain import run_mesh_train
+
+    mesh_train = run_mesh_train(force_virtual=(platform == "cpu"))
 
     # production-path amortized per-wave latency merged into the per-size
     # QC entries next to the serialized blocking_* and device_ms views
@@ -545,6 +563,7 @@ def main() -> int:
                 "qc_verify_ms": qc_latency,
                 "tc_verify_ms": tc_latency,
                 "sharded_route": sharded,
+                "mesh_train": mesh_train,
                 "verify_split": bench_verify_split(msgs, pks, sigs),
                 "pipeline": bench_pipeline(),
             }

@@ -351,7 +351,7 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=20.0)
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout-delay", type=int, default=5_000)
-    p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
+    p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded", "mesh"], default="cpu")
     p.add_argument(
         "--payload-homes",
         type=int,
@@ -447,7 +447,7 @@ def main(argv=None) -> int:
         help="consensus timeout (ms) — chaos runs default lower than "
         "`local` so view changes during outages resolve quickly",
     )
-    p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu")
+    p.add_argument("--verifier", choices=["cpu", "tpu", "tpu-sharded", "mesh"], default="cpu")
     p.add_argument("--transport", choices=["asyncio", "native"], default="asyncio")
     p.add_argument(
         "--journal",
@@ -479,7 +479,7 @@ def main(argv=None) -> int:
     p.add_argument("--waves", type=int, default=20)
     p.add_argument(
         "--verifier",
-        choices=["cpu", "tpu", "tpu-sharded", "bls"],
+        choices=["cpu", "tpu", "tpu-sharded", "mesh", "bls"],
         default="tpu",
         help="bls = the BLS claims path (device G1 aggregation + host "
         "pairing equality per QC)",
@@ -516,7 +516,7 @@ def main(argv=None) -> int:
     p.add_argument("--rate", type=int, default=1_000)
     p.add_argument("--duration", type=float, default=20.0)
     p.add_argument(
-        "--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu"
+        "--verifier", choices=["cpu", "tpu", "tpu-sharded", "mesh"], default="cpu"
     )
     p.set_defaults(fn=task_scaling)
 
@@ -568,7 +568,7 @@ def main(argv=None) -> int:
     p.add_argument("--faults", type=int, default=0)
     p.add_argument(
         "--verifier",
-        choices=["cpu", "tpu", "tpu-sharded"],
+        choices=["cpu", "tpu", "tpu-sharded", "mesh"],
         default="tpu",
     )
     p.add_argument(

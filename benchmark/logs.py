@@ -56,6 +56,7 @@ RE_VERIFY_STATS = re.compile(
     r"(?:cpu=(\d+) probe=(\d+) )?"
     r"device_sigs=(\d+) cpu_sigs=(\d+) deadline_misses=(\d+) "
     r"(?:waits=(\d+) depth=(\d+) )?"
+    r"(?:mesh=(\d+) )?"
     r"ewma_ms=([\d.]+)"
 )
 # periodic per-node telemetry snapshot (telemetry/exporter.py) — a
@@ -118,12 +119,12 @@ class LogParser:
         for log_idx, content in enumerate(node_logs):
             for (
                 tag, disp, dev, cpu, probe, dsig, csig, miss, waits,
-                depth, ewma,
+                depth, mesh, ewma,
             ) in RE_VERIFY_STATS.findall(content):
                 per_tag[(log_idx, tag)] = (
                     int(disp), int(dsig), int(csig), int(miss),
                     float(ewma), int(dev), int(cpu or 0), int(probe or 0),
-                    int(waits or 0), int(depth or 1),
+                    int(waits or 0), int(depth or 1), int(mesh or 0),
                 )
         self.device_sigs = sum(v[1] for v in per_tag.values())
         self.cpu_route_sigs = sum(v[2] for v in per_tag.values())
@@ -132,9 +133,14 @@ class LogParser:
             max(v[4] for v in per_tag.values()) if per_tag else None
         )
         # dispatch-wave routing split (ISSUE 5): waves by final route,
-        # plus depth-cap queue events and the configured pipeline depth
+        # plus depth-cap queue events and the configured pipeline depth.
+        # mesh= (ISSUE 7) is a SUBSET of device= (sharded-mesh backend
+        # dispatches), so "device" here reports single-device waves only
+        # and device+mesh reproduces the raw device= counter.
+        _mesh = sum(v[10] for v in per_tag.values())
         self.route_waves = {
-            "device": sum(v[5] for v in per_tag.values()),
+            "device": sum(v[5] for v in per_tag.values()) - _mesh,
+            "mesh": _mesh,
             "cpu": sum(v[6] for v in per_tag.values()),
             "probe": sum(v[7] for v in per_tag.values()),
         }

@@ -141,7 +141,7 @@ def format_report(
         f"{rate}/s input, {duration:.0f}s, verifier={verifier})",
         "",
         f"{'nodes':>6} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
-        f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p':>11} "
+        f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p/m':>13} "
         f"{'pred 1-core/node':>17}",
     ]
     for r in rows:
@@ -158,14 +158,14 @@ def format_report(
         if total_waves:
             route = "/".join(
                 f"{100 * waves.get(k, 0) // total_waves}"
-                for k in ("device", "cpu", "probe")
+                for k in ("device", "cpu", "probe", "mesh")
             )
         else:
             route = "-"
         lines.append(
             f"{r['nodes']:>6} {r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
-            f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>11} "
+            f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>13} "
             f"{predicted:>17.0f}"
         )
     lines += [

@@ -167,6 +167,23 @@ def wave_buckets_from_env() -> tuple[int, ...]:
     return tuple(s for s in sizes if s > 0)
 
 
+def resolve_wave_buckets(backend) -> tuple[int, ...]:
+    """The bucket ladder for ``backend`` (ISSUE 7): an explicit
+    ``HOTSTUFF_WAVE_BUCKETS`` always wins; otherwise a backend that
+    advertises ``wave_bucket_shapes`` (the mesh verifier's mesh-multiple
+    grid entries, so every padded wave IS a pre-compiled kernel shape
+    with equal per-device slices) gets its own shapes; everything else
+    gets the canonical default ladder."""
+    import os
+
+    if "HOTSTUFF_WAVE_BUCKETS" in os.environ:
+        return wave_buckets_from_env()
+    shapes = getattr(backend, "wave_bucket_shapes", None)
+    if shapes:
+        return tuple(sorted({int(b) for b in shapes if int(b) > 0}))
+    return DEFAULT_WAVE_BUCKETS
+
+
 def coalesce_window_s_from_env() -> float:
     """QC+TC coalescing window from HOTSTUFF_COALESCE_WINDOW_MS, in
     SECONDS.  Default 0: coalescing stays yield-based (two event-loop
@@ -407,7 +424,9 @@ class AsyncVerifyService:
         # Packing only applies when the backend advertises
         # supports_wave_padding (real device verifiers): synthetic test
         # hosts and CPU backends see exactly the claims submitted.
-        self.wave_buckets = wave_buckets_from_env()
+        # Bucket shapes resolve dynamically (see the wave_buckets
+        # property): the mesh backend's shapes only exist once the
+        # device host materializes it at warmup.
         self.coalesce_window_s = coalesce_window_s_from_env()
         self._pad_claim: tuple | None = None
         self.packed_waves = 0
@@ -432,6 +451,15 @@ class AsyncVerifyService:
         self.device_dispatches = 0
         self.cpu_dispatches = 0
         self.probe_dispatches = 0
+        # mesh route label (ISSUE 7): device waves dispatched into a
+        # mesh-sharded backend count separately so committee runs can
+        # tell sharded dispatches from single-device ones in the scaling
+        # SUMMARY's route column (device_dispatches stays the total)
+        self.mesh_dispatches = 0
+        self._device_route_label = (
+            "mesh" if ("sharded" in str(kind) or "mesh" in str(kind))
+            else "device"
+        )
         self.device_sigs = 0
         self.cpu_sigs = 0
         self.deadline_misses = 0
@@ -488,7 +516,7 @@ class AsyncVerifyService:
                     "Dispatch waves by routing decision",
                     {**labels, "route": r},
                 )
-                for r in ("device", "cpu", "probe", "wait")
+                for r in ("device", "mesh", "cpu", "probe", "wait")
             }
             reg.gauge(
                 "verify_pending_batches",
@@ -502,6 +530,17 @@ class AsyncVerifyService:
                 labels,
                 fn=lambda: len(self._inflight),
             )
+
+    @property
+    def wave_buckets(self) -> tuple[int, ...]:
+        """The fixed wave shapes for this service's backend, resolved
+        per access (ISSUE 7): a device host only advertises its
+        ``wave_bucket_shapes`` once the device backend materializes at
+        warmup, and the mesh backend's shapes depend on the mesh size —
+        resolving lazily means the service picks up the mesh-multiple
+        ladder the moment it exists instead of freezing the canonical
+        default at construction."""
+        return resolve_wave_buckets(self.backend)
 
     @property
     def _device_busy(self) -> bool:
@@ -689,7 +728,12 @@ class AsyncVerifyService:
         dispatch view, synchronously, so the first real wave of any
         bucket hits a warm jitted callable instead of paying a
         mid-consensus compile.  No-op for inline services, non-padding
-        backends, and hosts whose device isn't materialized yet."""
+        backends, and hosts whose device isn't materialized yet.
+
+        With a mesh-sharded backend the resolved buckets ARE that
+        mesh's pad-grid entries (mesh-multiple shapes up to the 4096
+        train bucket), so this loop pre-compiles every (bucket x mesh)
+        kernel shape the tunnel can dispatch (ISSUE 7)."""
         if not (self.device and self._packing_on):
             return
         if not getattr(self.backend, "device_ready", True):
@@ -970,7 +1014,13 @@ class AsyncVerifyService:
                         )
                     route = self._route_device(n_sigs)
                 if self._tel_route is not None:
-                    self._tel_route[route].inc()
+                    # sharded backends label their device waves "mesh"
+                    # so dashboards separate multi-chip dispatches
+                    self._tel_route[
+                        self._device_route_label
+                        if route == "device"
+                        else route
+                    ].inc()
                 dispatch_claims = claims
                 if route in ("device", "probe") and self._packing_on:
                     # fixed-shape wave (ISSUE 6): pad to the bucket so
@@ -987,6 +1037,8 @@ class AsyncVerifyService:
                     self._spawn_device(loop, dispatch_claims, measure_only=True)
                 if route == "device":
                     self.device_dispatches += 1
+                    if self._device_route_label == "mesh":
+                        self.mesh_dispatches += 1
                     self.device_sigs += n_sigs
                     deadline = self._deadline_s()
                     exec_fut, end_holder = self._spawn_device(
@@ -1129,7 +1181,8 @@ class AsyncVerifyService:
             log.info(
                 "Verify service stats [%s]: dispatches=%d device=%d "
                 "cpu=%d probe=%d device_sigs=%d cpu_sigs=%d "
-                "deadline_misses=%d waits=%d depth=%d ewma_ms=%.1f",
+                "deadline_misses=%d waits=%d depth=%d mesh=%d "
+                "ewma_ms=%.1f",
                 self._stats_tag,
                 self.dispatches,
                 self.device_dispatches,
@@ -1140,6 +1193,7 @@ class AsyncVerifyService:
                 self.deadline_misses,
                 self.pipeline_waits,
                 self.pipeline_depth,
+                self.mesh_dispatches,
                 (self._device_ewma_s or 0.0) * 1e3,
             )
 
@@ -1150,6 +1204,7 @@ __all__ = [
     "flatten_claims",
     "pipeline_depth_from_env",
     "wave_buckets_from_env",
+    "resolve_wave_buckets",
     "coalesce_window_s_from_env",
     "CPU_US_PER_SIG",
     "DEFAULT_PIPELINE_DEPTH",

@@ -48,7 +48,14 @@ class VerifierBackend(Protocol):
       jitted callable; only backends whose per-claim verdicts are
       independent of the other claims in the batch may opt in (the
       ed25519 device verifiers do; aggregate-preferring backends and
-      synthetic test hosts must not).
+      synthetic test hosts must not);
+    - ``wave_bucket_shapes`` (unset) — the backend's own preferred
+      bucket ladder for fixed-shape padding, overriding the canonical
+      default (but not an explicit ``HOTSTUFF_WAVE_BUCKETS``): the
+      mesh-sharded verifier advertises its pad-grid entries here so
+      every padded wave is a mesh-multiple pre-compiled kernel shape
+      (ISSUE 7); device HOSTS forward it as None until the device
+      materializes.
     """
 
     def verify_one(self, digest: Digest, pk: PublicKey, sig: Signature) -> bool: ...

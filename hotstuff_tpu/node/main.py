@@ -183,6 +183,18 @@ def _apply_verify_pipeline(args) -> None:
         os.environ["HOTSTUFF_VERIFY_PIPELINE"] = str(max(1, depth))
 
 
+def _apply_mesh_devices(args) -> None:
+    """Bridge ``--mesh-devices N`` into HOTSTUFF_MESH_DEVICES (the
+    env-first pattern) so the sharded verifier sizes its device mesh at
+    materialization — in this process and in any child node process the
+    deploy path spawns."""
+    n = getattr(args, "mesh_devices", None)
+    if n is not None:
+        import os
+
+        os.environ["HOTSTUFF_MESH_DEVICES"] = str(max(1, n))
+
+
 def _apply_fault_plane(args) -> None:
     """Activate the chaos plane when ``--fault-plane`` was given: the
     flag value (a spec file path or inline JSON) lands in
@@ -204,6 +216,7 @@ async def _run_node(args) -> None:
     _apply_fault_plane(args)
     _apply_profile(args)
     _apply_verify_pipeline(args)
+    _apply_mesh_devices(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -259,6 +272,7 @@ async def _run_many(args) -> None:
     _apply_fault_plane(args)
     _apply_profile(args)
     _apply_verify_pipeline(args)
+    _apply_mesh_devices(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -418,9 +432,10 @@ def main(argv=None) -> int:
     )
     p_run.add_argument(
         "--verifier",
-        choices=["cpu", "tpu", "tpu-sharded"],
+        choices=["cpu", "tpu", "tpu-sharded", "mesh"],
         default="cpu",
-        help="signature verification backend",
+        help="signature verification backend ('mesh' is the sharded "
+        "multi-chip backend, an alias of tpu-sharded)",
     )
     metrics_help = (
         "serve Prometheus /metrics on this port and enable telemetry "
@@ -463,6 +478,14 @@ def main(argv=None) -> int:
         metavar="N",
         help=pipeline_help,
     )
+    mesh_help = (
+        "device count for the sharded mesh verifier (default: every "
+        "visible device, or the HOTSTUFF_MESH_DEVICES env knob; only "
+        "meaningful with --verifier mesh/tpu-sharded)"
+    )
+    p_run.add_argument(
+        "--mesh-devices", type=int, default=None, metavar="N", help=mesh_help
+    )
 
     p_many = sub.add_parser(
         "run-many",
@@ -476,7 +499,9 @@ def main(argv=None) -> int:
         "--transport", choices=["asyncio", "native"], default="asyncio"
     )
     p_many.add_argument(
-        "--verifier", choices=["cpu", "tpu", "tpu-sharded"], default="cpu"
+        "--verifier",
+        choices=["cpu", "tpu", "tpu-sharded", "mesh"],
+        default="cpu",
     )
     p_many.add_argument(
         "--metrics-port", type=int, default=None, help=metrics_help
@@ -490,6 +515,9 @@ def main(argv=None) -> int:
         default=None,
         metavar="N",
         help=pipeline_help,
+    )
+    p_many.add_argument(
+        "--mesh-devices", type=int, default=None, metavar="N", help=mesh_help
     )
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
@@ -511,6 +539,9 @@ def main(argv=None) -> int:
         metavar="N",
         help=pipeline_help,
     )
+    p_dep.add_argument(
+        "--mesh-devices", type=int, default=None, metavar="N", help=mesh_help
+    )
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
@@ -531,6 +562,7 @@ def main(argv=None) -> int:
         _apply_fault_plane(args)
         _apply_profile(args)
         _apply_verify_pipeline(args)
+        _apply_mesh_devices(args)
         asyncio.run(
             _deploy_testbed(
                 args.nodes,

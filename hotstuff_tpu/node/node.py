@@ -80,7 +80,14 @@ class LazyDeviceVerifier:
     # in THIS process — the async service routes to the device only then
     _warm: set[str] = set()
 
+    #: "mesh" is the user-facing spelling of the sharded backend
+    #: (benchmark profile --verifier mesh, node --verifier mesh); it
+    #: normalizes to the canonical kind at construction so both names
+    #: share the same process-wide device singleton and warm state
+    _KIND_ALIASES = {"mesh": "tpu-sharded"}
+
     def __init__(self, kind: str):
+        kind = self._KIND_ALIASES.get(kind, kind)
         self._kind = kind
         self._cpu = CpuVerifier()
         self._precomputed: list[bytes] = []
@@ -103,6 +110,18 @@ class LazyDeviceVerifier:
     def _device(self) -> VerifierBackend | None:
         return self._shared_device.get(self._kind)
 
+    @property
+    def wave_bucket_shapes(self) -> tuple | None:
+        """The device verifier's advertised wave bucket ladder (the mesh
+        backend's mesh-multiple shapes, ISSUE 7) — None until the device
+        materializes, so the async service's lazy bucket resolution
+        falls back to the canonical ladder before warmup and picks the
+        mesh grid up the moment it exists."""
+        device = self._device
+        if device is None:
+            return None
+        return getattr(device, "wave_bucket_shapes", None)
+
     def _materialize(self) -> VerifierBackend:
         device = self._shared_device.get(self._kind)
         if device is None:
@@ -110,11 +129,22 @@ class LazyDeviceVerifier:
                 from ..tpu.ed25519 import BatchVerifier
 
                 device = BatchVerifier(min_device_batch=self.min_device_batch)
-            else:  # tpu-sharded: batch sharded over every visible device
-                from ..parallel.mesh import ShardedBatchVerifier
+            else:  # tpu-sharded: batch sharded over the device mesh
+                from ..parallel.mesh import (
+                    ShardedBatchVerifier,
+                    default_mesh,
+                    mesh_devices_from_env,
+                )
 
+                # HOTSTUFF_MESH_DEVICES (node --mesh-devices) sizes the
+                # production mesh; unset means every visible device.
+                # Read HERE, at materialization, because that is the
+                # moment the mesh is actually built — the CLI bridge
+                # sets the env before any verifier exists.
+                n = mesh_devices_from_env()
                 device = ShardedBatchVerifier(
-                    min_device_batch=self.min_device_batch
+                    mesh=default_mesh(n) if n else None,
+                    min_device_batch=self.min_device_batch,
                 )
             self._shared_device[self._kind] = device
         if self._precomputed:
@@ -164,11 +194,13 @@ class LazyDeviceVerifier:
 def make_verifier(kind: str, scheme: str = "ed25519") -> VerifierBackend:
     if kind == "cpu":
         return make_cpu_verifier(scheme)
-    if kind in ("tpu", "tpu-sharded"):
+    if kind in ("tpu", "tpu-sharded", "mesh"):
         if scheme == "bls":
             # BLS device path: G1 vote-signature aggregation on device
             # (hotstuff_tpu/tpu/bls.py), host pairing equality per QC.
-            return make_device_verifier(scheme, kind)
+            return make_device_verifier(
+                scheme, "tpu-sharded" if kind == "mesh" else kind
+            )
         return LazyDeviceVerifier(kind)
     raise ValueError(f"unknown verifier backend '{kind}'")
 

@@ -15,6 +15,8 @@ All functions work identically on a real TPU slice or on the virtual
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -44,8 +46,25 @@ def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = True):
 
 from ..tpu import curve
 from ..tpu.ed25519 import BatchVerifier
+from ..telemetry import spans as _spans
 
 DP_AXIS = "dp"
+
+
+def mesh_devices_from_env() -> int | None:
+    """``HOTSTUFF_MESH_DEVICES`` as a positive device count, or None when
+    unset/invalid (None means "use every visible device").  This is the
+    env half of the node CLI's ``--mesh-devices`` bridge: it is read at
+    backend materialization so run/run-many/deploy and the bench
+    subprocesses all size the production mesh the same way."""
+    raw = os.environ.get("HOTSTUFF_MESH_DEVICES", "").strip()
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
 
 
 def default_mesh(n_devices: int | None = None) -> Mesh:
@@ -95,7 +114,11 @@ def _make_local_verify_pallas(interpret: bool = False):
 
 
 def make_sharded_verify(
-    mesh: Mesh, pallas: bool = False, interpret: bool = False
+    mesh: Mesh,
+    pallas: bool = False,
+    interpret: bool = False,
+    donate: bool = False,
+    psum_word: bool = False,
 ):
     """jitted [batch]-bool verification with the batch sharded over the
     mesh. Batch size must be a multiple of the mesh size (the driver pads).
@@ -103,17 +126,43 @@ def make_sharded_verify(
     ``pallas=True`` runs the Pallas kernel per shard (TPU meshes; the
     XLA kernel remains the portable path for the CPU-mesh tests and
     dryrun).  ``interpret=True`` (tests only) drives the pallas branch
-    through the interpreter on CPU meshes."""
+    through the interpreter on CPU meshes.
+
+    ``donate=True`` donates the per-wave staging temporaries (args 4-7:
+    s_bits, k_bits, r_y, r_sign) to the kernel, mirroring the base
+    verifier's ``_verify_kernel_donated`` — the committee point rows
+    (args 0-3) alias the sharded device key gather and must NOT be
+    donated.
+
+    ``psum_word=True`` additionally returns the replicated invalid-count
+    scalar — the single psum word crossing ICI that the paper's scaling
+    story hinges on.  The production mesh readback fetches THAT word
+    first and skips the multi-shard lane gather entirely when the whole
+    wave is valid (the common case)."""
+    local = _make_local_verify_pallas(interpret) if pallas else _local_verify
+    if psum_word:
+        inner = local
+
+        def local(ax, ay, az, at, s_bits, k_bits, r_y, r_sign):
+            ok = inner(ax, ay, az, at, s_bits, k_bits, r_y, r_sign)
+            bad = jax.lax.psum(
+                jnp.sum(jnp.logical_not(ok).astype(jnp.int32)), DP_AXIS
+            )
+            return ok, bad
+
+        out_specs = (P(DP_AXIS), P())
+    else:
+        out_specs = P(DP_AXIS)
     fn = shard_map(
-        _make_local_verify_pallas(interpret) if pallas else _local_verify,
+        local,
         mesh=mesh,
         in_specs=_IN_SPECS,
-        out_specs=P(DP_AXIS),
+        out_specs=out_specs,
         # pallas_call's out_shape carries no varying-mesh-axes metadata,
         # so the vma consistency check cannot apply to the pallas branch
         check_vma=not pallas,
     )
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(4, 5, 6, 7) if donate else ())
 
 
 def make_sharded_qc_check(mesh: Mesh):
@@ -156,7 +205,17 @@ class ShardedBatchVerifier(BatchVerifier):
         self._shard_pallas = (
             self.mesh.devices.flat[0].platform == "tpu"
         )
-        self._kernel = make_sharded_verify(self.mesh, pallas=self._shard_pallas)
+        mk = lambda **kw: make_sharded_verify(  # noqa: E731
+            self.mesh, pallas=self._shard_pallas, **kw
+        )
+        # four compiled entry points, each compiled lazily per shape:
+        # the plain per-item kernel keeps stage()/bench signature parity
+        # with the base class; production verify_device dispatches the
+        # psum-word variants (per-item lanes + the one ICI word).
+        self._kernel = mk()
+        self._kernel_donated = mk(donate=True)
+        self._kernel_psum = mk(psum_word=True)
+        self._kernel_psum_donated = mk(psum_word=True, donate=True)
         self.name = f"tpu-sharded-{m}"
         if self._shard_pallas:
             from ..tpu import pallas_dsm
@@ -171,9 +230,30 @@ class ShardedBatchVerifier(BatchVerifier):
                 m * k * pallas_dsm.LANE_TILE for k in (1, 2, 4, 8)
             )
         else:
-            # equal per-device slices: multiples of the mesh size on the
-            # same power-of-4 progression as the base class
-            self.pad_sizes = tuple(m * p for p in (1, 4, 16, 64, 256, 1024))
+            # equal per-device slices: powers of two from one row per
+            # device up to 8192.  The old power-of-4 progression
+            # (m * {1,4,16,64,...}) skipped 4096 at mesh 8 — a 4096-sig
+            # train wave padded to 8192, 2x the work — and made every
+            # canonical wave bucket land between grid points (bucket 64
+            # at mesh 8 dispatched shape 128).  Powers of two keep each
+            # bucket == its kernel shape at every mesh size.
+            sizes, s = [], m
+            while s <= 8192:
+                sizes.append(s)
+                s *= 2
+            self.pad_sizes = tuple(sizes)
+        # Mesh-multiple wave bucket shapes advertised to the async
+        # service's fixed-shape tunnel (ISSUE 7): the canonical bucket
+        # ladder (incl. the 4096 train bucket) snapped UP to this mesh's
+        # pad grid, so every padded wave IS a pre-compiled kernel shape
+        # with equal per-device slices.  On TPU meshes this snaps to the
+        # lane-tile grid (e.g. v5e-8 -> 1024/2048/4096).
+        grid = self.pad_sizes
+        snapped = (
+            next((p for p in grid if p >= b), grid[-1])
+            for b in (16, 64, 256, 1024, 4096)
+        )
+        self.wave_bucket_shapes = tuple(sorted(set(snapped)))
         # Per-shard device key table (ISSUE 6): the stacked committee
         # tables replicate across the mesh once per rebuild, each wave
         # ships only its [padded] row indices sharded over dp, and the
@@ -215,10 +295,13 @@ class ShardedBatchVerifier(BatchVerifier):
     def _run_kernel(
         self, ax, ay, az, at, s_bits, k_bits, r_y, r_sign, donate=False
     ):
-        # donate is accepted for interface parity and ignored: the
-        # shard_map kernel's staging arrays are already consumed
-        # per-wave and donation across shard_map is not wired up
-        return self._kernel(
+        # donation wired through the shard_map jit (ISSUE 7): the
+        # donated compilation hands the four per-wave staging
+        # temporaries (bit-planes + R rows) back to XLA, exactly like
+        # the base class's _verify_kernel_donated — the point rows stay
+        # un-donated because they alias the sharded committee gather.
+        kernel = self._kernel_donated if donate else self._kernel
+        return kernel(
             jnp.asarray(ax),
             jnp.asarray(ay),
             jnp.asarray(az),
@@ -228,3 +311,45 @@ class ShardedBatchVerifier(BatchVerifier):
             jnp.asarray(r_y),
             jnp.asarray(r_sign),
         )
+
+    def verify_device(self, messages, pubkeys, signatures):
+        """Mesh dispatch with the psum-word readback: each wave returns
+        the per-item lanes (sharded over dp) AND the replicated
+        invalid-count scalar — the one word that crosses ICI.  The host
+        blocks on compute, fetches that word, and only gathers the
+        sharded lane array when something was actually invalid, so the
+        common all-valid wave's readback is a single scalar transfer
+        instead of a cross-shard gather.  Under the profiler the word
+        fetch is its own ``mesh.psum`` span, sitting between
+        device.execute and readback in the waterfall."""
+        n = len(messages)
+        if n == 0:
+            return np.zeros(0, bool)
+        if n > self._padded_sizes()[-1]:
+            # oversized batches chunk through the base class, which
+            # recurses back here per max-shape chunk
+            return super().verify_device(messages, pubkeys, signatures)
+        donate = self.donate_buffers
+        kernel = self._kernel_psum_donated if donate else self._kernel_psum
+        rec = _spans.recorder()
+        if rec is None:
+            valid_host, arrays = self.prepare(messages, pubkeys, signatures)
+            ok, bad = kernel(*(jnp.asarray(a) for a in arrays))
+            ok = jax.block_until_ready(ok)
+            if int(np.asarray(bad)) == 0:
+                # every lane valid => host validity was all-True too
+                # (host-invalid rows are zeroed into failing lanes)
+                return np.ones(n, bool)
+            return np.asarray(ok)[:n] & valid_host
+        with rec.span("prepare"):
+            valid_host, arrays = self.prepare(messages, pubkeys, signatures)
+        with rec.span("dispatch"):
+            ok, bad = kernel(*(jnp.asarray(a) for a in arrays))
+        with rec.span("device.execute"):
+            ok = jax.block_until_ready(ok)
+        with rec.span("mesh.psum"):
+            bad_count = int(np.asarray(bad))
+        if bad_count == 0:
+            return np.ones(n, bool)
+        with rec.span("readback"):
+            return np.asarray(ok)[:n] & valid_host

@@ -20,6 +20,9 @@ Span taxonomy (leaf stages sum to the wave's end-to-end time)::
     prepare          host staging: decompress lookup, hashing, padding
     dispatch         kernel call (device enqueue; returns a future)
     device.execute   block_until_ready on the enqueued computation
+    mesh.psum        mesh backend only: fetching the replicated QC-valid
+                     word — the single psum crossing ICI (ISSUE 7); when
+                     it reads 0 the sharded lane gather is skipped
     readback         device -> host transfer of the verdict lanes
     host.verify      CPU evaluation (inline route / fallback / hybrid)
     host.pairing     BLS pairing equality on the host
@@ -81,6 +84,7 @@ LEAF_STAGES: tuple[str, ...] = (
     "prepare",
     "dispatch",
     "device.execute",
+    "mesh.psum",
     "readback",
     "host.verify",
     "host.pairing",

@@ -264,9 +264,12 @@ class BatchVerifier:
         # (ISSUE 6): a small wave the cost model routes to the device
         # pads UP to the smallest bucket, so that shape must be warm too
         if getattr(self, "supports_wave_padding", False):
-            from ..crypto.async_service import wave_buckets_from_env
+            from ..crypto.async_service import resolve_wave_buckets
 
-            buckets = wave_buckets_from_env()
+            # same resolution the service uses: explicit env ladder
+            # wins, else this backend's own advertised shapes (the mesh
+            # verifier's mesh-multiple buckets, ISSUE 7)
+            buckets = resolve_wave_buckets(self)
             if buckets:
                 floor = min(floor, buckets[0])
         sizes = [p for p in grid if floor <= p <= ceiling] or [n]

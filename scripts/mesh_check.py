@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Mesh scale-out check (ISSUE 7): does the sharded mesh backend still
+scale under the production dispatch pipeline?
+
+Drives the mesh wave-train (``benchmark/meshtrain.py``) at mesh sizes
+1 and 8 on the virtual 8-device CPU mesh — each size in its own child
+process with ``HOTSTUFF_MESH_DEVICES`` set before jax loads, exactly
+the node CLI's ``--mesh-devices`` path — prints the per-mesh sustained
+train rates, and exits non-zero when the mesh-8 scaling efficiency
+falls below the floor.
+
+The floor is self-calibrating: half the efficiency recorded in the
+committed reference round's ``mesh_train`` block (``--ref``, default
+the latest BENCH_r*.json carrying one), overridable with
+``MESH_EFF_FLOOR``; with no reference the absolute default floor is
+0.02 (the virtual mesh shares one socket — the check catches the
+sharded path COLLAPSING, not sub-linear CPU scaling).
+
+Usage:
+    python scripts/mesh_check.py          # train + compare
+    MESH=1 scripts/trace.sh               # same, via the trace wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MESH_SIZES = (1, 8)
+ABS_FLOOR = 0.02
+REF_SHARE = 0.5
+
+
+def load_ref_efficiency(ref: str | None) -> tuple[float, str] | None:
+    """mesh_scaling_efficiency from the committed reference: an explicit
+    --ref file, else the newest BENCH_r*.json that carries one."""
+    paths = (
+        [ref]
+        if ref
+        else sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")), reverse=True)
+    )
+    for path in paths:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        doc = rec.get("parsed") if isinstance(rec.get("parsed"), dict) else rec
+        eff = ((doc or {}).get("mesh_train") or {}).get(
+            "mesh_scaling_efficiency"
+        )
+        if isinstance(eff, (int, float)) and eff > 0:
+            return float(eff), os.path.basename(path)
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ref", default=None,
+                    help="reference BENCH round (default: newest "
+                    "BENCH_r*.json with a mesh_train block)")
+    ap.add_argument("--batches", default="256,1024",
+                    help="train batch sizes (default 256,1024 — smaller "
+                    "than bench.py's sweep to keep the check fast)")
+    ap.add_argument("--train", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from benchmark.meshtrain import run_mesh_train
+
+    batches = tuple(int(x) for x in args.batches.split(",") if x)
+    result = run_mesh_train(
+        mesh_sizes=MESH_SIZES,
+        batches=batches,
+        train=args.train,
+        reps=args.reps,
+        force_virtual=True,
+    )
+
+    print(" MESH CHECK — sustained train sigs/s per mesh size "
+          "(virtual CPU mesh)")
+    for m_str, doc in sorted(
+        result.get("per_mesh", {}).items(), key=lambda kv: int(kv[0])
+    ):
+        rates = ", ".join(
+            f"{b}: {v['train_sigs_per_s']}"
+            for b, v in sorted(
+                doc["per_batch"].items(), key=lambda kv: int(kv[0])
+            )
+        )
+        print(f"   mesh {m_str}: {rates}  (devices {doc['mesh_devices']})")
+    for m_str, err in (result.get("errors") or {}).items():
+        print(f"   mesh {m_str}: CHILD FAILED — {err}")
+
+    eff = result.get("mesh_scaling_efficiency")
+    if eff is None:
+        print("mesh_check: FAIL — no mesh-8 efficiency "
+              "(a child died or mesh 1 is missing)")
+        return 1
+
+    env_floor = os.environ.get("MESH_EFF_FLOOR")
+    if env_floor:
+        floor, provenance = float(env_floor), "MESH_EFF_FLOOR"
+    else:
+        ref = load_ref_efficiency(args.ref)
+        if ref:
+            floor = ref[0] * REF_SHARE
+            provenance = f"{ref[1]} x {REF_SHARE:g}"
+        else:
+            floor, provenance = ABS_FLOOR, "absolute default"
+    print(f"   mesh-8 scaling efficiency {eff:.4f} "
+          f"(floor {floor:.4f} from {provenance})")
+    if eff < floor:
+        print("mesh_check: FAIL — mesh-8 efficiency below the floor; "
+              "the sharded dispatch path has collapsed")
+        return 1
+    print("mesh_check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

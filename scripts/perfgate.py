@@ -13,6 +13,9 @@ non-zero when either guarded metric regresses past the threshold
   * ``pipeline.train_sigs_per_s``    — sustained QC-256 wave-train
     throughput through the depth-2 dispatch pipeline (ISSUE 5; may not
     fall >15%)
+  * ``mesh_train.mesh_scaling_efficiency`` — per-mesh-size sustained
+    train sigs/s at the largest mesh vs single-device (ISSUE 7; wide
+    per-guard 50% gate — the virtual CPU mesh is noisy)
 
 ``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
 (ISSUE 6): the fresh value must stay within ``--ratchet-slack``
@@ -66,6 +69,19 @@ GUARDS = (
         "pipeline.train_sigs_per_s",
         lambda doc: (doc.get("pipeline") or {}).get("train_sigs_per_s"),
         -1,
+    ),
+    # mesh scale-out health (ISSUE 7): sustained-train efficiency at the
+    # largest mesh vs single-device.  The virtual CPU mesh shares one
+    # socket, so the absolute value is small and noisy — hence the wide
+    # per-guard 50% gate; skip-if-missing covers references from before
+    # the mesh_train block existed.
+    (
+        "mesh_train.mesh_scaling_efficiency",
+        lambda doc: (doc.get("mesh_train") or {}).get(
+            "mesh_scaling_efficiency"
+        ),
+        -1,
+        0.5,
     ),
 )
 

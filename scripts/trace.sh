@@ -17,6 +17,12 @@
 #                                 # delta vs the committed reference,
 #                                 # non-zero exit if leaf-span coverage
 #                                 # drops below 95%
+#   MESH=1 scripts/trace.sh       # ONLY the mesh scale-out check
+#                                 # (scripts/mesh_check.py): wave trains
+#                                 # at mesh 1 and 8 on the virtual
+#                                 # 8-device CPU mesh, non-zero exit if
+#                                 # mesh-8 scaling efficiency falls
+#                                 # below the committed-reference floor
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -24,6 +30,11 @@ cd "$(dirname "$0")/.."
 if [ "${TUNNEL:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/tunnel_check.py "$@"
+fi
+
+if [ "${MESH:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/mesh_check.py "$@"
 fi
 
 timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

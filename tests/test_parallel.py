@@ -75,8 +75,10 @@ def test_mesh_pallas_branch_selection():
     mesh = default_mesh()
     v = ShardedBatchVerifier(mesh, min_device_batch=0)
     assert v._shard_pallas == (mesh.devices.flat[0].platform == "tpu")
-    if not v._shard_pallas:  # CPU test mesh
-        assert v.pad_sizes == tuple(8 * p for p in (1, 4, 16, 64, 256, 1024))
+    if not v._shard_pallas:  # CPU test mesh: powers of two from one
+        # row per device to 8192 (ISSUE 7 — every wave bucket, incl.
+        # the 4096 train bucket, is its own kernel shape)
+        assert v.pad_sizes == tuple(8 * 2**j for j in range(11))
 
 
 def test_mesh_pallas_interpret_256_votes():
