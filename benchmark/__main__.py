@@ -252,6 +252,8 @@ def task_remote_bench(args) -> int:
         verifier=args.verifier,
         journal=args.journal,
         profile=args.profile,
+        fault_plane=args.fault_plane,
+        fault_seed=args.fault_seed,
     )
     return 0
 
@@ -422,7 +424,8 @@ def main(argv=None) -> int:
         default="split-brain",
         help="canned scenario name (hotstuff_tpu/faults/scenarios.py): "
         "split-brain, leader-isolation, flapping-link, "
-        "rolling-crash-restart",
+        "rolling-crash-restart, byz-equivocate, byz-forge-qc, "
+        "byz-withhold, byz-collude",
     )
     p.add_argument(
         "--spec",
@@ -582,6 +585,21 @@ def main(argv=None) -> int:
         action="store_true",
         help="verify-pipeline span profiler on in every remote node "
         "(spans land in the pulled journals when --journal is also set)",
+    )
+    p.add_argument(
+        "--fault-plane",
+        default=None,
+        metavar="SCENARIO_OR_SPEC",
+        help="run the sweep under a fault/adversary scenario: a canned "
+        "name (split-brain, byz-equivocate, byz-collude, ...) or a spec "
+        "JSON path; uploaded with the configs and threaded to every "
+        "node via --fault-plane/--adversary",
+    )
+    p.add_argument(
+        "--fault-seed",
+        type=int,
+        default=0,
+        help="seed for a canned --fault-plane scenario",
     )
     p.set_defaults(fn=task_remote_bench)
 

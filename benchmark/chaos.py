@@ -33,6 +33,7 @@ import signal
 import time
 
 from hotstuff_tpu.faults.scenarios import build, last_heal
+from hotstuff_tpu.node.config import Secret, read_committee
 
 from .invariants import check_run
 from .local import LocalBench
@@ -84,6 +85,9 @@ class ChaosBench(LocalBench):
         if not math.isinf(heal):
             resume = self.spec.get("liveness", {}).get("resume_within_s", 20.0)
             self.duration = max(self.duration, heal + resume + 4.0)
+        # node index -> short authority id, resolved from the key files
+        # at config time (feeds violation attribution in the checker)
+        self._authorities: dict[int, str] = {}
 
     # ---- config ------------------------------------------------------------
 
@@ -92,13 +96,32 @@ class ChaosBench(LocalBench):
         self._epoch = time.time() + BOOT_MARGIN_S
         spec = dict(self.spec)
         spec["epoch_unix"] = self._epoch
-        spec["nodes"] = {
-            f"127.0.0.1:{self.base_port + i}": i for i in range(self.nodes)
-        }
+        # Resolve node index -> listen address through the ACTUAL key +
+        # committee files (not a re-derived `127.0.0.1:{base_port+i}`
+        # guess): a subclass or remote driver laying the committee out
+        # differently would otherwise hand every node an empty fault
+        # plane while the harness believed the scenario ran.
+        committee = read_committee(PathMaker.committee_file())
+        nodes_map: dict[str, int] = {}
+        for i in range(self.nodes):
+            name = Secret.read(PathMaker.key_file(i)).name
+            addr = committee.address(name)
+            if addr is None:
+                raise RuntimeError(
+                    f"key file {i} names an authority absent from the "
+                    "committee file"
+                )
+            nodes_map[f"{addr[0]}:{addr[1]}"] = i
+            self._authorities[i] = name.encode_base64()[:8]
+        spec["nodes"] = nodes_map
         path = PathMaker.fault_spec_file()
         with open(path, "w") as f:
             json.dump(spec, f, indent=2)
         self.extra_env["HOTSTUFF_FAULTS"] = os.path.abspath(path)
+        if spec.get("adversary"):
+            # same spec file, second plane: adversarial nodes find their
+            # policy schedule under the "adversary" key
+            self.extra_env["HOTSTUFF_ADVERSARY"] = os.path.abspath(path)
         Print.info(
             f"chaos: scenario {self.spec.get('name')!r} seed {self.seed}, "
             f"spec -> {path} (epoch in {BOOT_MARGIN_S:.0f}s)"
@@ -148,7 +171,12 @@ class ChaosBench(LocalBench):
         """Evaluate safety/liveness over the finished run's logs.
         Returns (all_ok, rendered CHAOS block)."""
         assert self._epoch is not None, "run() must complete first"
-        return check_run(PathMaker.logs_path(), self.spec, self._epoch)
+        return check_run(
+            PathMaker.logs_path(),
+            self.spec,
+            self._epoch,
+            authorities=self._authorities or None,
+        )
 
 
 __all__ = ["BOOT_MARGIN_S", "ChaosBench"]

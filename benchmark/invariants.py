@@ -27,11 +27,25 @@ from __future__ import annotations
 import glob
 import math
 import os
+import re
 
 from .logs import RE_COMMITTED, _ts
 
 # commit observation: (wall-clock seconds, round, block digest)
 Commit = tuple[float, int, str]
+
+# Adversary-plane activity lines (core/proposer/adversary log contract,
+# mirroring the RE_COMMITTED approach: the node's log IS its history).
+RE_BYZ_ATTACK = re.compile(
+    r"byz (equivocate|forge-qc|withhold|double-vote|flood|shadow-commit)"
+)
+# Honest-side defense lines: rejected certificates / evicted signatures
+# (core._handle_timeout, aggregator.QCMaker) and equivocation evidence
+# (a second paid digest cell — aggregator._admit_cell).
+RE_QC_REJECT = re.compile(
+    r"qc reject: invalid certificate|Evicting invalid vote signature"
+)
+RE_VOTE_CONFLICT = re.compile(r"second digest cell paid by")
 
 
 def commits_from_logs(logs_dir: str) -> dict[str, list[Commit]]:
@@ -46,6 +60,81 @@ def commits_from_logs(logs_dir: str) -> dict[str, list[Commit]]:
             (_ts(ts), int(rnd), digest)
             for ts, rnd, digest in RE_COMMITTED.findall(content)
         ]
+    return out
+
+
+def byz_activity_from_logs(logs_dir: str) -> dict[str, dict[str, int]]:
+    """Per-node Byzantine activity counts from a logs directory: attack
+    lines on adversarial nodes, defense lines on honest ones."""
+    out: dict[str, dict[str, int]] = {}
+    for path in sorted(glob.glob(os.path.join(logs_dir, "node-*.log"))):
+        name = os.path.basename(path)[: -len(".log")]
+        with open(path) as f:
+            content = f.read()
+        counts: dict[str, int] = {}
+        for policy in RE_BYZ_ATTACK.findall(content):
+            counts[policy] = counts.get(policy, 0) + 1
+        qc_rejects = len(RE_QC_REJECT.findall(content))
+        if qc_rejects:
+            counts["qc_reject"] = qc_rejects
+        conflicts = len(RE_VOTE_CONFLICT.findall(content))
+        if conflicts:
+            counts["vote_conflict"] = conflicts
+        out[name] = counts
+    return out
+
+
+def adversaries_from_spec(
+    spec: dict, authorities: dict[int, str] | None = None
+) -> dict[str, dict]:
+    """Map the spec's adversarial node indexes to log-node names with
+    their policies and (when the caller can resolve key files)
+    authority identities: {"node-0": {"policies": [...], "authority":
+    "ab12cd34" | None}}."""
+    out: dict[str, dict] = {}
+    for rule in spec.get("adversary", ()):
+        nodes = rule.get("node", rule.get("nodes", ()))
+        if isinstance(nodes, int):
+            nodes = (nodes,)
+        for idx in nodes:
+            idx = int(idx)
+            entry = out.setdefault(
+                f"node-{idx}",
+                {
+                    "index": idx,
+                    "policies": [],
+                    "authority": (authorities or {}).get(idx),
+                },
+            )
+            policy = rule.get("policy", "?")
+            if policy not in entry["policies"]:
+                entry["policies"].append(policy)
+    return out
+
+
+def attribute_violations(
+    violations: list[str], adversaries: dict[str, dict]
+) -> list[str]:
+    """Annotate each safety violation with the adversarial authorities
+    involved: a violation naming an adversarial node (or occurring at
+    all while equivocators are live) must point at the equivocating
+    authors, not just the conflicting digests."""
+    if not adversaries:
+        return list(violations)
+    out = []
+    for v in violations:
+        involved = [
+            (name, info)
+            for name, info in sorted(adversaries.items())
+            if re.search(rf"\b{re.escape(name)}\b", v)
+        ] or sorted(adversaries.items())
+        tags = ", ".join(
+            f"{name} ({'/'.join(info['policies'])}"
+            + (f", authority {info['authority']}" if info["authority"] else "")
+            + ")"
+            for name, info in involved
+        )
+        out.append(f"{v} [adversary: {tags}]")
     return out
 
 
@@ -75,6 +164,25 @@ def check_safety(
                     f"{got[1]} -> {got[0]}, {node} -> {digest}"
                 )
     return (not violations), violations
+
+
+def trusted_subset_recheck(
+    commits_by_node: dict[str, list[Commit]],
+    untrusted: set[str] | frozenset[str],
+) -> tuple[bool, list[str]]:
+    """Re-check safety under TEE-style trusted-subset quorum math
+    (arXiv:2512.09409): when attested hardware removes equivocation from
+    the fault model, a quorum needs only f+1 of 2f+1 *trusted* replicas,
+    and the histories of the untrusted (here: adversarial) nodes are
+    discarded before checking consistency.  A full-history FAIL that
+    turns into a PASS here demonstrates the attack lives entirely in the
+    colluders' reported histories."""
+    trusted = {
+        node: commits
+        for node, commits in commits_by_node.items()
+        if node not in untrusted
+    }
+    return check_safety(trusted)
 
 
 def check_liveness(
@@ -147,8 +255,16 @@ def chaos_block(
         f" Scenario: {scenario} (seed {seed})\n",
         f" Safety (no conflicting commits): {'PASS' if safety_ok else 'FAIL'}\n",
     ]
-    for v in safety_violations:
+    # a sustained attack (byz-collude) yields one violation per shadow
+    # commit — hundreds per run; cap the render, the count tells the story
+    shown = safety_violations[:8]
+    for v in shown:
         lines.append(f"   ! {v}\n")
+    if len(safety_violations) > len(shown):
+        lines.append(
+            f"   ! ... and {len(safety_violations) - len(shown)} more "
+            "conflicting-commit violations\n"
+        )
     if liveness_ok is None:
         lines.append(" Liveness: n/a (scenario never heals)\n")
     else:
@@ -170,18 +286,80 @@ def chaos_block(
     return "".join(lines)
 
 
+def byz_block(
+    adversaries: dict[str, dict],
+    activity: dict[str, dict[str, int]],
+    safety_ok: bool,
+    trusted_result: tuple[bool, list[str]] | None = None,
+) -> str:
+    """Render the ``+ BYZ`` SUMMARY section: which nodes attacked, with
+    what policies and how often; what the honest committee rejected; and
+    (under ``quorum_mode: trusted-subset``) the safety verdict once the
+    adversarial histories are discarded."""
+    lines = [" + BYZ:\n"]
+    for name, info in sorted(adversaries.items()):
+        who = f" Adversary {name}"
+        if info.get("authority"):
+            who += f" (authority {info['authority']})"
+        who += f": {'/'.join(info['policies'])}"
+        attacks = {
+            k: v
+            for k, v in activity.get(name, {}).items()
+            if k not in ("qc_reject", "vote_conflict")
+        }
+        if attacks:
+            who += " — " + ", ".join(
+                f"{k} x{v}" for k, v in sorted(attacks.items())
+            )
+        lines.append(who + "\n")
+    defended = {
+        node: counts
+        for node, counts in sorted(activity.items())
+        if node not in adversaries
+        and (counts.get("qc_reject") or counts.get("vote_conflict"))
+    }
+    for node, counts in defended.items():
+        parts = []
+        if counts.get("qc_reject"):
+            parts.append(f"qc_reject x{counts['qc_reject']}")
+        if counts.get("vote_conflict"):
+            parts.append(f"vote_conflict x{counts['vote_conflict']}")
+        lines.append(f" Honest {node} rejected: {', '.join(parts)}\n")
+    lines.append(
+        f" Attack contained (full-history safety): "
+        f"{'PASS' if safety_ok else 'FAIL'}\n"
+    )
+    if trusted_result is not None:
+        t_ok, t_viol = trusted_result
+        lines.append(
+            " Trusted-subset quorum (adversaries excluded): "
+            f"{'PASS' if t_ok else 'FAIL'}\n"
+        )
+        for v in t_viol:
+            lines.append(f"   ! {v}\n")
+    return "".join(lines)
+
+
 def check_run(
     logs_dir: str,
     spec: dict,
     epoch_unix: float,
+    authorities: dict[int, str] | None = None,
 ) -> tuple[bool, str]:
     """Full invariant check for a finished chaos bench run: parse the
     node logs, evaluate both invariants against the scenario spec, and
-    return (all_ok, rendered CHAOS block)."""
+    return (all_ok, rendered CHAOS block).  When the spec carries an
+    ``adversary`` schedule, safety violations are attributed to the
+    Byzantine authorities and a ``+ BYZ`` section is appended; the
+    full-history verdict still governs the exit status (a successful
+    collusion FAILs the run even if the trusted-subset recheck passes)."""
     from hotstuff_tpu.faults.scenarios import last_heal
 
     commits = commits_from_logs(logs_dir)
     safety_ok, safety_viol = check_safety(commits)
+    adversaries = adversaries_from_spec(spec, authorities)
+    if adversaries:
+        safety_viol = attribute_violations(safety_viol, adversaries)
     heal_rel = last_heal(spec)
     liveness = spec.get("liveness", {})
     if math.isinf(heal_rel):
@@ -192,26 +370,45 @@ def check_run(
             spec.get("name", "custom"), int(spec.get("seed", 0)),
             safety_ok, safety_viol, live_ok, live_viol, details,
         )
-        return safety_ok, block
-    live_ok, live_viol, details = check_liveness(
-        commits,
-        heal_unix=epoch_unix + heal_rel,
-        resume_within_s=liveness.get("resume_within_s"),
-        max_round_gap=liveness.get("max_round_gap"),
-    )
-    block = chaos_block(
-        spec.get("name", "custom"), int(spec.get("seed", 0)),
-        safety_ok, safety_viol, live_ok, live_viol, details,
-        heal_rel=heal_rel,
-    )
-    return safety_ok and live_ok, block
+        all_ok = safety_ok
+    else:
+        live_ok, live_viol, details = check_liveness(
+            commits,
+            heal_unix=epoch_unix + heal_rel,
+            resume_within_s=liveness.get("resume_within_s"),
+            max_round_gap=liveness.get("max_round_gap"),
+        )
+        block = chaos_block(
+            spec.get("name", "custom"), int(spec.get("seed", 0)),
+            safety_ok, safety_viol, live_ok, live_viol, details,
+            heal_rel=heal_rel,
+        )
+        all_ok = safety_ok and live_ok
+    if adversaries:
+        trusted_result = None
+        if spec.get("quorum_mode") == "trusted-subset":
+            trusted_result = trusted_subset_recheck(
+                commits, set(adversaries)
+            )
+        block += byz_block(
+            adversaries,
+            byz_activity_from_logs(logs_dir),
+            safety_ok,
+            trusted_result,
+        )
+    return all_ok, block
 
 
 __all__ = [
     "Commit",
+    "adversaries_from_spec",
+    "attribute_violations",
+    "byz_activity_from_logs",
+    "byz_block",
     "chaos_block",
     "check_liveness",
     "check_run",
     "check_safety",
     "commits_from_logs",
+    "trusted_subset_recheck",
 ]

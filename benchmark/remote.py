@@ -107,9 +107,23 @@ class RemoteBench:
 
     # ---- one benchmark run -------------------------------------------------
 
-    def _config(self, hosts: list[dict], nodes: int) -> None:
+    #: config-to-scenario-epoch margin on the remote rig: covers the
+    #: sequential per-host uploads and the detached node boots (the TPU
+    #: verifier warms a device kernel) before t=0 windows can open
+    REMOTE_BOOT_MARGIN_S = 45.0
+
+    def _config(
+        self, hosts: list[dict], nodes: int, chaos_spec: dict | None = None
+    ) -> None:
         """Generate keys/committee locally, upload per-node files
-        (reference remote.py:130-175)."""
+        (reference remote.py:130-175).  ``chaos_spec`` (a fault-plane /
+        adversary scenario) gets its ``nodes`` map resolved against the
+        REAL committee addresses — internal IPs and per-host port
+        offsets, not a localhost guess — then is uploaded to every live
+        host as ``.faults.json``."""
+        import json
+        import time
+
         from hotstuff_tpu.consensus import Committee, Parameters
         from hotstuff_tpu.node.config import (
             Secret,
@@ -120,16 +134,16 @@ class RemoteBench:
         keys = [Secret.new() for _ in range(nodes)]
         # round-robin nodes over hosts; co-located nodes (i // len(hosts)
         # > 0) need distinct ports or their listeners collide
+        addresses = [
+            (
+                hosts[i % len(hosts)]["internal_ip"],
+                self.settings.consensus_port + i // len(hosts),
+            )
+            for i in range(nodes)
+        ]
         committee = Committee.new(
             [
-                (
-                    secret.name,
-                    1,
-                    (
-                        hosts[i % len(hosts)]["internal_ip"],
-                        self.settings.consensus_port + i // len(hosts),
-                    ),
-                )
+                (secret.name, 1, addresses[i])
                 for i, secret in enumerate(keys)
             ]
         )
@@ -138,10 +152,24 @@ class RemoteBench:
         for i, secret in enumerate(keys):
             secret.write(PathMaker.key_file(i))
         repo = self.settings.repo_name
+        live_hosts = hosts[: min(nodes, len(hosts))]
+        if chaos_spec is not None:
+            spec = dict(chaos_spec)
+            spec["epoch_unix"] = time.time() + self.REMOTE_BOOT_MARGIN_S
+            spec["nodes"] = {
+                f"{host}:{port}": i
+                for i, (host, port) in enumerate(addresses)
+            }
+            with open(PathMaker.fault_spec_file(), "w") as f:
+                json.dump(spec, f, indent=2)
         # shared files once per host; key files once per node
-        for host in hosts[: min(nodes, len(hosts))]:
+        for host in live_hosts:
             self._upload(host["name"], PathMaker.committee_file(), f"{repo}/")
             self._upload(host["name"], PathMaker.parameters_file(), f"{repo}/")
+            if chaos_spec is not None:
+                self._upload(
+                    host["name"], PathMaker.fault_spec_file(), f"{repo}/"
+                )
         for i in range(nodes):
             host = hosts[i % len(hosts)]
             self._upload(host["name"], PathMaker.key_file(i), f"{repo}/")
@@ -156,6 +184,8 @@ class RemoteBench:
         verifier: str,
         journal: bool = False,
         profile: bool = False,
+        fault_plane: bool = False,
+        adversary: bool = False,
     ) -> None:
         """Boot clients then nodes in detached remote shells
         (reference remote.py:177-219)."""
@@ -168,6 +198,13 @@ class RemoteBench:
             tel_flags += " --journal-dir logs/journals"
         if profile:
             tel_flags += " --profile"
+        # chaos/adversary planes: both read the uploaded spec file
+        # (repo-relative — the node cmd cd's into the repo first)
+        spec_name = os.path.basename(PathMaker.fault_spec_file())
+        if fault_plane:
+            tel_flags += f" --fault-plane {spec_name}"
+        if adversary:
+            tel_flags += f" --adversary {spec_name}"
         # Detached-launch shape matters: `mkdir && cd && nohup CMD &`
         # backgrounds the ENTIRE and-list, so the background shell's own
         # un-redirected stdout/stderr keep the ssh channel open until
@@ -267,8 +304,18 @@ class RemoteBench:
         verifier: str = "tpu",
         journal: bool = False,
         profile: bool = False,
+        fault_plane: str | None = None,
+        fault_seed: int = 0,
     ) -> None:
-        """The sweep driver (reference remote.py:237-298)."""
+        """The sweep driver (reference remote.py:237-298).
+
+        ``fault_plane`` is a canned scenario name (hotstuff_tpu/faults/
+        scenarios.py — including the byz-* adversary scenarios) or a
+        path to a spec JSON; the driver resolves it per committee size,
+        uploads it with the configs, and threads ``--fault-plane`` (and
+        ``--adversary`` when the spec schedules one) to every node."""
+        import json
+
         hosts = [h for h in self.manager.hosts() if h["state"] == "READY"]
         if not hosts:
             raise BenchError("no READY instances in the testbed")
@@ -276,16 +323,31 @@ class RemoteBench:
 
         for nodes in nodes_list:
             for rate in rate_list:
+                chaos_spec = None
+                if fault_plane is not None:
+                    if os.path.exists(fault_plane):
+                        with open(fault_plane) as f:
+                            chaos_spec = json.load(f)
+                    else:
+                        from hotstuff_tpu.faults.scenarios import build
+
+                        chaos_spec = build(
+                            fault_plane, nodes=nodes, seed=fault_seed
+                        )
                 for attempt in range(runs):
                     Print.heading(
                         f"Remote bench: {nodes} nodes, {rate}/s, "
                         f"run {attempt + 1}/{runs}"
                     )
                     self.kill()
-                    self._config(hosts, nodes)
+                    self._config(hosts, nodes, chaos_spec=chaos_spec)
                     self._run_single(
                         hosts, nodes, rate, duration, faults, verifier,
                         journal=journal, profile=profile,
+                        fault_plane=chaos_spec is not None,
+                        adversary=bool(
+                            chaos_spec and chaos_spec.get("adversary")
+                        ),
                     )
                     time.sleep(duration + 20)
                     self.kill()

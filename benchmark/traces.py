@@ -175,6 +175,12 @@ class TraceSet:
         # taken from the node that journaled the most fault edges (every
         # node journals the same scenario schedule)
         self.fault_spans: list[tuple[str, int, int | None]] = []
+        # adversary-plane windows, per attacking node (unlike fault
+        # windows these are NOT committee-wide — only the Byzantine
+        # nodes journal them): (node, label, w_open_corr, w_close|None)
+        self.byz_spans: list[tuple[str, str, int, int | None]] = []
+        # individual attack events: (w_corr, node, kind, round)
+        self.byz_events: list[tuple[int, str, str, int]] = []
         # verify-pipeline profiler spans (ISSUE 4): node -> list of
         # (stage, w_end_corr, dur_ns).  A span record's timestamps mark
         # the span's END; its duration rides in the "u" field.
@@ -211,11 +217,24 @@ class TraceSet:
 
     def _reconstruct(self) -> None:
         fault_edges_best: list[tuple[int, str, str]] = []
+        byz_edges: list[tuple[int, str, str, str]] = []  # (w, node, kind, label)
         for node, records in self.journals.items():
             producer_seen: dict[str, int] = {}  # digest -> monotonic ns
             fault_edges: list[tuple[int, str, str]] = []  # (w_corr, kind, label)
             for r in records:
                 e = r["e"]
+                if e.startswith("byz."):
+                    # adversary-plane records must never reach _block
+                    # (their "d" may be None)
+                    w = self._corr(node, r["w"])
+                    kind = e[len("byz."):]
+                    if kind in ("open", "close"):
+                        byz_edges.append((w, node, kind, r.get("p", "")))
+                    else:
+                        self.byz_events.append(
+                            (w, node, kind, int(r.get("r", 0)))
+                        )
+                    continue
                 if e in ("tc", "round.enter", "recv.timeout", "recv.tc",
                          "sync.req", "sync.reply", "sync.done",
                          "recv.sync_req", "sync.expire"):
@@ -282,6 +301,19 @@ class TraceSet:
         for label, w in open_at.items():  # never-closed windows
             self.fault_spans.append((label, w, None))
         self.fault_spans.sort(key=lambda s: s[1])
+        # adversary windows pair per (node, label) — each Byzantine node
+        # journals only its own schedule
+        byz_open: dict[tuple[str, str], int] = {}
+        for w, node, kind, label in sorted(byz_edges):
+            key = (node, label)
+            if kind == "open":
+                byz_open.setdefault(key, w)
+            elif key in byz_open:
+                self.byz_spans.append((node, label, byz_open.pop(key), w))
+        for (node, label), w in byz_open.items():
+            self.byz_spans.append((node, label, w, None))
+        self.byz_spans.sort(key=lambda s: s[2])
+        self.byz_events.sort()
 
     # ---- derived views -----------------------------------------------------
 
@@ -422,6 +454,22 @@ class TraceSet:
                 f" Fault windows journaled: {len(self.fault_spans)}"
                 f" ({shown})\n"
             )
+        if self.byz_spans or self.byz_events:
+            kinds = Counter(kind for _w, _n, kind, _r in self.byz_events)
+            attackers = sorted(
+                {s[0] for s in self.byz_spans}
+                | {e[1] for e in self.byz_events}
+            )
+            shown = ", ".join(
+                f"{kind} x{c}" if c > 1 else kind
+                for kind, c in sorted(kinds.items())
+            )
+            lines.append(
+                f" Adversary plane journaled: {len(self.byz_spans)}"
+                f" window(s) on {', '.join(attackers)}"
+                + (f"; attacks: {shown}" if shown else "")
+                + "\n"
+            )
         if self.verify_spans:
             total: Counter = Counter()
             count = 0
@@ -468,6 +516,9 @@ class TraceSet:
         anchors.extend(w for _, w in self.timeouts.values())
         anchors.extend(w for _, w, _ in self.fault_spans)
         anchors.extend(w for _, _, w in self.fault_spans if w is not None)
+        anchors.extend(w for _, _, w, _ in self.byz_spans)
+        anchors.extend(w for _, _, _, w in self.byz_spans if w is not None)
+        anchors.extend(w for w, _, _, _ in self.byz_events)
         for rows in self.verify_spans.values():
             # a span's start = its end stamp minus its duration
             anchors.extend(w - dur for _, w, dur in rows)
@@ -589,6 +640,66 @@ class TraceSet:
                         "ts": us(w_open),
                         "dur": max(1.0, us(end) - us(w_open)),
                         "args": {"label": label, "closed": w_close is not None},
+                    }
+                )
+        if self.byz_spans or self.byz_events:
+            # dedicated adversary track (one pid past the chaos plane):
+            # policy windows as duration slices, one thread lane per
+            # attacking node, individual attacks as instant markers
+            byz_pid = len(self.nodes) + 1
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": byz_pid,
+                    "tid": 0,
+                    "args": {"name": "adversary plane"},
+                }
+            )
+            attackers = sorted(
+                {n for n, _l, _o, _c in self.byz_spans}
+                | {n for _w, n, _k, _r in self.byz_events}
+            )
+            tid_of = {n: i for i, n in enumerate(attackers)}
+            for n, tid in tid_of.items():
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": byz_pid,
+                        "tid": tid,
+                        "args": {"name": f"adversary {n}"},
+                    }
+                )
+            for node, label, w_open, w_close in self.byz_spans:
+                end = w_close if w_close is not None else horizon
+                events.append(
+                    {
+                        "name": label,
+                        "cat": "byz",
+                        "ph": "X",
+                        "pid": byz_pid,
+                        "tid": tid_of[node],
+                        "ts": us(w_open),
+                        "dur": max(1.0, us(end) - us(w_open)),
+                        "args": {
+                            "label": label,
+                            "node": node,
+                            "closed": w_close is not None,
+                        },
+                    }
+                )
+            for w, node, kind, rnd in self.byz_events:
+                events.append(
+                    {
+                        "name": f"byz {kind}" + (f" r{rnd}" if rnd else ""),
+                        "cat": "byz",
+                        "ph": "i",
+                        "s": "t",
+                        "pid": byz_pid,
+                        "tid": tid_of[node],
+                        "ts": us(w),
+                        "args": {"kind": kind, "round": rnd, "node": node},
                     }
                 )
         for node, rows in sorted(self.verify_spans.items()):

@@ -57,6 +57,9 @@ class QCMaker:
         self.votes: list[tuple[PublicKey, Signature]] = []
         self.used: set[PublicKey] = set()
         self.suspect: set[PublicKey] = set()  # authors with an evicted sig
+        # owning Aggregator (set at cell admission) — rejected-signature
+        # accounting rolls up there so it survives round cleanup
+        self.owner: "Aggregator | None" = None
         # True once the cell holds at least one signature that passed
         # verification.  Cells that never earn this are evictable when the
         # per-round digest-cell budget fills up (ADVICE r1: otherwise 8
@@ -102,6 +105,8 @@ class QCMaker:
             # this author's slot was already poisoned once — pay one eager
             # verify instead of trusting the deferred batch again
             if not verifier.verify_one(vote.digest(), author, vote.signature):
+                if self.owner is not None:
+                    self.owner.qc_rejects += 1
                 raise InvalidSignature(f"bad signature on vote {vote!r}")
             self.verified = True
         else:
@@ -174,6 +179,8 @@ class QCMaker:
         for (pk, _), valid in zip(self.votes, ok):
             if not valid:
                 log.warning("Evicting invalid vote signature naming %s", pk)
+                if self.owner is not None:
+                    self.owner.qc_rejects += 1
                 # release the author — the signature was never authenticated,
                 # so this may be a spoof and the real vote must still count —
                 # but demand eager verification from now on
@@ -255,6 +262,13 @@ class Aggregator:
         # them through Core's snapshot section when enabled).
         self.cells_evicted = 0
         self.votes_parked = 0
+        # Honest-side Byzantine defense counters: signatures rejected in
+        # certificate verification (vote evictions, suspect-path
+        # rejects, and invalid timeout certificates counted by the
+        # core) and equivocation evidence (a second paid digest cell
+        # from one author — conflicting validly-signed votes).
+        self.qc_rejects = 0
+        self.vote_conflicts = 0
 
     def add_vote(
         self,
@@ -349,11 +363,30 @@ class Aggregator:
                 # genuine though — votes may legitimately join an
                 # EXISTING cell regardless of the author's history — so
                 # park it for replay in case its digest gets the
-                # protected cell later.
+                # protected cell later.  Two validly-signed conflicting
+                # votes from one author = equivocation evidence.
+                self.vote_conflicts += 1
                 self._park(vote)
                 raise AggregationBounds(
                     f"second digest cell paid by {vote.author} in round "
                     f"{vote.round} (vote parked)"
+                )
+            if any(
+                vote.author in m.used
+                for d, m in makers.items()
+                if d != digest
+            ):
+                # The payment signature verified AND another cell already
+                # counts this author for a different digest this round:
+                # equivocation evidence (a double-voter's second digest).
+                # Accounting only — the paid cell is still admitted, the
+                # protocol math is untouched.
+                self.vote_conflicts += 1
+                log.info(
+                    "second digest cell paid by %s in round %d "
+                    "(conflicting double-vote evidence)",
+                    vote.author,
+                    vote.round,
                 )
             verified = True
         if len(makers) >= MAX_DIGEST_CELLS and not self._evict_for(
@@ -373,6 +406,7 @@ class Aggregator:
             # charge the payer only once the cell actually exists
             self.cell_payers.setdefault(vote.round, set()).add(vote.author)
         maker = makers[digest] = QCMaker()
+        maker.owner = self
         maker.verified = verified or own
         maker.protected = own
         return maker
@@ -454,4 +488,6 @@ class Aggregator:
             "parked_votes": sum(len(p) for p in self.parked.values()),
             "votes_parked_total": self.votes_parked,
             "cells_evicted_total": self.cells_evicted,
+            "qc_rejects_total": self.qc_rejects,
+            "vote_conflicts_total": self.vote_conflicts,
         }

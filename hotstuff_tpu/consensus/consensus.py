@@ -342,6 +342,22 @@ class Consensus:
             fault_plane = FaultPlane.load(faults_spec, address)
             log.info("Fault plane active: %s", fault_plane.describe())
 
+        # Byzantine adversary plane (HOTSTUFF_ADVERSARY, faults/
+        # adversary.py): protocol-level attack injection at the
+        # proposer/core seams.  The spec is shared committee-wide (the
+        # chaos runner points it at the same file as HOTSTUFF_FAULTS);
+        # the plane stays inert unless it names this node.
+        adversary = None
+        adversary_spec = os.environ.get("HOTSTUFF_ADVERSARY")
+        if adversary_spec:
+            from ..faults import AdversaryPlane
+
+            plane = AdversaryPlane.load(adversary_spec, address)
+            if plane.enabled:
+                adversary = plane
+                adversary.bind(committee, name)
+                log.info("Adversary plane active: %s", adversary.describe())
+
         if transport == "native":
             from ..network.native import (
                 NativeReceiver,
@@ -430,6 +446,41 @@ class Consensus:
                     )
                 telemetry.add_section("fault_plane", fault_plane.stats)
 
+        if adversary is not None:
+            from ..faults import run_adversary_clock, run_flood
+
+            journal = telemetry.journal if telemetry is not None else None
+            adversary.journal = journal
+            loop = asyncio.get_running_loop()
+            self._tasks.append(
+                loop.create_task(
+                    run_adversary_clock(adversary, journal),
+                    name="adversary-clock",
+                )
+            )
+            if any(r.policy == "flood" for r in adversary.my_rules):
+                self._tasks.append(
+                    loop.create_task(
+                        run_flood(adversary, committee, name),
+                        name="adversary-flood",
+                    )
+                )
+            if telemetry is not None:
+                for count_name, help_text in (
+                    ("byz_equivocations", "Conflicting blocks signed"),
+                    ("byz_forged_qcs", "Forged QCs shipped"),
+                    ("byz_votes_withheld", "Votes withheld"),
+                    ("byz_double_votes", "Conflicting votes cast"),
+                    ("byz_floods", "Garbage bursts sent"),
+                    ("byz_shadow_commits", "Shadow-branch commits logged"),
+                ):
+                    telemetry.gauge(
+                        count_name,
+                        help_text,
+                        fn=lambda p=adversary, k=count_name: p.counts[k],
+                    )
+                telemetry.add_section("adversary", adversary.stats)
+
         leader_elector = LeaderElector(committee)
         self.synchronizer = Synchronizer(
             name,
@@ -481,6 +532,7 @@ class Consensus:
             network=make_sender(),
             payload_bodies=payload_bodies,
             telemetry=telemetry,
+            adversary=adversary,
         )
         self._tasks.append(self.core.spawn())
 
@@ -493,6 +545,7 @@ class Consensus:
             tx_loopback=tx_loopback,
             network=make_reliable(),
             telemetry=telemetry,
+            adversary=adversary,
         )
         self._tasks.append(self.proposer.spawn())
 

@@ -281,6 +281,7 @@ class Core:
         timeout_cap_ms: int = 60_000,
         payload_bodies=None,
         telemetry=None,
+        adversary=None,
     ):
         self.name = name
         self.committee = committee
@@ -325,6 +326,10 @@ class Core:
         # (measured: a WAN f=3 committee wedged to zero commits because
         # boot-time idle rounds pushed the timer to 16 s+).
         self._saw_proposal = False
+        # Byzantine adversary plane (faults/adversary.py): None on
+        # honest nodes; on attacking nodes the vote/timeout/commit
+        # seams below consult it for the active policy windows.
+        self.adversary = adversary
         self.aggregator = Aggregator(committee, verifier, self_key=name)
         # Async claim preverifier (crypto/async_service.py): device
         # backends get a coalescing off-loop dispatch service (shared
@@ -480,7 +485,25 @@ class Core:
             # head and debug-logs the rest (core.rs:204-209): head-only
             # logging hides the other blocks' payloads from the harness
             # and undercounts TPS after every view change.
-            self.log.info("Committed block %d -> %s", b.round, b.digest())
+            reported = b.digest()
+            adversary = self.adversary
+            if (
+                adversary is not None
+                and adversary.is_shadow_committer
+                and adversary.active("collude")
+                and b.author in adversary.colluder_names
+            ):
+                # collude policy: the designated shadow committer
+                # reports the shadow branch for colluder-led rounds —
+                # a REAL divergent history the safety checker must
+                # catch and attribute to the colluding authorities
+                reported = adversary.shadow_block(b).digest()
+                adversary.count("byz_shadow_commits")
+                adversary.record("shadow-commit", b.round, reported)
+                self.log.info(
+                    "byz shadow-commit round %d -> %s", b.round, reported
+                )
+            self.log.info("Committed block %d -> %s", b.round, reported)
         # Tell the proposer what committed: (a) it prunes those digests
         # from its buffer — with single-homed clients (node/client.py)
         # queues are disjoint so this is defense-in-depth against
@@ -620,12 +643,24 @@ class Core:
         # TCMaker can then emit TCs from pre-verified entries.
         # ``sig_verified``: the burst drain already aggregate-verified
         # this timeout's author signature (_preverify_timeout_burst).
-        timeout.verify(
-            self.committee,
-            self.verifier,
-            qc_cache=self._qc_cache(),
-            sig_verified=sig_verified,
-        )
+        try:
+            timeout.verify(
+                self.committee,
+                self.verifier,
+                qc_cache=self._qc_cache(),
+                sig_verified=sig_verified,
+            )
+        except ConsensusError:
+            # honest defense seam: a timeout whose author signature or
+            # embedded certificate fails verification (forged QCs from
+            # the adversary plane land here after the burst preverifier
+            # refuses their claims)
+            self.aggregator.qc_rejects += 1
+            self.log.info(
+                "qc reject: invalid certificate in timeout from %s "
+                "round %d", str(timeout.author)[:8], timeout.round,
+            )
+            raise
         self._process_qc(timeout.high_qc)
 
         tc = self.aggregator.add_timeout(timeout, self.round)
@@ -736,6 +771,19 @@ class Core:
         if block.round != self.round:
             return
 
+        adversary = self.adversary
+        if adversary is not None and adversary.active("withhold"):
+            # withhold policy: receive, never vote — the committee must
+            # reach quorum without us (timeouts), and recover liveness
+            # once the window closes
+            adversary.count("byz_votes_withheld")
+            adversary.record("withhold", block.round, block.digest())
+            self.log.info(
+                "byz withhold vote round %d -> %s",
+                block.round, block.digest(),
+            )
+            return
+
         vote = await self._make_vote(block)
         if vote is not None:
             self.log.debug("Created %r", vote)
@@ -755,6 +803,64 @@ class Core:
             else:
                 address = self.committee.address(next_leader)
                 await self.network.send(address, encode_vote(vote))
+            if adversary is not None and adversary.active("double-vote"):
+                await self._byz_double_vote(block, next_leader)
+        if adversary is not None and adversary.active("forge-qc"):
+            await self._byz_forge_qc()
+
+    # ---- adversary seams (faults/adversary.py) -----------------------------
+
+    async def _byz_double_vote(self, block: Block, next_leader) -> None:
+        """double-vote policy: also sign a vote for the deterministic
+        shadow twin of ``block`` and ship it to the same next leader —
+        a well-formed conflicting vote the honest aggregator must park
+        (second digest cell for one payer)."""
+        adversary = self.adversary
+        shadow = adversary.shadow_block(block)
+        vote = Vote(hash=shadow.digest(), round=block.round, author=self.name)
+        vote.signature = await self.signature_service.request_signature(
+            vote.digest()
+        )
+        adversary.count("byz_double_votes")
+        adversary.record(
+            "double-vote", block.round, shadow.digest(), str(next_leader)[:8]
+        )
+        self.log.info(
+            "byz double-vote round %d -> %s", block.round, shadow.digest()
+        )
+        if next_leader == self.name:
+            try:
+                await self._handle_vote(vote, sig_verified=True)
+            except ConsensusError as e:
+                self.log.debug("own conflicting vote rejected: %s", e)
+        else:
+            address = self.committee.address(next_leader)
+            await self.network.send(address, encode_vote(vote))
+
+    async def _byz_forge_qc(self) -> None:
+        """forge-qc policy: broadcast a properly-signed timeout whose
+        high_qc names real committee authors with quorum-many garbage
+        signatures — it passes every structural check (stake, quorum,
+        no reuse) and MUST die in honest signature verification.  One
+        seeded draw gates each opportunity so the attack volume is
+        replayable."""
+        adversary = self.adversary
+        if adversary.rng.random() >= 0.5:
+            return
+        qc = adversary.forged_qc(self.committee, max(self.round - 1, 1))
+        timeout = Timeout(high_qc=qc, round=self.round, author=self.name)
+        timeout.signature = await self.signature_service.request_signature(
+            timeout.digest()
+        )
+        adversary.count("byz_forged_qcs")
+        adversary.record("forge-qc", self.round, qc.hash)
+        self.log.info(
+            "byz forge-qc round %d (authors %d)", self.round, len(qc.votes)
+        )
+        addresses = [
+            addr for _, addr in self.committee.broadcast_addresses(self.name)
+        ]
+        await self.network.broadcast(addresses, encode_timeout(timeout))
 
     async def _handle_proposal(
         self, block: Block, sigs_verified: bool = False

@@ -70,8 +70,12 @@ class Proposer:
         tx_loopback: asyncio.Queue,
         network: ReliableSender | None = None,
         telemetry=None,
+        adversary=None,
     ):
         self.name = name
+        # Byzantine adversary plane (faults/adversary.py): None on
+        # honest nodes; the equivocation seam in _make_block consults it
+        self.adversary = adversary
         self.committee = committee
         self.signature_service = signature_service
         self.rx_producer = rx_producer
@@ -224,6 +228,9 @@ class Proposer:
 
         await self.tx_loopback.put(block)
 
+        if self.adversary is not None and self.adversary.active("equivocate"):
+            await self._byz_equivocate(block, names_addresses)
+
         # Control system: wait for 2f+1 total stake (ours included) to ACK
         # the block before making the next one.
         total_stake = com.stake(self.name)
@@ -244,6 +251,32 @@ class Proposer:
         finally:
             for t in pending:
                 t.cancel()
+
+    async def _byz_equivocate(self, block: Block, names_addresses) -> None:
+        """equivocate policy (adversary plane): sign the deterministic
+        shadow twin of the block just proposed — same round, same QC,
+        conflicting payloads — and ship it to a deterministic peer
+        subset (fellow colluders when colluding, else the first half of
+        the peer set).  Honest receivers vote at most once per round,
+        so the main branch keeps committing; the checker attributes the
+        equivocations to this authority."""
+        adversary = self.adversary
+        shadow = adversary.shadow_block(block)
+        shadow.signature = await self.signature_service.request_signature(
+            shadow.digest()
+        )
+        targets = adversary.equivocation_targets(names_addresses)
+        message = encode_propose(shadow)
+        for _, address in targets:
+            await self.network.send(address, message)
+        adversary.count("byz_equivocations")
+        adversary.record(
+            "equivocate", block.round, shadow.digest(), f"{len(targets)}p"
+        )
+        self.log.info(
+            "byz equivocate round %d -> %s | %s (%d peers)",
+            block.round, block.digest(), shadow.digest(), len(targets),
+        )
 
     def _requeue_orphans(
         self, round_: Round, payloads: tuple, committed=frozenset(), note: str = ""

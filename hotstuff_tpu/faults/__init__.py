@@ -5,6 +5,14 @@ the safety/liveness invariant definitions checked by
 ``python -m benchmark chaos``.
 """
 
+from .adversary import (
+    POLICIES,
+    AdversaryPlane,
+    AdversaryRule,
+    expand_adversary,
+    run_adversary_clock,
+    run_flood,
+)
 from .plane import (
     BARRIER_POLL_S,
     Decision,
@@ -19,16 +27,22 @@ from .plane import (
 from .scenarios import SCENARIOS, build, last_heal
 
 __all__ = [
+    "AdversaryPlane",
+    "AdversaryRule",
     "BARRIER_POLL_S",
     "Decision",
     "FaultPlane",
     "FaultRule",
     "LinkFaults",
     "PASS",
+    "POLICIES",
     "SCENARIOS",
     "build",
     "corrupt_frame",
+    "expand_adversary",
     "expand_rules",
     "last_heal",
+    "run_adversary_clock",
     "run_clock",
+    "run_flood",
 ]

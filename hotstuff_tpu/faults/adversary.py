@@ -1,0 +1,435 @@
+"""AdversaryPlane: seeded, deterministic Byzantine behavior injection.
+
+Where :class:`~hotstuff_tpu.faults.plane.FaultPlane` attacks the
+*network* (omission faults: drop/delay/duplicate/corrupt), the
+adversary plane attacks the *protocol*: a node selected by the spec
+runs one or more attack policies on the same seeded scenario schedule.
+Attacks are injected at the proposer/core/aggregator seams — NOT the
+wire layer — so every adversarial message is a well-formed frame that
+exercises the committee's real verification paths.
+
+Policies
+  equivocate   as leader, sign and ship a second conflicting block for
+               the same round to a subset of peers
+  forge-qc     broadcast properly-signed timeouts whose high_qc names
+               real committee authors but carries garbage aggregate
+               signatures (hits ``_preverify_burst`` / QC verification
+               on honest nodes, which must reject)
+  withhold     receive proposals but never vote, forcing the committee
+               through timeout quorums (liveness pressure; must heal)
+  double-vote  vote for the leader's block AND a fabricated conflicting
+               digest in the same round (hits the aggregator's
+               second-cell parking on the honest next leader)
+  flood        sustained bursts of garbage votes / spoofed votes /
+               garbage timeouts (the reusable form of the ad-hoc burst
+               loop from tests/test_byzantine_e2e.py)
+  collude      f+1 coordinated equivocators: colluders equivocate when
+               leading, double-vote the shadow branch, and the
+               designated shadow committer reports the shadow chain in
+               its commit log — producing a REAL divergent history the
+               safety checker must catch and attribute
+
+Determinism contract (same bar as the fault plane): every random
+choice is drawn from a per-node ``random.Random`` seeded from
+``(scenario seed, node index)`` — str seeding hashes through SHA-512,
+so the stream is identical across processes and runs regardless of
+PYTHONHASHSEED.  Each decision consumes a FIXED number of draws;
+wall-clock gates only which policy windows are active, never the draw
+stream.  Shadow payloads are a pure function of (seed, round) so
+colluders agree on the shadow branch without communicating.
+
+Spec: the adversary rides in the same JSON spec as the fault plane
+(``HOTSTUFF_ADVERSARY`` accepts an inline object or a file path, and
+the chaos runner points it at the same ``.faults.json``)::
+
+    {"seed": 0, "name": "byz-equivocate",
+     "nodes": {"host:port": index, ...}, "epoch_unix": ...,
+     "adversary": [
+        {"policy": "equivocate", "node": 0, "at": 2.0, "until": null}
+     ]}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import time
+
+from .plane import _addr_key
+
+log = logging.getLogger(__name__)
+
+POLICIES = (
+    "equivocate",
+    "forge-qc",
+    "withhold",
+    "double-vote",
+    "flood",
+    "collude",
+)
+
+#: flood policy burst cadence (seconds between bursts)
+FLOOD_BURST_S = 0.025
+
+
+class AdversaryRule:
+    """One policy window over a set of adversarial node indexes."""
+
+    __slots__ = ("policy", "nodes", "at", "until", "rate", "label")
+
+    def __init__(self, policy: str, nodes, at: float = 0.0,
+                 until: float | None = None, rate: float = 1.0,
+                 label: str | None = None):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown adversary policy {policy!r}; "
+                f"choose from {sorted(POLICIES)}"
+            )
+        self.policy = policy
+        if isinstance(nodes, int):
+            nodes = (nodes,)
+        self.nodes = frozenset(int(v) for v in nodes)
+        self.at = float(at)
+        self.until = None if until is None else float(until)
+        self.rate = float(rate)
+        self.label = label or policy
+
+    def active(self, t: float) -> bool:
+        if t < self.at:
+            return False
+        return self.until is None or t < self.until
+
+
+def expand_adversary(spec: dict) -> list[AdversaryRule]:
+    """Parse the spec's ``adversary`` list into rules."""
+    rules = []
+    for raw in spec.get("adversary", ()):
+        rules.append(
+            AdversaryRule(
+                raw["policy"],
+                raw.get("node", raw.get("nodes", ())),
+                at=raw.get("at", 0.0),
+                until=raw.get("until"),
+                rate=raw.get("rate", 1.0),
+                label=raw.get("label"),
+            )
+        )
+    return rules
+
+
+class AdversaryPlane:
+    """One node's view of the Byzantine scenario.
+
+    Constructed on every node (the spec is shared); inert — every
+    ``active()`` query returns False — unless the spec names this
+    node's index in at least one policy rule.  The consensus stack
+    consults it at the attack seams; the plane owns the RNG, counters,
+    journal edges, and the deterministic shadow-branch math.
+    """
+
+    def __init__(self, spec: dict, self_address, now: float | None = None):
+        self.spec = spec
+        self.seed = int(spec.get("seed", 0))
+        self.name = spec.get("name", "custom")
+        self.nodes: dict[str, int] = {
+            k: int(v) for k, v in spec.get("nodes", {}).items()
+        }
+        self.self_id = self.nodes.get(_addr_key(self_address))
+        self.rules = expand_adversary(spec)
+        self.my_rules = [
+            r for r in self.rules
+            if self.self_id is not None and self.self_id in r.nodes
+        ]
+        boot = time.time() if now is None else now
+        epoch = spec.get("epoch_unix")
+        self.epoch = float(epoch) if epoch is not None else boot
+        if self.epoch < boot - 3600.0:
+            log.warning(
+                "adversary spec epoch is stale (%.0fs old); using boot time",
+                boot - self.epoch,
+            )
+            self.epoch = boot
+        self.rng = random.Random(f"{self.seed}|adversary|{self.self_id}")
+        self.counts = {
+            "byz_equivocations": 0,
+            "byz_forged_qcs": 0,
+            "byz_votes_withheld": 0,
+            "byz_double_votes": 0,
+            "byz_floods": 0,
+            "byz_shadow_commits": 0,
+        }
+        #: colluding node indexes, sorted (collude rules only)
+        self.colluders = sorted(
+            frozenset().union(
+                *(r.nodes for r in self.rules if r.policy == "collude")
+            ) if any(r.policy == "collude" for r in self.rules)
+            else frozenset()
+        )
+        #: authority names of colluders, resolved by bind()
+        self.colluder_names: set = set()
+        self.names_by_index: dict[int, object] = {}
+        self.journal = None  # set by Consensus.spawn when journaling
+
+    @classmethod
+    def load(cls, spec_or_path: str, self_address, now: float | None = None):
+        """Build a plane from an inline JSON object or a spec file path
+        (the ``HOTSTUFF_ADVERSARY`` knob accepts both)."""
+        text = spec_or_path.strip()
+        if text.startswith("{"):
+            spec = json.loads(text)
+        else:
+            with open(spec_or_path) as f:
+                spec = json.load(f)
+        return cls(spec, self_address, now=now)
+
+    # ------------------------------------------------------------------
+    # selection / scheduling
+
+    @property
+    def enabled(self) -> bool:
+        """True when the spec names this node in any policy rule."""
+        return bool(self.my_rules)
+
+    def _t(self, now: float | None = None) -> float:
+        return (time.time() if now is None else now) - self.epoch
+
+    def active(self, policy: str, now: float | None = None) -> bool:
+        """Is ``policy`` live on THIS node at ``now``?  The collude
+        policy implies equivocate and double-vote (colluders run the
+        full attack suite while the window is open)."""
+        if not self.my_rules:
+            return False
+        t = self._t(now)
+        for r in self.my_rules:
+            if not r.active(t):
+                continue
+            if r.policy == policy:
+                return True
+            if r.policy == "collude" and policy in (
+                "equivocate", "double-vote",
+            ):
+                return True
+        return False
+
+    def bind(self, committee, self_name) -> None:
+        """Resolve node indexes to authority names against the live
+        committee (the spec only knows addresses)."""
+        pairs = list(committee.broadcast_addresses(self_name))
+        pairs.append((self_name, committee.address(self_name)))
+        for nm, addr in pairs:
+            if addr is None:
+                continue
+            idx = self.nodes.get(_addr_key(addr))
+            if idx is not None:
+                self.names_by_index[idx] = nm
+        self.colluder_names = {
+            self.names_by_index[i]
+            for i in self.colluders
+            if i in self.names_by_index
+        }
+
+    @property
+    def is_shadow_committer(self) -> bool:
+        """The highest-indexed colluder reports the shadow chain in its
+        commit log (one divergent history is enough for the checker;
+        deterministic designation needs no coordination)."""
+        return bool(self.colluders) and self.self_id == self.colluders[-1]
+
+    # ------------------------------------------------------------------
+    # attack math (shared by the attacking seams)
+
+    def shadow_payloads(self, round_: int) -> tuple:
+        """The shadow branch's payload for ``round_`` — a pure function
+        of (seed, round) so every colluder derives the same conflicting
+        block without communicating."""
+        from ..crypto import Digest
+
+        return (Digest.of(f"byz-shadow|{self.seed}|{round_}".encode()),)
+
+    def shadow_block(self, block):
+        """The conflicting twin of ``block``: same author/round/qc/tc,
+        shadow payloads.  Unsigned — the equivocator signs its own copy;
+        observers only need the digest (signatures are not part of it)."""
+        from ..consensus.messages import Block
+
+        return Block(
+            qc=block.qc,
+            tc=block.tc,
+            author=block.author,
+            round=block.round,
+            payloads=self.shadow_payloads(block.round),
+        )
+
+    def equivocation_targets(self, names_addresses):
+        """The deterministic peer subset that receives the shadow block:
+        fellow colluders when colluding (the honest committee keeps
+        committing the main branch), otherwise the lexicographically
+        first half of the peer set."""
+        pairs = sorted(names_addresses, key=lambda p: str(p[0]))
+        if self.colluder_names:
+            return [p for p in pairs if p[0] in self.colluder_names]
+        return pairs[: max(1, len(pairs) // 2)]
+
+    def forged_qc(self, committee, round_: int):
+        """A structurally valid QC — real committee authors, quorum-many
+        entries, passes ``check_weight`` — whose signatures are seeded
+        garbage, so honest verification MUST reject it.  Consumes 64
+        draws per signature (fixed per call for a given committee)."""
+        from ..consensus.messages import QC
+        from ..crypto import Digest, Signature
+
+        authors = sorted(
+            (nm for nm, _ in committee.broadcast_addresses(None)),
+            key=str,
+        )
+        need = committee.quorum_threshold()
+        votes = [
+            (nm, Signature(bytes(self.rng.getrandbits(8) for _ in range(64))))
+            for nm in authors[:need]
+        ]
+        return QC(
+            hash=Digest.of(f"byz-forged|{self.seed}|{round_}".encode()),
+            round=round_,
+            votes=votes,
+        )
+
+    # ------------------------------------------------------------------
+    # accounting
+
+    def count(self, key: str, n: int = 1) -> None:
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    def record(self, event: str, round_: int = 0, digest=None,
+               peer: str = "") -> None:
+        """Journal a ``byz.*`` edge (rendered as the adversary track by
+        ``benchmark traces``)."""
+        if self.journal is not None:
+            self.journal.record(f"byz.{event}", round_, digest, peer)
+
+    def describe(self) -> str:
+        mine = ",".join(sorted({r.policy for r in self.my_rules})) or "none"
+        return (
+            f"scenario {self.name!r} seed {self.seed} "
+            f"(node index {self.self_id}, policies [{mine}])"
+        )
+
+    def window_edges(self) -> list[tuple[float, str, str]]:
+        """THIS node's policy window edges as (t_rel, "open"|"close",
+        policy label), sorted — the adversary clock task walks this."""
+        edges: set[tuple[float, str, str]] = set()
+        for rule in self.my_rules:
+            edges.add((rule.at, "open", rule.label))
+            if rule.until is not None:
+                edges.add((rule.until, "close", rule.label))
+        order = {"close": 0, "open": 1}
+        return sorted(edges, key=lambda e: (e[0], order[e[1]], e[2]))
+
+    def stats(self) -> dict:
+        """Telemetry snapshot section."""
+        return {
+            "scenario": self.name,
+            "seed": self.seed,
+            "node": self.self_id,
+            "policies": sorted({r.policy for r in self.my_rules}),
+            **self.counts,
+        }
+
+
+async def run_adversary_clock(plane: AdversaryPlane, journal=None) -> None:
+    """Walk the adversary's policy window edges in real time, logging
+    each and journaling ``byz.open`` / ``byz.close`` records so traces
+    render an adversary track.  Spawned by Consensus.spawn on attacking
+    nodes; cancelled at shutdown."""
+    for t_rel, kind, label in plane.window_edges():
+        delay = (plane.epoch + t_rel) - time.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        log.info("Adversary window %s: %s (t=%.1fs)", kind, label, t_rel)
+        if journal is not None:
+            journal.record(f"byz.{kind}", 0, None, label)
+
+
+async def run_flood(plane: AdversaryPlane, committee, name,
+                    signature_service=None) -> None:
+    """The flood policy: sustained bursts of garbage votes, spoofed
+    votes naming honest authorities, and garbage timeouts — every frame
+    well-formed at the wire layer, every signature invalid, so honest
+    nodes burn real verification work rejecting them.  The reusable
+    form of tests/test_byzantine_e2e.py's ad-hoc burst loop."""
+    from ..consensus.messages import QC, Timeout, Vote
+    from ..consensus.wire import encode_timeout, encode_vote
+    from ..crypto import Digest, Signature
+    from ..network import SimpleSender
+
+    sender = SimpleSender()
+    peers = [
+        (nm, addr) for nm, addr in committee.broadcast_addresses(name)
+    ]
+    honest = [nm for nm, _ in peers]
+    rng = plane.rng
+    try:
+        while True:
+            await asyncio.sleep(FLOOD_BURST_S)
+            if not plane.active("flood"):
+                continue
+            rnd = rng.randrange(1, 1 << 20)
+            frames = []
+            # (a) garbage votes under our own identity
+            for _ in range(3):
+                frames.append(encode_vote(Vote(
+                    hash=Digest.of(bytes(
+                        rng.getrandbits(8) for _ in range(16))),
+                    round=rnd,
+                    author=name,
+                    signature=Signature(bytes(
+                        rng.getrandbits(8) for _ in range(64))),
+                )))
+            # (b) spoofed votes naming honest authorities
+            for victim in honest[:2]:
+                frames.append(encode_vote(Vote(
+                    hash=Digest.of(f"byz-spoof|{rnd}".encode()),
+                    round=rnd,
+                    author=victim,
+                    signature=Signature(bytes(
+                        rng.getrandbits(8) for _ in range(64))),
+                )))
+            # (c) a garbage timeout anchored at the genesis QC
+            frames.append(encode_timeout(Timeout(
+                high_qc=QC.genesis(),
+                round=rnd,
+                author=name,
+                signature=Signature(bytes(
+                    rng.getrandbits(8) for _ in range(64))),
+            )))
+            for _, addr in peers:
+                for frame in frames:
+                    await sender.send(addr, frame)
+            plane.count("byz_floods")
+            plane.record("flood", rnd, None, f"{len(frames)}x{len(peers)}")
+            log.info(
+                "byz flood burst: %d frames to %d peers (round %d)",
+                len(frames), len(peers), rnd,
+            )
+    except asyncio.CancelledError:
+        raise
+    finally:
+        close = getattr(sender, "close", None)
+        if close is not None:
+            try:
+                res = close()
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+
+
+__all__ = [
+    "POLICIES",
+    "AdversaryPlane",
+    "AdversaryRule",
+    "expand_adversary",
+    "run_adversary_clock",
+    "run_flood",
+]

@@ -86,11 +86,84 @@ def rolling_crash_restart(nodes: int = 4, seed: int = 0) -> dict:
     }
 
 
+def byz_equivocate(nodes: int = 4, seed: int = 0, at: float = 2.0) -> dict:
+    """Node 0 signs a second conflicting block whenever it leads.
+    Honest safety rules hold (each node votes once per round), so the
+    committee keeps committing the main branch: safety must PASS with
+    the equivocations attributed to node 0's authority."""
+    return {
+        "name": "byz-equivocate",
+        "seed": seed,
+        "rules": [],
+        "adversary": [
+            {"policy": "equivocate", "node": 0, "at": at, "until": None}
+        ],
+        "liveness": {"resume_within_s": 20.0, "max_round_gap": 200},
+    }
+
+
+def byz_forge_qc(nodes: int = 4, seed: int = 0, at: float = 2.0) -> dict:
+    """Node 0 broadcasts properly-signed timeouts carrying forged QCs
+    (real committee authors, garbage aggregate signatures).  Honest
+    verification must reject every one; commits continue."""
+    return {
+        "name": "byz-forge-qc",
+        "seed": seed,
+        "rules": [],
+        "adversary": [
+            {"policy": "forge-qc", "node": 0, "at": at, "until": None}
+        ],
+        "liveness": {"resume_within_s": 20.0, "max_round_gap": 200},
+    }
+
+
+def byz_withhold(nodes: int = 4, seed: int = 0, at: float = 4.0,
+                 until: float = 12.0) -> dict:
+    """Node 0 receives proposals but never votes while the window is
+    open, forcing rounds led by slow quorums/timeouts.  An impairing
+    window: liveness must recover after it closes."""
+    return {
+        "name": "byz-withhold",
+        "seed": seed,
+        "rules": [],
+        "adversary": [
+            {"policy": "withhold", "node": 0, "at": at, "until": until}
+        ],
+        "liveness": {"resume_within_s": 25.0, "max_round_gap": 200},
+    }
+
+
+def byz_collude(nodes: int = 4, seed: int = 0, at: float = 2.0) -> dict:
+    """f+1 colluders (nodes 0 and 1 in a 4-committee — one more than
+    the f=1 the quorum math tolerates): both equivocate when leading
+    and double-vote the shadow branch, and the designated shadow
+    committer reports the shadow chain in its commit log.  The result
+    is a REAL divergent history: the safety checker must FAIL with the
+    conflicting commits attributed to the colluding authorities.  The
+    ``trusted-subset`` quorum mode re-checks the same history under the
+    TEE-style f+1 regime, where excluding the untrusted colluders
+    restores consistency."""
+    return {
+        "name": "byz-collude",
+        "seed": seed,
+        "rules": [],
+        "adversary": [
+            {"policy": "collude", "nodes": [0, 1], "at": at, "until": None}
+        ],
+        "quorum_mode": "trusted-subset",
+        "liveness": {"resume_within_s": 25.0, "max_round_gap": 200},
+    }
+
+
 SCENARIOS = {
     "split-brain": split_brain,
     "leader-isolation": leader_isolation,
     "flapping-link": flapping_link,
     "rolling-crash-restart": rolling_crash_restart,
+    "byz-equivocate": byz_equivocate,
+    "byz-forge-qc": byz_forge_qc,
+    "byz-withhold": byz_withhold,
+    "byz-collude": byz_collude,
 }
 
 
@@ -124,8 +197,19 @@ def last_heal(spec: dict) -> float:
         if restart is None:
             return float("inf")
         t = max(t, float(restart))
+    for rule in spec.get("adversary", ()):
+        # only vote withholding impairs liveness; equivocation, forged
+        # QCs, double votes, and floods are rejected/absorbed while the
+        # committee keeps committing
+        if rule.get("policy") != "withhold":
+            continue
+        until = rule.get("until")
+        if until is None:
+            return float("inf")
+        t = max(t, float(until))
     return t
 
 
 __all__ = ["SCENARIOS", "build", "last_heal", "split_brain",
-           "leader_isolation", "flapping_link", "rolling_crash_restart"]
+           "leader_isolation", "flapping_link", "rolling_crash_restart",
+           "byz_equivocate", "byz_forge_qc", "byz_withhold", "byz_collude"]

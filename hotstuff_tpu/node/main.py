@@ -207,6 +207,20 @@ def _apply_fault_plane(args) -> None:
         os.environ["HOTSTUFF_FAULTS"] = spec
 
 
+def _apply_adversary(args) -> None:
+    """Activate the Byzantine adversary plane when ``--adversary`` was
+    given: the flag value (a spec file path or inline JSON naming the
+    attacking node indexes and policy windows) lands in
+    HOTSTUFF_ADVERSARY, which Consensus.spawn reads at boot.  Inert on
+    nodes the spec does not name, so the whole committee can share one
+    spec file."""
+    import os
+
+    spec = getattr(args, "adversary", None)
+    if spec:
+        os.environ["HOTSTUFF_ADVERSARY"] = spec
+
+
 async def _run_node(args) -> None:
     from .. import telemetry
 
@@ -214,6 +228,7 @@ async def _run_node(args) -> None:
     # and the nodes booted below only pick telemetry up at boot
     _apply_journal_dir(args)
     _apply_fault_plane(args)
+    _apply_adversary(args)
     _apply_profile(args)
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
@@ -270,6 +285,7 @@ async def _run_many(args) -> None:
 
     _apply_journal_dir(args)
     _apply_fault_plane(args)
+    _apply_adversary(args)
     _apply_profile(args)
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
@@ -466,6 +482,14 @@ def main(argv=None) -> int:
         "default: off, or the HOTSTUFF_FAULTS env knob)"
     )
     p_run.add_argument("--fault-plane", default=None, help=faults_help)
+    adversary_help = (
+        "activate the Byzantine adversary plane from this spec file (or "
+        "inline JSON): seeded deterministic protocol-level attacks — "
+        "equivocate, forge-qc, withhold, double-vote, flood, collude — "
+        "on the named node indexes (docs/FAULTS.md; default: off, or "
+        "the HOTSTUFF_ADVERSARY env knob)"
+    )
+    p_run.add_argument("--adversary", default=None, help=adversary_help)
     pipeline_help = (
         "verify dispatch pipeline depth: device waves in flight at once "
         "(default: 2, or the HOTSTUFF_VERIFY_PIPELINE env knob; 1 "
@@ -509,6 +533,7 @@ def main(argv=None) -> int:
     p_many.add_argument("--journal-dir", default=None, help=journal_help)
     p_many.add_argument("--profile", action="store_true", help=profile_help)
     p_many.add_argument("--fault-plane", default=None, help=faults_help)
+    p_many.add_argument("--adversary", default=None, help=adversary_help)
     p_many.add_argument(
         "--verify-pipeline",
         type=int,
@@ -532,6 +557,7 @@ def main(argv=None) -> int:
     p_dep.add_argument("--journal-dir", default=None, help=journal_help)
     p_dep.add_argument("--profile", action="store_true", help=profile_help)
     p_dep.add_argument("--fault-plane", default=None, help=faults_help)
+    p_dep.add_argument("--adversary", default=None, help=adversary_help)
     p_dep.add_argument(
         "--verify-pipeline",
         type=int,
@@ -560,6 +586,7 @@ def main(argv=None) -> int:
         return 0
     if args.command == "deploy":
         _apply_fault_plane(args)
+        _apply_adversary(args)
         _apply_profile(args)
         _apply_verify_pipeline(args)
         _apply_mesh_devices(args)

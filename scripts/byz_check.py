@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""Byzantine adversary matrix check (docs/FAULTS.md, Byzantine section).
+
+Runs the canned ``byz-*`` scenarios through the production chaos
+runner (``python -m benchmark chaos``) and asserts the contract each
+one exists to prove:
+
+- ``byz-equivocate`` — the attack is journaled/counted, the honest
+  committee keeps committing one history: run PASSes (exit 0) and the
+  ``+ BYZ`` block shows the attack contained.
+- ``byz-withhold``  — a withholding node costs rounds, never safety:
+  liveness recovers after the window closes and the run PASSes.
+- ``byz-collude``   — a shadow-committing colluding pair produces a
+  REAL divergent history: the run must FAIL (non-zero exit) with the
+  violation attributed to the colluders, while the trusted-subset
+  re-check still PASSes over the honest nodes.
+
+Exit non-zero when ANY scenario breaks its contract — including
+byz-collude "passing", which would mean the safety checker went blind.
+
+Usage:
+    python scripts/byz_check.py [--seed N] [--rate R] [--duration S]
+    BYZ=1 scripts/trace.sh                # same, via the trace wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_scenario(name: str, seed: int, rate: int, duration: int) -> tuple[int, str]:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "benchmark", "chaos",
+            "--scenario", name, "--seed", str(seed),
+            "--rate", str(rate), "--duration", str(duration),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=duration + 240,
+    )
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def check(label: str, ok: bool, detail: str = "") -> bool:
+    print(f"  [{'ok' if ok else 'FAIL'}] {label}" + (f" — {detail}" if detail and not ok else ""))
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=int, default=400)
+    ap.add_argument("--duration", type=int, default=30,
+                    help="per-run seconds (byz-withhold heals at t=12 "
+                    "and must resume within its bound, so keep >= 30)")
+    args = ap.parse_args(argv)
+
+    failed = False
+
+    print(f"=== byz-equivocate (seed {args.seed}) ===")
+    rc, out = run_scenario("byz-equivocate", args.seed, args.rate, args.duration)
+    failed |= not check("run PASSes (exit 0)", rc == 0, f"exit {rc}")
+    failed |= not check("+ BYZ block rendered", "+ BYZ:" in out)
+    failed |= not check(
+        "equivocation counted and attributed to the adversary",
+        bool(re.search(r"Adversary node-\d+ .*equivocate x\d+", out)),
+    )
+    failed |= not check(
+        "attack contained on full history",
+        "Attack contained (full-history safety): PASS" in out,
+    )
+
+    print(f"=== byz-withhold (seed {args.seed}) ===")
+    rc, out = run_scenario("byz-withhold", args.seed, args.rate, args.duration)
+    failed |= not check("run PASSes (exit 0)", rc == 0, f"exit {rc}")
+    failed |= not check(
+        "withholding journaled on the adversary",
+        bool(re.search(r"Adversary node-\d+ .*withhold x\d+", out)),
+    )
+    failed |= not check(
+        "liveness recovers after the withhold window closes",
+        bool(re.search(r"Liveness .*: PASS", out)),
+    )
+
+    print(f"=== byz-collude (seed {args.seed}) ===")
+    rc, out = run_scenario("byz-collude", args.seed, args.rate, args.duration)
+    failed |= not check("run FAILs (non-zero exit)", rc != 0, f"exit {rc}")
+    failed |= not check(
+        "divergent commits detected",
+        "conflicting commits" in out,
+    )
+    failed |= not check(
+        "violation attributed to the colluders",
+        "[adversary:" in out,
+    )
+    failed |= not check(
+        "full-history safety verdict is FAIL",
+        "Attack contained (full-history safety): FAIL" in out,
+    )
+    failed |= not check(
+        "trusted-subset quorum still agrees (honest nodes consistent)",
+        "Trusted-subset quorum (adversaries excluded): PASS" in out,
+    )
+
+    print("byz matrix:", "FAIL" if failed else "ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

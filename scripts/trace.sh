@@ -17,6 +17,11 @@
 #                                 # delta vs the committed reference,
 #                                 # non-zero exit if leaf-span coverage
 #                                 # drops below 95%
+#   BYZ=1 scripts/trace.sh        # ONLY the Byzantine adversary matrix
+#                                 # (scripts/byz_check.py): equivocation
+#                                 # caught-and-attributed, collusion
+#                                 # FAILs with non-zero exit, withholding
+#                                 # recovers liveness
 #   MESH=1 scripts/trace.sh       # ONLY the mesh scale-out check
 #                                 # (scripts/mesh_check.py): wave trains
 #                                 # at mesh 1 and 8 on the virtual
@@ -35,6 +40,11 @@ fi
 if [ "${MESH:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/mesh_check.py "$@"
+fi
+
+if [ "${BYZ:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/byz_check.py "$@"
 fi
 
 timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -1,0 +1,416 @@
+"""Byzantine adversary plane (hotstuff_tpu/faults/adversary.py).
+
+Unit tier: determinism from one seed, schedule gating, per-policy attack
+math (shadow branch, equivocation targets, forged certificates), and the
+checker layer (attribution + trusted-subset quorum re-check).
+
+E2E tier: a live in-process 4-committee with the adversary plane armed
+through the production ``HOTSTUFF_ADVERSARY`` knob — an equivocating
+leader cannot stop the honest committee committing consistently, a
+withholding node costs rounds but not safety, and a colluding pair
+produces a real divergent history the invariant checker FAILs and
+attributes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from benchmark.invariants import (
+    adversaries_from_spec,
+    attribute_violations,
+    byz_activity_from_logs,
+    check_run,
+    check_safety,
+    trusted_subset_recheck,
+)
+from hotstuff_tpu.faults.adversary import (
+    POLICIES,
+    AdversaryPlane,
+    AdversaryRule,
+    expand_adversary,
+)
+from hotstuff_tpu.faults.scenarios import SCENARIOS, build, last_heal
+
+from .common import async_test, committee, fresh_base_port, keys
+from .test_consensus_e2e import _feed_producers, _shutdown, _spawn_committee
+
+
+def _spec(policy="equivocate", nodes=0, at=0.0, until=None, seed=3,
+          base=9_900, n=4):
+    return {
+        "name": f"byz-{policy}",
+        "seed": seed,
+        "epoch_unix": time.time(),
+        "nodes": {f"127.0.0.1:{base + i}": i for i in range(n)},
+        "adversary": [
+            {"policy": policy, "node": nodes, "at": at, "until": until}
+        ],
+    }
+
+
+# ---- determinism ------------------------------------------------------------
+
+
+def test_same_seed_same_attack_stream():
+    """Two planes built from the same spec on the same slot draw
+    identical randomness: forged certificates, shadow payloads, and the
+    rng stream itself are replayable from (seed, node index) alone."""
+    spec = _spec("forge-qc")
+    com = committee(9_900)
+    a = AdversaryPlane(spec, ("127.0.0.1", 9_900))
+    b = AdversaryPlane(spec, ("127.0.0.1", 9_900))
+    for rnd in (1, 2, 17):
+        qa, qb = a.forged_qc(com, rnd), b.forged_qc(com, rnd)
+        assert qa.hash == qb.hash
+        assert [pk for pk, _ in qa.votes] == [pk for pk, _ in qb.votes]
+        assert [s.to_bytes() for _, s in qa.votes] == [
+            s.to_bytes() for _, s in qb.votes
+        ]
+        assert a.shadow_payloads(rnd) == b.shadow_payloads(rnd)
+    # a different slot (or seed) diverges
+    c = AdversaryPlane(_spec("forge-qc", nodes=1), ("127.0.0.1", 9_901))
+    assert [s.to_bytes() for _, s in c.forged_qc(com, 1).votes] != [
+        s.to_bytes() for _, s in a.forged_qc(com, 1).votes
+    ]
+    d = AdversaryPlane(_spec("forge-qc", seed=4), ("127.0.0.1", 9_900))
+    assert d.shadow_payloads(1) != a.shadow_payloads(1)
+
+
+def test_forged_qc_passes_weight_but_fails_verification():
+    """The forged certificate is the whole point of the forge-qc policy:
+    structurally a quorum (real authors, 2f+1 stake, no reuse) so it
+    survives check_weight, with garbage signatures so honest
+    verification must reject it."""
+    from hotstuff_tpu.consensus.errors import ConsensusError
+    from hotstuff_tpu.crypto.service import CpuVerifier
+
+    com = committee(9_910)
+    plane = AdversaryPlane(_spec("forge-qc", base=9_910), ("127.0.0.1", 9_910))
+    qc = plane.forged_qc(com, 5)
+    qc.check_weight(com)  # must NOT raise
+    with pytest.raises(ConsensusError):
+        qc.verify(com, CpuVerifier())
+
+
+# ---- scheduling -------------------------------------------------------------
+
+
+def test_schedule_gating_and_selection():
+    spec = _spec("withhold", at=2.0, until=6.0)
+    epoch = spec["epoch_unix"]
+    plane = AdversaryPlane(spec, ("127.0.0.1", 9_900))
+    assert plane.enabled
+    assert not plane.active("withhold", now=epoch + 1.9)
+    assert plane.active("withhold", now=epoch + 2.0)
+    assert plane.active("withhold", now=epoch + 5.9)
+    assert not plane.active("withhold", now=epoch + 6.0)
+    # other policies never fire from this rule
+    assert not plane.active("equivocate", now=epoch + 3.0)
+    # a node the spec does not name is inert forever
+    honest = AdversaryPlane(spec, ("127.0.0.1", 9_901))
+    assert not honest.enabled
+    assert not honest.active("withhold", now=epoch + 3.0)
+    # window edges feed the adversary clock in order
+    assert plane.window_edges() == [(2.0, "open", "withhold"),
+                                    (6.0, "close", "withhold")]
+
+
+def test_collude_implies_equivocate_and_double_vote():
+    spec = _spec("collude", nodes=[0, 1], at=1.0)
+    epoch = spec["epoch_unix"]
+    plane = AdversaryPlane(spec, ("127.0.0.1", 9_901))
+    for policy in ("collude", "equivocate", "double-vote"):
+        assert plane.active(policy, now=epoch + 1.5), policy
+    assert not plane.active("withhold", now=epoch + 1.5)
+    assert plane.colluders == [0, 1]
+    # shadow committer = highest-indexed colluder, deterministically
+    assert plane.is_shadow_committer
+    assert not AdversaryPlane(spec, ("127.0.0.1", 9_900)).is_shadow_committer
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError):
+        AdversaryRule("bribe", 0)
+    with pytest.raises(ValueError):
+        expand_adversary({"adversary": [{"policy": "nope", "node": 0}]})
+
+
+def test_canned_byz_scenarios_registered():
+    for name in ("byz-equivocate", "byz-forge-qc", "byz-withhold",
+                 "byz-collude"):
+        assert name in SCENARIOS
+        spec = build(name, nodes=4, seed=11)
+        assert spec["adversary"], name
+        for rule in expand_adversary(spec):
+            assert rule.policy in POLICIES
+    # only withhold impairs liveness; open-ended windows push the last
+    # heal to infinity so the checker treats liveness as n/a
+    import math
+
+    assert last_heal(build("byz-withhold", nodes=4, seed=0)) == 12.0
+    assert last_heal(build("byz-equivocate", nodes=4, seed=0)) == 0.0
+    assert not math.isinf(last_heal(build("byz-collude", nodes=4, seed=0)))
+    assert build("byz-collude", nodes=4, seed=0)["quorum_mode"] == (
+        "trusted-subset"
+    )
+
+
+# ---- attack math ------------------------------------------------------------
+
+
+def test_shadow_branch_agrees_across_colluders_without_communication():
+    """Both colluders derive the same conflicting twin for a received
+    block from (seed, round) alone — the block digest excludes the
+    signature, so no coordination round-trip is needed."""
+    from .common import signed_block
+
+    spec = _spec("collude", nodes=[0, 1])
+    a = AdversaryPlane(spec, ("127.0.0.1", 9_900))
+    b = AdversaryPlane(spec, ("127.0.0.1", 9_901))
+    pk, sk = keys()[2]
+    block = signed_block(pk, sk, 7)
+    sa, sb = a.shadow_block(block), b.shadow_block(block)
+    assert sa.digest() == sb.digest()
+    assert sa.digest() != block.digest()
+    assert sa.round == block.round and sa.author == block.author
+
+
+def test_equivocation_targets():
+    com = committee(9_920)
+    fixture = keys()
+    self_name = fixture[0][0]
+    pairs = com.broadcast_addresses(self_name)
+    # solo equivocator: deterministic first half of the peer set
+    solo = AdversaryPlane(_spec("equivocate", base=9_920),
+                          ("127.0.0.1", 9_920))
+    targets = solo.equivocation_targets(pairs)
+    assert targets == sorted(pairs, key=lambda p: str(p[0]))[: len(pairs) // 2]
+    # colluding equivocator: only fellow colluders see the shadow block
+    spec = _spec("collude", nodes=[0, 1], base=9_920)
+    plane = AdversaryPlane(spec, ("127.0.0.1", 9_920))
+    plane.bind(com, self_name)
+    targets = plane.equivocation_targets(pairs)
+    assert [nm for nm, _ in targets] == [fixture[1][0]]
+
+
+# ---- checker layer ----------------------------------------------------------
+
+
+def test_attribution_names_adversaries_and_trusted_subset_recovers():
+    spec = _spec("collude", nodes=[0, 1])
+    advs = adversaries_from_spec(spec, {0: "aa11", 1: "bb22"})
+    assert set(advs) == {"node-0", "node-1"}
+    commits = {
+        "node-0": [(1.0, 4, "MAIN")],
+        "node-1": [(1.0, 4, "SHADOW")],
+        "node-2": [(1.0, 4, "MAIN")],
+        "node-3": [(1.0, 4, "MAIN")],
+    }
+    ok, viol = check_safety(commits)
+    assert not ok
+    attributed = attribute_violations(viol, advs)
+    assert "node-1" in attributed[0] and "collude" in attributed[0]
+    assert "bb22" in attributed[0]
+    # TEE-style trusted-subset quorum: discard the adversarial
+    # histories and the survivors agree
+    t_ok, t_viol = trusted_subset_recheck(commits, set(advs))
+    assert t_ok, t_viol
+
+
+def test_check_run_fails_collusion_and_renders_byz_block(tmp_path):
+    """The full log-scrape path: a shadow-committing colluder makes the
+    run FAIL on full history, with the violation attributed and the
+    trusted-subset recheck PASSing in the rendered + BYZ block."""
+    epoch = time.time() - 30.0
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(epoch + 5.0))
+    line = f"[{stamp}.000Z] node INFO: Committed block {{r}} -> {{d}}\n"
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    for i in range(4):
+        digest = "SHADOW9" if i == 1 else "MAIN447"
+        content = line.format(r=3, d=digest)
+        content += "byz equivocate round 3 -> SHADOW9 | x (1 peers)\n" if i < 2 else ""
+        (logs / f"node-{i}.log").write_text(content)
+    (logs / "node-3.log").write_text(
+        (logs / "node-3.log").read_text()
+        + "qc reject: invalid certificate in timeout from x round 2\n"
+        + "second digest cell paid by y\n"
+    )
+    spec = build("byz-collude", nodes=4, seed=0)
+    ok, block = check_run(str(logs), spec, epoch,
+                          authorities={0: "aa11", 1: "bb22"})
+    assert not ok
+    assert "+ BYZ:" in block
+    assert "FAIL" in block
+    assert "[adversary:" in block and "bb22" in block
+    assert "Trusted-subset quorum (adversaries excluded): PASS" in block
+    activity = byz_activity_from_logs(str(logs))
+    assert activity["node-0"].get("equivocate") == 1
+    assert activity["node-3"] == {"qc_reject": 1, "vote_conflict": 1}
+
+
+# ---- e2e: the plane on a live committee -------------------------------------
+
+
+def _arm(monkeypatch, base, policy, nodes, at=0.5, until=None, seed=5):
+    spec = _spec(policy, nodes=nodes, at=at, until=until, seed=seed,
+                 base=base)
+    monkeypatch.setenv("HOTSTUFF_ADVERSARY", json.dumps(spec))
+    return spec
+
+
+async def _consistent_chains(nodes, per_node=4, timeout=40.0):
+    chains = []
+    for _, commit_q, _ in nodes:
+        committed = []
+        while len(committed) < per_node:
+            b = await asyncio.wait_for(commit_q.get(), timeout=timeout)
+            if b.round > 0:
+                committed.append(b)
+        chains.append(committed)
+    digests = [[b.digest() for b in chain] for chain in chains]
+    common_len = min(len(d) for d in digests)
+    for d in digests[1:]:
+        assert d[:common_len] == digests[0][:common_len]
+    return chains
+
+
+@async_test
+async def test_equivocating_leader_commits_within_deadline(
+    tmp_path, monkeypatch
+):
+    """The production knob end to end: node 0 equivocates every time it
+    leads, yet the honest committee keeps committing a consistent chain
+    — and the plane actually attacked (counted equivocations)."""
+    base = fresh_base_port()
+    _arm(monkeypatch, base, "equivocate", 0, at=0.0)
+    nodes = await _spawn_committee(tmp_path, base, range(4),
+                                   timeout_delay=1_000)
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    try:
+        await _consistent_chains(nodes, per_node=4)
+        plane = nodes[0][0].core.adversary
+        assert plane is not None and plane.enabled
+        deadline = time.time() + 20.0
+        while plane.counts["byz_equivocations"] == 0 and time.time() < deadline:
+            await asyncio.sleep(0.25)
+        assert plane.counts["byz_equivocations"] > 0
+        assert nodes[1][0].core.adversary is None  # honest slots stay clean
+    finally:
+        await _shutdown(nodes, feeder)
+
+
+@async_test
+async def test_withholding_node_costs_rounds_not_safety(
+    tmp_path, monkeypatch
+):
+    """Withhold: node 0 receives but never votes inside its window; the
+    3-of-4 honest quorum keeps committing, and the attacker counted the
+    votes it swallowed."""
+    base = fresh_base_port()
+    _arm(monkeypatch, base, "withhold", 0, at=0.0, until=None)
+    nodes = await _spawn_committee(tmp_path, base, range(4),
+                                   timeout_delay=800)
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    try:
+        await _consistent_chains(nodes, per_node=3)
+        plane = nodes[0][0].core.adversary
+        assert plane is not None
+        assert plane.counts["byz_votes_withheld"] > 0
+    finally:
+        await _shutdown(nodes, feeder)
+
+
+@async_test
+async def test_double_vote_parks_on_honest_aggregator(tmp_path, monkeypatch):
+    """Double-vote: the attacker's conflicting vote reaches an honest
+    next leader whose aggregator must park it as a second paid digest
+    cell — surfaced as the vote_conflicts defense counter."""
+    base = fresh_base_port()
+    _arm(monkeypatch, base, "double-vote", 0, at=0.0)
+    nodes = await _spawn_committee(tmp_path, base, range(4),
+                                   timeout_delay=1_000)
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    try:
+        await _consistent_chains(nodes, per_node=4)
+        plane = nodes[0][0].core.adversary
+        assert plane is not None
+        deadline = time.time() + 20.0
+        while plane.counts["byz_double_votes"] == 0 and time.time() < deadline:
+            await asyncio.sleep(0.25)
+        assert plane.counts["byz_double_votes"] > 0
+        conflicts = sum(
+            stack.core.aggregator.vote_conflicts
+            for stack, _, _ in nodes[1:]
+        )
+        assert conflicts > 0, "no honest aggregator parked the double vote"
+    finally:
+        await _shutdown(nodes, feeder)
+
+
+@async_test
+async def test_colluding_pair_produces_attributable_divergence(
+    tmp_path, monkeypatch
+):
+    """Collude e2e: nodes 0+1 run the shadow-branch suite; the shadow
+    committer (node 1) reports shadow digests for colluder-authored
+    commits, so the commit streams REALLY diverge — exactly what the
+    safety checker must catch and pin on the colluders."""
+    base = fresh_base_port()
+    _arm(monkeypatch, base, "collude", [0, 1], at=0.0, seed=9)
+    nodes = await _spawn_committee(tmp_path, base, range(4),
+                                   timeout_delay=1_000)
+    feeder = asyncio.ensure_future(_feed_producers(nodes))
+    records: dict[str, list[tuple[float, int, str]]] = {
+        f"node-{i}": [] for i in range(4)
+    }
+
+    async def collect(i, commit_q):
+        while True:
+            block = await commit_q.get()
+            plane = nodes[i][0].core.adversary
+            digest = block.digest()
+            # commit queues carry the true blocks; mirror the shadow
+            # committer's LOG view (core._commit), which is what the
+            # checker scrapes in production
+            if (
+                plane is not None
+                and plane.is_shadow_committer
+                and block.author in plane.colluder_names
+            ):
+                digest = plane.shadow_block(block).digest()
+            records[f"node-{i}"].append((time.time(), block.round, str(digest)))
+
+    collectors = [
+        asyncio.ensure_future(collect(i, commit_q))
+        for i, (_, commit_q, _) in enumerate(nodes)
+    ]
+    try:
+        shadow_plane = nodes[1][0].core.adversary
+        assert shadow_plane is not None and shadow_plane.is_shadow_committer
+        deadline = time.time() + 45.0
+        diverged = False
+        while time.time() < deadline:
+            ok, viol = check_safety(records)
+            if not ok:
+                diverged = True
+                break
+            await asyncio.sleep(0.5)
+        assert diverged, "colluders never produced a divergent history"
+        advs = adversaries_from_spec(
+            {"adversary": [{"policy": "collude", "nodes": [0, 1]}]}
+        )
+        attributed = attribute_violations(viol, advs)
+        assert any("collude" in v for v in attributed)
+        # the honest majority still agrees once colluders are discarded
+        t_ok, t_viol = trusted_subset_recheck(records, {"node-0", "node-1"})
+        assert t_ok, t_viol
+    finally:
+        for c in collectors:
+            c.cancel()
+        await _shutdown(nodes, feeder)

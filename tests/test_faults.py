@@ -232,7 +232,12 @@ def test_all_scenarios_build_and_heal():
         spec = build(name, nodes=4, seed=7)
         assert spec["seed"] == 7 and spec["name"] == name
         heal = last_heal(spec)
-        assert 0 < heal < math.inf
+        assert 0 <= heal < math.inf
+        if not name.startswith("byz-") or name == "byz-withhold":
+            # network faults and vote withholding impair liveness and
+            # must heal strictly after t=0; the other byz scenarios are
+            # pure attacks (never impairing) and heal at 0.0
+            assert heal > 0
         assert spec["liveness"]["resume_within_s"] > 0
         # every scenario resolves to a working plane for node 0
         plane = _plane(spec, self_addr="127.0.0.1:9000")

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import random
 
+import pytest
+
 from hotstuff_tpu.consensus.errors import SerializationError
 from hotstuff_tpu.consensus.messages import MAX_BLOCK_PAYLOADS
 from hotstuff_tpu.consensus.wire import (
@@ -21,9 +23,16 @@ from hotstuff_tpu.consensus.wire import (
     encode_timeout,
     encode_vote,
 )
-from hotstuff_tpu.crypto import Digest
+from hotstuff_tpu.crypto import Digest, Signature
 
-from .common import chain, keys, qc_for_block, signed_timeout, signed_vote
+from .common import (
+    chain,
+    keys,
+    qc_for_block,
+    secret_for,
+    signed_timeout,
+    signed_vote,
+)
 
 
 def _decode_must_not_crash(data: bytes) -> None:
@@ -111,3 +120,82 @@ def test_length_field_extremes_never_crash_or_overallocate():
     # block payload count just over the protocol cap decodes (the cap is
     # a VERIFY-time rule) or errors cleanly — never crashes
     assert MAX_BLOCK_PAYLOADS == 512
+
+
+def test_adversarial_well_formed_frames_decode_then_fail_verify():
+    """Frames an adversary-plane node actually emits (faults/adversary.py)
+    are WELL-FORMED on the wire — they must decode cleanly and be killed
+    by verification (``ConsensusError``), never by the codec and never by
+    an unhandled crash.  This is a different threat than random mutation:
+    every byte here is chosen by a protocol-aware attacker."""
+    import time
+
+    from hotstuff_tpu.consensus.errors import ConsensusError
+    from hotstuff_tpu.crypto.service import CpuVerifier
+    from hotstuff_tpu.faults.adversary import AdversaryPlane
+
+    from .common import committee, signed_block
+
+    base = 9_900
+    com = committee(base)
+    verifier = CpuVerifier()
+    plane = AdversaryPlane(
+        {
+            "name": "byz-forge-qc",
+            "seed": 7,
+            "epoch_unix": time.time(),
+            "nodes": {f"127.0.0.1:{base + i}": i for i in range(4)},
+            "adversary": [{"policy": "forge-qc", "node": 0, "at": 0.0}],
+        },
+        ("127.0.0.1", base),
+    )
+    pairs = keys()
+    blocks = chain(3)
+
+    # 1. Forged QC smuggled inside an otherwise-genuine timeout: passes
+    #    check_weight (real authors, quorum-many), decode round-trips,
+    #    verification rejects the garbage signatures.
+    forged = plane.forged_qc(com, blocks[1].round)
+    forged.check_weight(com)
+    pk, sk = pairs[0]
+    frame = encode_timeout(signed_timeout(forged, 5, pk, sk))
+    _, timeout = decode_message(frame)
+    with pytest.raises(ConsensusError):
+        timeout.verify(com, verifier)
+
+    # 2. The forged QC as a block's parent certificate.
+    author, secret = pairs[2 % 4]
+    bad_block = signed_block(author, secret, 2, qc=forged)
+    _, decoded = decode_message(encode_propose(bad_block))
+    assert decoded.digest() == bad_block.digest()
+    with pytest.raises(ConsensusError):
+        decoded.verify(com, verifier)
+
+    # 3. A vote whose signature was produced by a DIFFERENT committee
+    #    member (signature spoofing a peer): structurally perfect, fails
+    #    only on crypto.
+    spoofed = signed_vote(blocks[1], pairs[1][0], pairs[2][1])
+    _, vote = decode_message(encode_vote(spoofed))
+    with pytest.raises(ConsensusError):
+        vote.verify(com, verifier)
+
+    # 4. The equivocating twin of a committed block, genuinely signed by
+    #    its author: decodes AND verifies — only the safety rule (not the
+    #    codec or crypto) can reject it, which is exactly why the
+    #    invariant checker needs attribution.
+    shadow = plane.shadow_block(blocks[1])
+    shadow.signature = Signature.new(
+        shadow.digest(), secret_for(shadow.author)
+    )
+    _, twin = decode_message(encode_propose(shadow))
+    twin.verify(com, verifier)
+    assert twin.digest() != blocks[1].digest()
+    assert twin.round == blocks[1].round and twin.author == blocks[1].author
+
+    # 5. Mutations of the adversarial frames still never crash the codec.
+    rng = random.Random(0xF025)
+    for f in (frame, encode_propose(bad_block), encode_vote(spoofed)):
+        for _ in range(200):
+            buf = bytearray(f)
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            _decode_must_not_crash(bytes(buf))
