@@ -469,6 +469,134 @@ def bench_qc_pipelined(sizes=(16, 64, 256), train: int = 8, reps: int = 5) -> di
     return out
 
 
+def bench_agg_qc(sizes=(64, 256, 512), reps: int = 5) -> dict:
+    """Compact (aggregated) QC vs the vote-list BLS baseline (ISSUE 9),
+    per committee size: certificate wire bytes, QC formation p50 (build
+    + encode from already-accumulated votes — the compact path snapshots
+    a running G1 sum and emits ~50 wire bytes, the vote-list path copies
+    and encodes n×144), and verify p50 — ``verify_aggregate_msg``'s one
+    pairing over the memoized key sum vs ``verify_shared_msg``'s O(n)
+    re-aggregation per certificate.  ``verify_cold_ms`` keeps the
+    first-bitmap cost (one O(n) key sum) honest next to the steady-state
+    p50.  Committee secrets are small scalars so fixture generation is
+    O(n) cheap point multiplies — verification cost is unaffected.
+
+    Headline scalars: ``verify_p50_ms`` (largest committee, the perfgate
+    guard) and ``flat_ratio`` = compact verify p50 at max size / at min
+    size — the acceptance bar is < 1.5 while the vote-list baseline
+    grows with n."""
+    from hotstuff_tpu.consensus.handel import HandelTopology, simulate
+    from hotstuff_tpu.consensus.messages import QC, make_signer_bitmap
+    from hotstuff_tpu.crypto import Digest, PublicKey, Signature
+    from hotstuff_tpu.crypto.bls import BlsSecretKey
+    from hotstuff_tpu.crypto.scheme import make_cpu_verifier
+
+    digest = Digest.of(b"bench agg qc block digest")
+    msg = digest.to_bytes()
+    out: dict = {}
+    p50s: dict[int, float] = {}
+    for n in sizes:
+        verifier = make_cpu_verifier("bls")  # fresh memo per size
+        sks = [BlsSecretKey(i + 2) for i in range(n)]
+        pks = sorted(
+            PublicKey(sk.public_key().to_bytes()) for sk in sks
+        )
+        sk_by_pk = {
+            PublicKey(sk.public_key().to_bytes()): sk for sk in sks
+        }
+        quorum = 2 * n // 3 + 1
+        signers = pks[:quorum]
+        votes = [
+            (pk, Signature(sk_by_pk[pk].sign(msg).to_bytes()))
+            for pk in signers
+        ]
+        verifier.precompute([pk.to_bytes() for pk in signers])
+
+        from hotstuff_tpu.crypto.bls.curve import G1Point
+
+        sig_points = [
+            G1Point.from_bytes(sig.to_bytes(), subgroup_check=False)
+            for _, sig in votes
+        ]
+        running_sum = G1Point.sum(sig_points)  # what the accumulator holds
+
+        def timed(fn, count=reps):
+            samples = []
+            for _ in range(count):
+                t0 = time.perf_counter()
+                fn()
+                samples.append((time.perf_counter() - t0) * 1e3)
+            samples.sort()
+            return samples
+
+        # -- formation: votes already accumulated -> QC on the wire ----
+        def form_compact():
+            bitmap = make_signer_bitmap(signers, pks)
+            qc = QC(
+                hash=digest,
+                round=3,
+                votes=[],
+                agg_sig=Signature(running_sum.to_bytes()),
+                signers=bitmap,
+            )
+            return qc.wire_size()
+
+        def form_votelist():
+            return QC(hash=digest, round=3, votes=list(votes)).wire_size()
+
+        compact_bytes = form_compact()
+        votelist_bytes = form_votelist()
+        form_c = timed(form_compact)
+        form_v = timed(form_votelist)
+
+        # -- verification ---------------------------------------------
+        agg_bytes = running_sum.to_bytes()
+        pk_bytes = [pk.to_bytes() for pk in signers]
+        assert verifier.verify_aggregate_msg(digest, pk_bytes, agg_bytes)
+        # genuinely cold verifier for the first-bitmap (key-sum) cost —
+        # the warm ``verifier`` above now holds the memoized aggregate
+        fresh = make_cpu_verifier("bls")
+        fresh.precompute(pk_bytes)
+        t0 = time.perf_counter()
+        assert fresh.verify_aggregate_msg(digest, pk_bytes, agg_bytes)
+        cold = [(time.perf_counter() - t0) * 1e3]
+        verify_c = timed(
+            lambda: verifier.verify_aggregate_msg(
+                digest, pk_bytes, agg_bytes
+            )
+        )
+        verify_v = timed(
+            lambda: verifier.verify_shared_msg(digest, votes)
+        )
+
+        # -- Handel plane: leader-side merge count at this size --------
+        topo = HandelTopology.for_round(n, round_=3)
+        sigs_by_index = {
+            pks.index(pk): sig.to_bytes() for pk, sig in votes
+        }
+        final, top_merges, _ = simulate(topo, sigs_by_index)
+        assert final.weight == quorum
+
+        p50s[n] = verify_c[len(verify_c) // 2]
+        out[str(n)] = {
+            "qc_bytes_compact": compact_bytes,
+            "qc_bytes_votelist": votelist_bytes,
+            "form_p50_ms": round(form_c[len(form_c) // 2], 3),
+            "form_votelist_p50_ms": round(form_v[len(form_v) // 2], 3),
+            "verify_p50_ms": round(verify_c[len(verify_c) // 2], 3),
+            "verify_cold_ms": round(cold[0], 3),
+            "verify_votelist_p50_ms": round(
+                verify_v[len(verify_v) // 2], 3
+            ),
+            "handel_levels": topo.levels,
+            "handel_leader_merges": top_merges,
+        }
+    lo, hi = min(sizes), max(sizes)
+    out["verify_p50_ms"] = round(p50s[hi], 3)
+    out["flat_ratio"] = round(p50s[hi] / max(p50s[lo], 1e-9), 3)
+    return out
+
+
 def probe_tunnel(inflight: int = 16, reps: int = 7) -> dict:
     """Tunnel weather, two views over the same tiny resident-arg jit
     call, pinned in the output so end-to-end swings between rounds are
@@ -566,6 +694,7 @@ def main() -> int:
                 "mesh_train": mesh_train,
                 "verify_split": bench_verify_split(msgs, pks, sigs),
                 "pipeline": bench_pipeline(),
+                "agg_qc": bench_agg_qc(),
             }
         )
     )

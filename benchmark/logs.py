@@ -57,6 +57,7 @@ RE_VERIFY_STATS = re.compile(
     r"device_sigs=(\d+) cpu_sigs=(\d+) deadline_misses=(\d+) "
     r"(?:waits=(\d+) depth=(\d+) )?"
     r"(?:mesh=(\d+) )?"
+    r"(?:agg=(\d+) agg_sigs=(\d+) )?"
     r"ewma_ms=([\d.]+)"
 )
 # periodic per-node telemetry snapshot (telemetry/exporter.py) — a
@@ -119,12 +120,13 @@ class LogParser:
         for log_idx, content in enumerate(node_logs):
             for (
                 tag, disp, dev, cpu, probe, dsig, csig, miss, waits,
-                depth, mesh, ewma,
+                depth, mesh, agg, agg_sigs, ewma,
             ) in RE_VERIFY_STATS.findall(content):
                 per_tag[(log_idx, tag)] = (
                     int(disp), int(dsig), int(csig), int(miss),
                     float(ewma), int(dev), int(cpu or 0), int(probe or 0),
                     int(waits or 0), int(depth or 1), int(mesh or 0),
+                    int(agg or 0), int(agg_sigs or 0),
                 )
         self.device_sigs = sum(v[1] for v in per_tag.values())
         self.cpu_route_sigs = sum(v[2] for v in per_tag.values())
@@ -148,6 +150,12 @@ class LogParser:
         self.pipeline_depth = (
             max(v[9] for v in per_tag.values()) if per_tag else None
         )
+        # aggregate-certificate route (ISSUE 9): "agg" claims served by
+        # ONE pairing over the bitmap-selected key sum instead of a
+        # per-signature batch; agg_sigs counts the votes those compact
+        # certificates stood in for
+        self.agg_claims = sum(v[11] for v in per_tag.values())
+        self.agg_claim_sigs = sum(v[12] for v in per_tag.values())
 
         # telemetry snapshots (cumulative): last document per node log
         import json as _json
@@ -161,6 +169,23 @@ class LogParser:
                 self.telemetry_docs.append(_json.loads(matches[-1]))
             except ValueError:
                 pass  # truncated log line mid-write
+
+        # compact-certificate telemetry (ISSUE 9): the aggregator section
+        # records the last emitted QC's wire size (compact = agg sig +
+        # signer bitmap, vote-list = n x full votes) and how many
+        # certificates took the compact form
+        _agg_sections = [
+            d.get("aggregator", {}) for d in self.telemetry_docs
+        ]
+        self.qc_wire_bytes = max(
+            (s.get("qc_wire_bytes", 0) for s in _agg_sections), default=0
+        ) or None
+        self.compact_qcs = sum(
+            s.get("compact_qcs_total", 0) for s in _agg_sections
+        )
+        self.compact_tcs = sum(
+            s.get("compact_tcs_total", 0) for s in _agg_sections
+        )
 
         # only blocks whose proposal we saw count toward latency
         self.commits = {
@@ -348,9 +373,9 @@ class LogParser:
         """Routing-split lines (only for runs with async verify services
         — the device-routing proof for tpu-verifier A/Bs)."""
         total = self.device_sigs + self.cpu_route_sigs
-        if not total:
+        if not total and not self.agg_claims:
             return ""
-        pct = 100.0 * self.device_sigs / total
+        pct = 100.0 * self.device_sigs / total if total else 0.0
         ewma = (
             f"{self.verify_ewma_ms:.1f} ms"
             if self.verify_ewma_ms is not None
@@ -378,6 +403,24 @@ class LogParser:
             out += (
                 f" Verify route waves: {shares} of {waves:,}"
                 f" (queued {self.pipeline_waits}{depth})\n"
+            )
+        # aggregate-certificate route (ISSUE 9): compact QCs/TCs served
+        # by one pairing each instead of per-signature batches
+        if self.agg_claims:
+            out += (
+                f" Verify aggregate certificates: {self.agg_claims:,}"
+                f" (standing in for {self.agg_claim_sigs:,} sigs,"
+                f" one pairing each)\n"
+            )
+        if self.qc_wire_bytes:
+            form = (
+                f"{self.compact_qcs:,} compact QCs emitted"
+                if self.compact_qcs
+                else "vote-list form"
+            )
+            out += (
+                f" QC wire size (last emitted): {self.qc_wire_bytes:,} B"
+                f" ({form})\n"
             )
         return out
 

@@ -125,6 +125,12 @@ def run_scaling(
                     # flapping is visible per rate in the SUMMARY
                     "route_waves": dict(parser.route_waves),
                     "pipeline_waits": parser.pipeline_waits,
+                    # compact-certificate columns (ISSUE 9): last emitted
+                    # QC wire size plus how many certificates took the
+                    # aggregate one-pairing route
+                    "qc_bytes": parser.qc_wire_bytes or 0,
+                    "agg_claims": parser.agg_claims,
+                    "compact_qcs": parser.compact_qcs,
                 }
             )
     finally:
@@ -142,7 +148,7 @@ def format_report(
         "",
         f"{'nodes':>6} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
         f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p/m':>13} "
-        f"{'pred 1-core/node':>17}",
+        f"{'qc B':>6} {'agg':>5} {'pred 1-core/node':>17}",
     ]
     for r in rows:
         window = max(r["window_s"], 1e-9)
@@ -162,11 +168,15 @@ def format_report(
             )
         else:
             route = "-"
+        qc_bytes = r.get("qc_bytes", 0)
+        qc_txt = f"{qc_bytes}" if qc_bytes else "-"
+        agg_claims = r.get("agg_claims", 0)
+        agg_txt = f"{agg_claims}" if agg_claims else "-"
         lines.append(
             f"{r['nodes']:>6} {r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
             f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>13} "
-            f"{predicted:>17.0f}"
+            f"{qc_txt:>6} {agg_txt:>5} {predicted:>17.0f}"
         )
     lines += [
         "",
@@ -185,6 +195,10 @@ def format_report(
         "visible as lag >> 1 ms;",
         "- c us: measured per-(node, payload) protocol cost = "
         "window / (payloads x nodes) core-microseconds;",
+        "- qc B / agg: last emitted QC's wire size and certificates "
+        "served by the aggregate one-pairing route (BLS compact form: "
+        "48 B agg sig + ceil(n/8) B signer bitmap vs n x 144 B vote "
+        "lists; '-' for ed25519 vote-list committees);",
         "- pred: payloads/s one node sustains on a DEDICATED core (the "
         "reference topology, one host per node) = 1/c.  Committee size "
         "multiplies the fleet's total work, not the per-node cost, so "

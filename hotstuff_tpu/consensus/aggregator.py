@@ -29,12 +29,14 @@ the TC without re-verification.
 from __future__ import annotations
 
 import logging
+import os
+import sys
 
 from ..crypto import Digest, PublicKey, Signature
 from ..crypto.service import VerifierBackend
 from .config import Committee
 from .errors import AuthorityReuse, ConsensusError, InvalidSignature, UnknownAuthority
-from .messages import QC, TC, Round, Timeout, Vote
+from .messages import QC, TC, Round, Timeout, Vote, make_signer_bitmap
 
 log = logging.getLogger(__name__)
 
@@ -42,6 +44,91 @@ log = logging.getLogger(__name__)
 ROUND_LOOKAHEAD = 64
 # Distinct block digests tracked per round (honest case: exactly one).
 MAX_DIGEST_CELLS = 8
+
+
+def _compact_enabled(committee: Committee) -> bool:
+    """Compact (one-agg-sig + signer-bitmap) certificate emission:
+    default ON for BLS committees — their G1 signatures aggregate —
+    HOTSTUFF_COMPACT_QC=0 reverts to the vote-list form.  Ed25519
+    committees always emit vote lists (no aggregate form; the wire
+    layer rejects compact certificates for them outright)."""
+    return (
+        getattr(committee, "scheme", "ed25519") == "bls"
+        and os.environ.get("HOTSTUFF_COMPACT_QC", "1").strip() != "0"
+    )
+
+
+class _SigAccumulator:
+    """Running Σ sig_i over a cell's vote list (ISSUE 9): one G1 add per
+    arriving vote, so the aggregate signature already exists when quorum
+    lands — O(1) marginal work per vote instead of an O(n) sum at QC
+    formation.
+
+    The sum runs on DEVICE (``tpu.bls.TpuG1RunningSum``, one fixed-shape
+    ``point_add`` dispatch per vote) when an accelerator backend is live
+    or HOTSTUFF_AGG_DEVICE_SUM=1 forces it; otherwise an incremental
+    host Jacobian add.  Per-signature decompress skips the r-torsion
+    ladder — the emitted aggregate is subgroup-checked by every verifier
+    (the same soundness argument as ``BlsVerifier.verify_shared_msg``).
+
+    ``count`` mirrors the number of accumulated signatures; the owning
+    cell compares it against its vote list to detect evict/replace
+    divergence and rebuilds from the surviving votes (rare, adversarial
+    path)."""
+
+    def __init__(self):
+        self.count = 0
+        self._device = None
+        self._host = None
+        if "jax" in sys.modules and self._want_device():
+            try:
+                from ..tpu.bls import TpuG1RunningSum
+
+                self._device = TpuG1RunningSum()
+            except Exception:  # noqa: BLE001 — device absence is non-fatal
+                self._device = None
+        if self._device is None:
+            from ..crypto.bls.curve import G1Point
+
+            self._host = G1Point.identity()
+
+    @staticmethod
+    def _want_device() -> bool:
+        env = os.environ.get("HOTSTUFF_AGG_DEVICE_SUM", "").strip().lower()
+        if env:
+            return env not in ("0", "off", "no", "false")
+        try:
+            import jax
+
+            return jax.default_backend() in ("tpu", "gpu")
+        except Exception:  # noqa: BLE001
+            return False
+
+    def add(self, sig: Signature) -> bool:
+        """Accumulate one signature; False when it doesn't decompress
+        (a spoofed blob — the cell falls back to rebuild-at-quorum)."""
+        from ..crypto.bls.curve import G1Point
+
+        pt = G1Point.from_bytes(sig.to_bytes(), subgroup_check=False)
+        if pt is None:
+            return False
+        if self._device is not None:
+            self._device.add(pt)
+        else:
+            self._host = self._host + pt
+        self.count += 1
+        return True
+
+    def aggregate(self) -> bytes | None:
+        """The compressed 48-byte aggregate, or None for the empty sum."""
+        pt = (
+            self._device.snapshot()
+            if self._device is not None
+            else self._host
+        )
+        if pt.inf:
+            return None
+        return pt.to_bytes()
 
 
 class AggregationBounds(ConsensusError):
@@ -74,6 +161,10 @@ class QCMaker:
         # empty at quorum, the batch dispatch is skipped — every
         # signature in the certificate already passed.
         self.unverified: set[PublicKey] = set()
+        # Running Σ sig for compact-QC emission (BLS committees only;
+        # built lazily on the first vote).  None when the committee
+        # scheme has no aggregate form or compact emission is off.
+        self._acc: _SigAccumulator | None = None
 
     def append(
         self,
@@ -113,6 +204,13 @@ class QCMaker:
             self.unverified.add(author)
         self.used.add(author)
         self.votes.append((author, vote.signature))
+        if _compact_enabled(committee):
+            # O(1) marginal work per vote: the aggregate signature is
+            # ready the moment quorum lands (ISSUE 9)
+            if self._acc is None:
+                self._acc = _SigAccumulator()
+            self._acc.add(vote.signature)  # failure -> count diverges,
+            # _compact_qc rebuilds from the (verified) survivors
         self.weight += stake
         if self.weight < committee.quorum_threshold():
             return None
@@ -129,7 +227,43 @@ class QCMaker:
 
         self.verified = True
         self.weight = 0  # a QC is made at most once
+        if _compact_enabled(committee):
+            qc = self._compact_qc(vote, committee)
+            if qc is not None:
+                return qc
         return QC(hash=vote.hash, round=vote.round, votes=list(self.votes))
+
+    def _compact_qc(self, vote: Vote, committee: Committee) -> QC | None:
+        """Emit the constant-size form: one aggregate signature + signer
+        bitmap.  None (vote-list fallback) when the signer set doesn't
+        map onto the committee bitmap or no aggregate can be formed —
+        correctness never depends on the compact path."""
+        try:
+            bitmap = make_signer_bitmap(
+                [pk for pk, _ in self.votes], committee.sorted_keys()
+            )
+        except (UnknownAuthority, ValueError):
+            return None
+        if self._acc is None or self._acc.count != len(self.votes):
+            # evict/replace (or a non-decompressing spoof) diverged the
+            # running sum from the vote list: rebuild from the survivors
+            # — all of them just passed verification
+            acc = _SigAccumulator()
+            if not all(acc.add(sig) for _, sig in self.votes):
+                return None
+            self._acc = acc
+        agg = self._acc.aggregate()
+        if agg is None:
+            return None
+        if self.owner is not None:
+            self.owner.compact_qcs += 1
+        return QC(
+            hash=vote.hash,
+            round=vote.round,
+            votes=[],
+            agg_sig=Signature(agg),
+            signers=bitmap,
+        )
 
     def check_any_valid(self, digest: Digest, verifier: VerifierBackend) -> bool:
         """Verify the stored signatures against the cell's vote digest;
@@ -166,6 +300,7 @@ class QCMaker:
                 )
                 self.votes[i] = (vote.author, vote.signature)
                 self.unverified.discard(pk)
+                self._acc = None  # running sum diverged; rebuilt on emit
             return
 
     def _evict_invalid(
@@ -187,6 +322,7 @@ class QCMaker:
                 self.used.discard(pk)
                 self.suspect.add(pk)
         self.votes = [v for v, valid in zip(self.votes, ok) if valid]
+        self._acc = None  # running sum diverged; rebuilt on emit
         # every survivor just passed a per-signature check
         self.unverified.clear()
         self.weight = sum(committee.stake(pk) for pk, _ in self.votes)
@@ -207,6 +343,7 @@ class TCMaker:
         self.weight = 0
         self.votes: list[tuple[PublicKey, Signature, Round]] = []
         self.used: set[PublicKey] = set()
+        self.owner: "Aggregator | None" = None
 
     def append(self, timeout: Timeout, committee: Committee) -> TC | None:
         author = timeout.author
@@ -221,7 +358,47 @@ class TCMaker:
         if self.weight < committee.quorum_threshold():
             return None
         self.weight = 0  # a TC is made at most once
+        if _compact_enabled(committee):
+            tc = self._compact_tc(timeout.round, committee)
+            if tc is not None:
+                return tc
         return TC(round=timeout.round, votes=list(self.votes))
+
+    def _compact_tc(self, round_: Round, committee: Committee) -> TC | None:
+        """Compact TC: one (agg sig, signer bitmap) per distinct high-QC
+        round.  Honest storms collapse to one or two groups, so the wire
+        form is ~groups x (48 + bitmap) bytes instead of n x 144.
+        Entries here were verified on entry by the core, so the host
+        aggregation is over genuine signatures.  Vote-list fallback on
+        any mapping/decompress failure, as with the QC path."""
+        from ..crypto.bls.curve import G1Point
+
+        ordered = committee.sorted_keys()
+        by_hq: dict[Round, list[tuple[PublicKey, Signature]]] = {}
+        for pk, sig, hq in self.votes:
+            by_hq.setdefault(hq, []).append((pk, sig))
+        groups: list[tuple[Round, Signature, bytes]] = []
+        for hq in sorted(by_hq):
+            members = by_hq[hq]
+            try:
+                bitmap = make_signer_bitmap(
+                    [pk for pk, _ in members], ordered
+                )
+            except (UnknownAuthority, ValueError):
+                return None
+            pts = []
+            for _, sig in members:
+                pt = G1Point.from_bytes(sig.to_bytes(), subgroup_check=False)
+                if pt is None:
+                    return None
+                pts.append(pt)
+            agg = G1Point.sum(pts)
+            if agg.inf:
+                return None
+            groups.append((hq, Signature(agg.to_bytes()), bitmap))
+        if self.owner is not None:
+            self.owner.compact_tcs += 1
+        return TC(round=round_, votes=[], groups=groups)
 
 
 class Aggregator:
@@ -269,6 +446,13 @@ class Aggregator:
         # from one author — conflicting validly-signed votes).
         self.qc_rejects = 0
         self.vote_conflicts = 0
+        # Compact-certificate accounting (ISSUE 9): certificates emitted
+        # in the aggregated form, and the wire size of the most recent
+        # QC (compact or vote-list — the scaling SUMMARY's qc_bytes
+        # column reads this to show the O(1)-vs-O(n) gap).
+        self.compact_qcs = 0
+        self.compact_tcs = 0
+        self.qc_wire_bytes = 0
 
     def add_vote(
         self,
@@ -305,6 +489,8 @@ class Aggregator:
         )
         if created and maker.protected:
             qc = self._replay_parked(vote.round, digest, maker) or qc
+        if qc is not None:
+            self.qc_wire_bytes = qc.wire_size()
         return qc
 
     def _park(self, vote: Vote) -> None:
@@ -455,7 +641,10 @@ class Aggregator:
             raise AggregationBounds(
                 f"timeout for far-future round {timeout.round}"
             )
-        maker = self.timeouts_aggregators.setdefault(timeout.round, TCMaker())
+        maker = self.timeouts_aggregators.get(timeout.round)
+        if maker is None:
+            maker = self.timeouts_aggregators[timeout.round] = TCMaker()
+            maker.owner = self
         return maker.append(
             timeout, self.committee.for_round(timeout.round)
         )
@@ -490,4 +679,7 @@ class Aggregator:
             "cells_evicted_total": self.cells_evicted,
             "qc_rejects_total": self.qc_rejects,
             "vote_conflicts_total": self.vote_conflicts,
+            "compact_qcs_total": self.compact_qcs,
+            "compact_tcs_total": self.compact_tcs,
+            "qc_wire_bytes": self.qc_wire_bytes,
         }

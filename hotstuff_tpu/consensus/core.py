@@ -373,6 +373,16 @@ class Core:
                 "Round timer re-arms (rounds entered + backoff restarts)",
                 fn=lambda: self.timer.resets,
             )
+            from .messages import QC_CACHE_STATS
+
+            # process-wide (module-level) by design: co-located nodes
+            # share the dedup the counter is meant to surface
+            telemetry.gauge(
+                "qc_verify_cache_hit",
+                "QC verifications skipped via the per-digest verify "
+                "memo (same QC via Propose / sync reply / TC high-QC)",
+                fn=lambda: QC_CACHE_STATS["hits"],
+            )
             telemetry.add_section("aggregator", self.aggregator.stats)
 
     # ---- persistence (fork additions, core.rs:76-86, 112-153) --------------
@@ -940,7 +950,9 @@ class Core:
                 return []
             qc.check_weight(self.committee)
             out = []
-            for c in qc.claims(cache=cache):
+            # committee= resolves a compact QC's signer bitmap into the
+            # member keys its "agg" claim carries
+            for c in qc.claims(cache=cache, committee=self.committee):
                 claims.setdefault(c, None)
                 qc_memo[c] = qc._cache_key()
                 out.append(c)
@@ -964,7 +976,7 @@ class Core:
             claims.setdefault(keys[0], None)
             keys += add_qc_claims(payload.qc)
             if payload.tc is not None:
-                for c in payload.tc.claims():
+                for c in payload.tc.claims(committee=self.committee):
                     claims.setdefault(c, None)
                     keys.append(c)
             per_msg.append((idx, keys))
@@ -990,7 +1002,7 @@ class Core:
         def collect_tc(idx, payload) -> None:
             if payload.round >= self.round:
                 keys = []
-                for c in payload.claims():
+                for c in payload.claims(committee=self.committee):
                     claims.setdefault(c, None)
                     keys.append(c)
                 per_msg.append((idx, keys))

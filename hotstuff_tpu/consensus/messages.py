@@ -42,6 +42,92 @@ Round = int
 # scheme; the length prefix lets both coexist in the one wire format.
 _MAX_KEYSIG = 96
 
+# Compact-certificate wire form (aggregated BLS committees): inside the
+# certificate's wire slot the vote count carries this sentinel, followed
+# by a version byte, one aggregated G1 signature and a committee signer
+# bitmap — constant-size in committee membership (48 + ceil(n/8) bytes
+# vs n x 144 for the vote list).  ed25519 committees never emit it and
+# scheme-pinned decoders reject it (wire.SCHEME_COMPACT_SIZES sets
+# ``Decoder.compact_sig_size`` to 0 = forbidden).
+COMPACT_SENTINEL = 0xFFFFFFFF
+COMPACT_VERSION = 1
+#: decode-time cap on the signer bitmap (bytes) — committees up to 4096
+MAX_SIGNER_BITMAP = 512
+#: decode-time cap on compact-TC groups (distinct high_qc_rounds)
+MAX_COMPACT_GROUPS = 64
+
+#: process-wide QC-verify memo hits/misses — the ``qc_verify_cache_hit``
+#: telemetry counter reads these (co-located committees share the
+#: process, so the split is per-process, not per-node)
+QC_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def make_signer_bitmap(authors, ordered: list[PublicKey]) -> bytes:
+    """Bitmap over ``ordered`` (the round committee's ``sorted_keys()``)
+    with one bit set per author; unknown authors raise."""
+    index = {pk: i for i, pk in enumerate(ordered)}
+    bits = bytearray((len(ordered) + 7) // 8)
+    for pk in authors:
+        i = index.get(pk)
+        if i is None:
+            raise UnknownAuthority(pk)
+        bits[i // 8] |= 1 << (i % 8)
+    return bytes(bits)
+
+
+def bitmap_indices(bitmap: bytes):
+    """Set-bit positions of a signer bitmap, ascending."""
+    for byte_idx, b in enumerate(bitmap):
+        while b:
+            low = b & -b
+            yield byte_idx * 8 + low.bit_length() - 1
+            b ^= low
+
+
+def bitmap_keys(bitmap: bytes, ordered: list[PublicKey]) -> list[PublicKey]:
+    """Resolve a signer bitmap against the committee key order.  Bits
+    beyond the committee size take the UnknownAuthority path — the same
+    rule an unknown vote author hits in the vote-list form."""
+    out = []
+    for i in bitmap_indices(bitmap):
+        if i >= len(ordered):
+            raise UnknownAuthority(f"signer bit {i} of {len(ordered)}")
+        out.append(ordered[i])
+    return out
+
+
+def _popcount(bitmap: bytes) -> int:
+    return int.from_bytes(bitmap, "little").bit_count()
+
+
+def _compact_allowed(dec: Decoder) -> None:
+    if dec.compact_sig_size == 0:
+        raise CodecError(
+            "compact certificate not valid under this committee scheme"
+        )
+
+
+def _decode_agg_and_bitmap(dec: Decoder) -> tuple[Signature, bytes]:
+    agg = dec.var_bytes(_MAX_KEYSIG)
+    want = dec.compact_sig_size
+    if want is not None and len(agg) != want:
+        raise CodecError(
+            f"aggregate signature must be {want} bytes under the "
+            f"committee scheme, got {len(agg)}"
+        )
+    bitmap = dec.var_bytes(dec.compact_bitmap_max or MAX_SIGNER_BITMAP)
+    try:
+        return Signature(agg), bitmap
+    except ValueError as e:
+        raise CodecError(str(e)) from e
+
+
+def _decode_compact_version(dec: Decoder) -> None:
+    _compact_allowed(dec)
+    version = dec.u8()
+    if version != COMPACT_VERSION:
+        raise CodecError(f"unknown compact-certificate version {version}")
+
 
 # Precompiled struct layouts for the two hottest wire shapes (per-scheme
 # pk/sig sizes).  When the decoder carries the committee's sizes
@@ -138,6 +224,13 @@ class QC:
     hash: Digest = field(default_factory=Digest)
     round: Round = 0
     votes: list[tuple[PublicKey, Signature]] = field(default_factory=list)
+    # compact (aggregated) form: one G1 aggregate over the shared vote
+    # digest plus a signer bitmap over the round committee's
+    # sorted_keys() order.  ``votes`` is empty in this form; either form
+    # proves the same 2f+1 statement and both coexist on the wire
+    # (versioned sentinel encoding below).
+    agg_sig: Signature | None = None
+    signers: bytes | None = None
     # memoized wire encoding (same contract as Block._wire): the
     # committee's current high_qc is re-encoded on every ConsensusState
     # persist (once-plus per round per node) and in every block carrying
@@ -151,7 +244,29 @@ class QC:
         return cls()
 
     def is_genesis(self) -> bool:
-        return self.hash == Digest() and self.round == 0 and not self.votes
+        return (
+            self.hash == Digest()
+            and self.round == 0
+            and not self.votes
+            and self.agg_sig is None
+        )
+
+    @property
+    def is_compact(self) -> bool:
+        return self.agg_sig is not None
+
+    def signer_keys(self, committee: Committee) -> list[PublicKey]:
+        """The compact form's signers, resolved against the round
+        committee's canonical key order."""
+        return bitmap_keys(
+            self.signers, committee.for_round(self.round).sorted_keys()
+        )
+
+    def wire_size(self) -> int:
+        """Encoded certificate size in bytes (the qc_bytes metric)."""
+        enc = Encoder()
+        self.encode(enc)
+        return len(enc.finish())
 
     def timeout(self) -> bool:
         return self.hash == Digest() and self.round != 0
@@ -171,8 +286,22 @@ class QC:
         votes vs three 32+64 chunks, both 288 bytes) collide with a
         verified QC's key and skip verification for a crafted
         certificate.  Hence the vote count and a u32 length prefix per
-        field."""
+        field.  The compact form gets its own discriminator byte so an
+        aggregate certificate can never collide with a vote-list one."""
+        if self.is_compact:
+            agg = self.agg_sig.to_bytes()
+            parts = [
+                b"\x01",
+                self.hash.to_bytes(),
+                _round_le(self.round),
+                len(agg).to_bytes(4, "little"),
+                agg,
+                len(self.signers).to_bytes(4, "little"),
+                self.signers,
+            ]
+            return sha512_trunc(b"".join(parts))
         parts = [
+            b"\x00",
             self.hash.to_bytes(),
             _round_le(self.round),
             len(self.votes).to_bytes(4, "little"),
@@ -187,16 +316,30 @@ class QC:
     def check_weight(self, committee: Committee) -> None:
         """The stake/structure rules alone (no signatures): authority
         reuse, unknown authorities, 2f+1 stake — under this
-        certificate's own round's committee."""
+        certificate's own round's committee.  The compact form resolves
+        its bitmap first: a bit per member makes reuse structurally
+        impossible, but sub-quorum bitmaps and out-of-range bits fail
+        here exactly like their vote-list counterparts."""
         committee = committee.for_round(self.round)  # epoch seam
+        if self.is_compact:
+            _check_certificate_weight(
+                bitmap_keys(self.signers, committee.sorted_keys()),
+                committee,
+                QCRequiresQuorum,
+            )
+            return
         _check_certificate_weight(
             [pk for pk, _ in self.votes], committee, QCRequiresQuorum
         )
 
-    def claims(self, cache: set | None = None) -> list:
+    def claims(
+        self, cache: set | None = None, committee: Committee | None = None
+    ) -> list:
         """The signature claims an async preverifier must discharge for
         this certificate (crypto/async_service.py): one shared-message
-        claim, or none when genesis / already memoized in ``cache``.
+        claim (vote-list form) or one aggregate claim (compact form —
+        needs ``committee`` to resolve the signer bitmap), or none when
+        genesis / already memoized in ``cache``.
 
         SAFETY: a successful claim verdict proves only the SIGNATURES.
         A caller that memoizes this certificate as verified (the core's
@@ -207,7 +350,24 @@ class QC:
         if self.is_genesis():
             return []
         if cache is not None and self._cache_key() in cache:
+            QC_CACHE_STATS["hits"] += 1
             return []
+        if self.is_compact:
+            if committee is None:
+                raise ValueError(
+                    "compact QC claims need the committee to resolve "
+                    "the signer bitmap"
+                )
+            return [
+                (
+                    "agg",
+                    self.digest().to_bytes(),
+                    self.agg_sig.to_bytes(),
+                    tuple(
+                        pk.to_bytes() for pk in self.signer_keys(committee)
+                    ),
+                )
+            ]
         return [
             (
                 "shared",
@@ -238,14 +398,28 @@ class QC:
         if cache is not None:
             key = self._cache_key()
             if key in cache:
+                QC_CACHE_STATS["hits"] += 1
                 return
+            QC_CACHE_STATS["misses"] += 1
         self.check_weight(committee)
-        # One batched verification over the shared vote digest — the hot
-        # kernel (reference messages.rs:195 → crypto verify_batch).
-        if not sigs_verified and not verifier.verify_shared_msg(
-            self.digest(), self.votes
-        ):
-            raise InvalidSignature(f"bad signature in QC for {self.hash}")
+        if not sigs_verified:
+            if self.is_compact:
+                # Bitmap-selected public keys summed + ONE pairing,
+                # regardless of committee size (verify_aggregate_msg —
+                # BLS backends only; a backend without it cannot accept
+                # an aggregate certificate).
+                fn = getattr(verifier, "verify_aggregate_msg", None)
+                pks = [pk.to_bytes() for pk in self.signer_keys(committee)]
+                if fn is None or not fn(
+                    self.digest(), pks, self.agg_sig.to_bytes()
+                ):
+                    raise InvalidSignature(
+                        f"bad aggregate signature in QC for {self.hash}"
+                    )
+            # One batched verification over the shared vote digest — the
+            # hot kernel (reference messages.rs:195 → crypto verify_batch).
+            elif not verifier.verify_shared_msg(self.digest(), self.votes):
+                raise InvalidSignature(f"bad signature in QC for {self.hash}")
         if cache is not None:
             cache.add(key)
 
@@ -264,10 +438,16 @@ class QC:
         w = self._wire
         if w is None:
             e = Encoder()
-            e.raw(self.hash.to_bytes()).u64(self.round).u32(len(self.votes))
-            for pk, sig in self.votes:
-                encode_pk(e, pk)
-                encode_sig(e, sig)
+            e.raw(self.hash.to_bytes()).u64(self.round)
+            if self.is_compact:
+                e.u32(COMPACT_SENTINEL).u8(COMPACT_VERSION)
+                e.var_bytes(self.agg_sig.to_bytes())
+                e.var_bytes(self.signers)
+            else:
+                e.u32(len(self.votes))
+                for pk, sig in self.votes:
+                    encode_pk(e, pk)
+                    encode_sig(e, sig)
             w = e.finish()
             self._wire = w
         enc.raw(w)
@@ -281,6 +461,12 @@ class QC:
         h = Digest(dec.raw(Digest.SIZE))
         rnd = dec.u64()
         n = dec.u32()
+        if n == COMPACT_SENTINEL:
+            _decode_compact_version(dec)
+            agg, signers = _decode_agg_and_bitmap(dec)
+            qc = cls(hash=h, round=rnd, agg_sig=agg, signers=signers)
+            qc._wire = dec.since(start)
+            return qc
         votes = [(decode_pk(dec), decode_sig(dec)) for _ in range(n)]
         qc = cls(hash=h, round=rnd, votes=votes)
         qc._wire = dec.since(start)
@@ -297,6 +483,16 @@ class QC:
             h, rnd, n = head.unpack_from(data, start)
         except struct.error as e:
             raise CodecError(f"truncated QC header: {e}") from e
+        if n == COMPACT_SENTINEL:
+            # compact certificate under a scheme-pinned decoder: hand
+            # the tail back to the generic codec (scheme gating and
+            # size narrowing live in _decode_agg_and_bitmap)
+            dec._pos = start + head.size
+            _decode_compact_version(dec)
+            agg, signers = _decode_agg_and_bitmap(dec)
+            qc = cls(hash=Digest(h), round=rnd, agg_sig=agg, signers=signers)
+            qc._wire = data[start : dec._pos]
+            return qc
         pos = start + head.size
         end = pos + n * entry.size
         if end > len(data):
@@ -328,16 +524,48 @@ class TC:
     round: Round = 0
     # (author, signature, author's high_qc round)
     votes: list[tuple[PublicKey, Signature, Round]] = field(default_factory=list)
+    # compact (aggregated) form: per distinct high_qc_round, one G1
+    # aggregate over timeout_digest(round, hq_round) plus a signer
+    # bitmap; ``votes`` is empty in this form
+    groups: list[tuple[Round, Signature, bytes]] | None = None
+
+    @property
+    def is_compact(self) -> bool:
+        return self.groups is not None
 
     def high_qc_rounds(self) -> list[Round]:
+        if self.is_compact:
+            out: list[Round] = []
+            for hq, _, bitmap in self.groups:
+                out.extend([hq] * _popcount(bitmap))
+            return out
         return [r for _, _, r in self.votes]
 
-    def claims(self) -> list:
+    def claims(self, committee: Committee | None = None) -> list:
         """Signature claims for the async preverifier: entries signing
         the SAME timeout digest (same high_qc_round — the common storm
         shape) group into shared claims so aggregate-preferring backends
         (BLS) pay one check per group; distinct rounds become single
-        claims."""
+        claims.  The compact form emits one aggregate claim per group
+        (needs ``committee`` to resolve the signer bitmaps)."""
+        if self.is_compact:
+            if committee is None:
+                raise ValueError(
+                    "compact TC claims need the committee to resolve "
+                    "the signer bitmaps"
+                )
+            ordered = committee.for_round(self.round).sorted_keys()
+            return [
+                (
+                    "agg",
+                    timeout_digest(self.round, hq).to_bytes(),
+                    agg.to_bytes(),
+                    tuple(
+                        pk.to_bytes() for pk in bitmap_keys(bitmap, ordered)
+                    ),
+                )
+                for hq, agg, bitmap in self.groups
+            ]
         groups: dict[Round, list] = {}
         for pk, sig, hq_round in self.votes:
             groups.setdefault(hq_round, []).append((pk, sig))
@@ -367,6 +595,25 @@ class TC:
         sigs_verified: bool = False,
     ) -> None:
         committee = committee.for_round(self.round)  # epoch seam
+        if self.is_compact:
+            ordered = committee.sorted_keys()
+            authors: list[PublicKey] = []
+            for _, _, bitmap in self.groups:
+                authors.extend(bitmap_keys(bitmap, ordered))
+            # a node in two groups is authority reuse, caught here
+            _check_certificate_weight(authors, committee, TCRequiresQuorum)
+            if sigs_verified:
+                return
+            fn = getattr(verifier, "verify_aggregate_msg", None)
+            for hq, agg, bitmap in self.groups:
+                pks = [pk.to_bytes() for pk in bitmap_keys(bitmap, ordered)]
+                if fn is None or not fn(
+                    timeout_digest(self.round, hq), pks, agg.to_bytes()
+                ):
+                    raise InvalidSignature(
+                        f"bad aggregate signature in TC for round {self.round}"
+                    )
+            return
         _check_certificate_weight(
             [pk for pk, _, _ in self.votes], committee, TCRequiresQuorum
         )
@@ -389,6 +636,14 @@ class TC:
             raise InvalidSignature(f"bad signature in TC for round {self.round}")
 
     def encode(self, enc: Encoder) -> None:
+        if self.is_compact:
+            enc.u64(self.round).u32(COMPACT_SENTINEL).u8(COMPACT_VERSION)
+            enc.u8(len(self.groups))
+            for hq, agg, bitmap in self.groups:
+                enc.u64(hq)
+                enc.var_bytes(agg.to_bytes())
+                enc.var_bytes(bitmap)
+            return
         enc.u64(self.round).u32(len(self.votes))
         for pk, sig, hq in self.votes:
             encode_pk(enc, pk)
@@ -399,6 +654,20 @@ class TC:
     def decode(cls, dec: Decoder) -> "TC":
         rnd = dec.u64()
         n = dec.u32()
+        if n == COMPACT_SENTINEL:
+            _decode_compact_version(dec)
+            count = dec.u8()
+            if count > MAX_COMPACT_GROUPS:
+                raise CodecError(
+                    f"compact TC groups {count} exceed cap "
+                    f"{MAX_COMPACT_GROUPS}"
+                )
+            groups = []
+            for _ in range(count):
+                hq = dec.u64()
+                agg, bitmap = _decode_agg_and_bitmap(dec)
+                groups.append((hq, agg, bitmap))
+            return cls(round=rnd, groups=groups)
         votes = [
             (decode_pk(dec), decode_sig(dec), dec.u64()) for _ in range(n)
         ]
@@ -474,10 +743,15 @@ class Block:
             self._digest = d
         return d
 
-    def claims(self, qc_cache: set | None = None) -> list:
+    def claims(
+        self,
+        qc_cache: set | None = None,
+        committee: Committee | None = None,
+    ) -> list:
         """Signature claims for the async preverifier: the author
         signature, the embedded QC (unless memoized), and the embedded
-        TC's entries."""
+        TC's entries.  ``committee`` is required when the embedded
+        certificates are compact (signer-bitmap resolution)."""
         out = [
             (
                 "one",
@@ -486,9 +760,9 @@ class Block:
                 self.signature.to_bytes(),
             )
         ]
-        out.extend(self.qc.claims(cache=qc_cache))
+        out.extend(self.qc.claims(cache=qc_cache, committee=committee))
         if self.tc is not None:
-            out.extend(self.tc.claims())
+            out.extend(self.tc.claims(committee=committee))
         return out
 
     def verify(

@@ -11,6 +11,7 @@ from ..crypto import Digest, PublicKey
 from ..utils.codec import CodecError, Decoder, Encoder
 from .errors import SerializationError
 from .messages import (
+    MAX_SIGNER_BITMAP,
     TC,
     Block,
     Timeout,
@@ -34,6 +35,17 @@ ACK = b"Ack"
 # the accepted sizes to its own scheme (ADVICE r2: don't rely on later
 # stake/crypto checks to reject the other scheme's material).
 SCHEME_WIRE_SIZES = {"ed25519": (32, 64), "bls": (96, 48)}
+
+# Compact-certificate narrowing, same contract: (aggregate-sig size,
+# signer-bitmap byte cap) per scheme, or None when the scheme has no
+# aggregate form — then any compact certificate off the wire is a
+# CodecError, not something later stake/crypto checks must catch.  Only
+# BLS aggregates; the bitmap cap admits committees up to 4096 members
+# (messages.MAX_SIGNER_BITMAP).
+SCHEME_COMPACT_SIZES = {
+    "ed25519": None,
+    "bls": (48, MAX_SIGNER_BITMAP),
+}
 
 
 _PROPOSE_PREFIX = bytes([TAG_PROPOSE])
@@ -116,6 +128,11 @@ def decode_message(data: bytes, scheme: str | None = None):
         dec = Decoder(data)
         if sizes is not None:
             dec.pk_size, dec.sig_size = sizes
+            compact = SCHEME_COMPACT_SIZES.get(scheme)
+            if compact is None:
+                dec.compact_sig_size = 0  # scheme has no compact form
+            else:
+                dec.compact_sig_size, dec.compact_bitmap_max = compact
         tag = dec.u8()
         if tag == TAG_PROPOSE:
             out = Block.decode(dec)

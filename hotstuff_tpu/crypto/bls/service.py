@@ -51,6 +51,9 @@ class BlsVerifier:
 
     def __init__(self, aggregator: str = "cpu"):
         self._pk_cache: dict[bytes, BlsPublicKey | None] = {}
+        # signer-set digest -> aggregated G2 key (compact-QC verify);
+        # bounded in verify_aggregate_msg
+        self._agg_pk_cache: dict[bytes, BlsPublicKey] = {}
         self._tpu_agg = None
         # Native pairing (C++ port of this package, ~8x): used for
         # per-signature checks and point aggregation when the library
@@ -259,6 +262,64 @@ class BlsVerifier:
             return False
         with _spans.span("host.pairing"):
             return agg_pk.verify(msg, BlsSignature(agg))
+
+    def verify_aggregate_msg(self, digest, pks, agg_sig) -> bool:
+        """Compact-certificate verify (QC.verify / TC.verify over the
+        aggregated wire form): the signers' public keys — gathered from
+        the signer bitmap by the caller — are summed once, then ONE
+        pairing equality checks the pre-aggregated 48-byte signature,
+        regardless of committee size.
+
+        Unlike ``verify_shared_msg`` the aggregate signature arrives
+        off the WIRE (adversary-controlled), so it is subgroup-checked
+        here: the native verifier r-ladders the signature itself, and
+        the pure path decodes with the default subgroup check on.  The
+        key SUM is memoized by signer-set digest — under steady state
+        every QC carries the same (or one of a few) quorum bitmaps, so
+        repeat certificates skip the G2 sum and pay only the pairing."""
+        msg = digest if isinstance(digest, bytes) else digest.to_bytes()
+        sig_b = (
+            agg_sig if isinstance(agg_sig, bytes) else agg_sig.to_bytes()
+        )
+        if not pks or len(sig_b) != 48:
+            return False
+        pk_bytes = [
+            p if isinstance(p, bytes) else p.to_bytes() for p in pks
+        ]
+        import hashlib
+
+        set_key = hashlib.blake2b(
+            b"".join(pk_bytes), digest_size=16
+        ).digest()
+        agg_pk = self._agg_pk_cache.get(set_key)
+        if agg_pk is None:
+            with _spans.span("agg.gather"):
+                pubs = []
+                for pb in pk_bytes:
+                    pub = self._pk(pb)
+                    if pub is None:
+                        return False
+                    pubs.append(pub)
+            with _spans.span("agg.keysum"):
+                agg_pk = aggregate_public_keys(pubs)
+            if len(self._agg_pk_cache) >= 256:
+                # bounded: distinct quorum bitmaps per view are few; an
+                # adversary churning bitmaps just degrades to no-cache
+                self._agg_pk_cache.clear()
+            self._agg_pk_cache[set_key] = agg_pk
+        if self._native_verify is not None:
+            # the native verifier subgroup-checks the (wire) aggregate
+            # signature itself; the key sum is over subgroup-checked
+            # cached committee points (closure), so its ladder is skipped
+            with _spans.span("agg.pairing"):
+                return self._native_verify(
+                    msg, agg_pk.to_bytes(), sig_b, check_pk_subgroup=False
+                )
+        sig = BlsSignature.from_bytes(sig_b)  # default: subgroup-checked
+        if sig is None:
+            return False
+        with _spans.span("agg.pairing"):
+            return agg_pk.verify(msg, sig)
 
     def _grouped_batch(self, db, pb, sb):
         """Group a distinct-message batch by digest and aggregate each

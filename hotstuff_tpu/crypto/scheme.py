@@ -210,6 +210,19 @@ class DualSchemeVerifier:
             digests, pks, sigs, aggregate_ok=aggregate_ok
         )
 
+    def verify_aggregate_msg(self, digest, pks, agg_sig) -> bool:
+        """Compact-certificate verify (one agg sig + signer keys).  Only
+        the BLS side has an aggregate form, but route by key size anyway:
+        an ed25519 key set lands on a backend without the method and is
+        rejected, same as everywhere else in this class."""
+        if not pks:
+            return False
+        pk0 = pks[0] if isinstance(pks[0], bytes) else pks[0].to_bytes()
+        with _spans.span("scheme.route"):
+            backend = self._route(pk0)
+        fn = getattr(backend, "verify_aggregate_msg", None)
+        return fn is not None and fn(digest, pks, agg_sig)
+
     # boot-time hooks forwarded so device backends still warm up
     def precompute(self, pubkeys: list[bytes]) -> None:
         for pk in pubkeys:

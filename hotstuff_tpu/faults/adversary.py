@@ -295,6 +295,28 @@ class AdversaryPlane:
             votes=votes,
         )
 
+    def forged_compact_qc(self, committee, round_: int):
+        """The compact-form twin of ``forged_qc``: a quorum-popcount
+        signer bitmap over the committee's sorted key order plus a
+        seeded garbage 48-byte aggregate signature.  Passes decode and
+        ``check_weight``; aggregate verification (one pairing) MUST
+        reject it.  Consumes 48 draws (fixed per call)."""
+        from ..consensus.messages import QC, make_signer_bitmap
+        from ..crypto import Digest, Signature
+
+        ordered = committee.sorted_keys()
+        need = committee.quorum_threshold()
+        bitmap = make_signer_bitmap(ordered[:need], ordered)
+        return QC(
+            hash=Digest.of(f"byz-forged|{self.seed}|{round_}".encode()),
+            round=round_,
+            votes=[],
+            agg_sig=Signature(
+                bytes(self.rng.getrandbits(8) for _ in range(48))
+            ),
+            signers=bitmap,
+        )
+
     # ------------------------------------------------------------------
     # accounting
 

@@ -465,6 +465,88 @@ class TpuG1Aggregator:
         return G1Point(xi * z_inv % Q, yi * z_inv % Q)
 
 
+def _running_add_impl(ax, ay, az, px, py, pz):
+    """One incremental accumulate (ISSUE 9): the new point arrives as
+    PLAIN [1, NLIMBS] limb rows (byte-split on host, no bignum work),
+    Montgomery-converts in-kernel (one R^2 multiply per coordinate,
+    same trick as ``_aggregate_plain_impl``), then ``point_add``s into
+    the Montgomery-form accumulator.  The result is ``_freshen``ed:
+    unlike the log-depth aggregation tree, this chain is as deep as the
+    committee (up to 512 sequential adds), and unfreshened point_add
+    outputs compound ~x2.5 per round until the CIOS columns overflow
+    int32 (see ``_freshen``'s magnitude audit)."""
+    r2 = jnp.broadcast_to(jnp.asarray(R2_LIMBS), px.shape)
+    p = tuple(mont_mul(c, r2) for c in (px, py, pz))
+    out = point_add((ax, ay, az), p)
+    return tuple(_freshen(c) for c in out)
+
+
+_running_add_kernel = jax.jit(_running_add_impl)
+# donated variant: the previous accumulator is dead the moment the new
+# one exists — let XLA recycle its buffers across votes
+_running_add_kernel_donated = jax.jit(
+    _running_add_impl, donate_argnums=(0, 1, 2)
+)
+
+
+class TpuG1RunningSum:
+    """Device-resident incremental G1 accumulator (ISSUE 9).
+
+    ``TpuG1Aggregator`` batches the whole vote set at quorum;
+    this keeps a running Σ sig_i ON DEVICE as votes arrive — one
+    fixed-shape [1, NLIMBS] ``point_add`` dispatch per vote — so QC
+    formation at quorum is a readback of an already-computed point:
+    O(1) marginal work per vote, O(1) work at quorum.  The async
+    dispatch never blocks the caller; only ``snapshot()`` fences.
+
+    Same trust contract as the batch aggregator: callers feed subgroup
+    points (completeness of the addition law depends on it)."""
+
+    def __init__(self):
+        self._acc = None
+        self._count = 0
+        self.reset()
+
+    def reset(self) -> None:
+        # identity (0 : 1 : 0) in Montgomery form
+        self._acc = (
+            jnp.zeros((1, NLIMBS), jnp.int32),
+            jnp.asarray(to_mont_limbs(1), jnp.int32).reshape(1, NLIMBS),
+            jnp.zeros((1, NLIMBS), jnp.int32),
+        )
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    def add(self, pt: G1Point) -> None:
+        """Accumulate one point; returns immediately (async dispatch)."""
+        if pt.inf:
+            return
+        with _spans.span("agg.accumulate"):
+            xs = jnp.asarray(ints_to_limbs_batch([pt.x]))
+            ys = jnp.asarray(ints_to_limbs_batch([pt.y]))
+            zs = np.zeros((1, NLIMBS), np.int32)
+            zs[0, 0] = 1
+            kernel = (
+                _running_add_kernel_donated
+                if _donate_buffers()
+                else _running_add_kernel
+            )
+            self._acc = kernel(*self._acc, xs, ys, jnp.asarray(zs))
+            self._count += 1
+
+    def snapshot(self) -> G1Point:
+        """Fence the pending adds and read the aggregate back (affine)."""
+        with _spans.span("agg.snapshot"):
+            x, y, z = jax.block_until_ready(self._acc)
+            return TpuG1Aggregator._projective_to_affine(
+                np.asarray(x).reshape(NLIMBS),
+                np.asarray(y).reshape(NLIMBS),
+                np.asarray(z).reshape(NLIMBS),
+            )
+
+
 # ---- batched variable-base scalar multiplication ----------------------------
 # The per-entry G1 work of distinct-digest TC verification (VERDICT r5
 # item 8): r_i·H(m_i) for every entry plus the Σ r_i·sig_i aggregate.

@@ -61,13 +61,23 @@ class Decoder:
     # allows (trusted/loopback decode paths).  Narrowing this at decode
     # time keeps an ed25519 committee from parsing 96-byte BLS keys off
     # the wire at all (hostile-input surface, ADVICE r2).
-    __slots__ = ("_data", "_pos", "pk_size", "sig_size")
+    # compact_sig_size/compact_bitmap_max: the same narrowing for the
+    # compact (aggregated) certificate form — None = accept (unpinned),
+    # a positive size = enforce, 0 = the scheme has no compact form and
+    # any compact certificate is a CodecError
+    # (wire.SCHEME_COMPACT_SIZES).
+    __slots__ = (
+        "_data", "_pos", "pk_size", "sig_size",
+        "compact_sig_size", "compact_bitmap_max",
+    )
 
     def __init__(self, data: bytes):
         self._data = data
         self._pos = 0
         self.pk_size: int | None = None
         self.sig_size: int | None = None
+        self.compact_sig_size: int | None = None
+        self.compact_bitmap_max: int | None = None
 
     def _take(self, n: int) -> bytes:
         if self._pos + n > len(self._data):

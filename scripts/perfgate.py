@@ -16,6 +16,10 @@ non-zero when either guarded metric regresses past the threshold
   * ``mesh_train.mesh_scaling_efficiency`` — per-mesh-size sustained
     train sigs/s at the largest mesh vs single-device (ISSUE 7; wide
     per-guard 50% gate — the virtual CPU mesh is noisy)
+  * ``agg_qc.verify_p50_ms`` — compact-QC one-pairing verify at the
+    largest benched committee (ISSUE 9; per-guard 75% gate — the value
+    is a single host pairing, so only a structural regression such as
+    losing the key-sum memo or the native pairing should trip it)
 
 ``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
 (ISSUE 6): the fresh value must stay within ``--ratchet-slack``
@@ -82,6 +86,17 @@ GUARDS = (
         ),
         -1,
         0.5,
+    ),
+    # compact-QC verify (ISSUE 9): ONE pairing over the memoized key sum
+    # at the largest benched committee.  Skip-if-missing covers
+    # references predating the agg_qc block; the wide 75% per-guard gate
+    # tolerates host pairing jitter while still catching a lost memo or
+    # a fall off the native pairing path (both are >2x).
+    (
+        "agg_qc.verify_p50_ms",
+        lambda doc: (doc.get("agg_qc") or {}).get("verify_p50_ms"),
+        +1,
+        0.75,
     ),
 )
 

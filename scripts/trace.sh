@@ -28,6 +28,11 @@
 #                                 # 8-device CPU mesh, non-zero exit if
 #                                 # mesh-8 scaling efficiency falls
 #                                 # below the committed-reference floor
+#   AGG=1 scripts/trace.sh        # ONLY the compact-certificate sweep
+#                                 # (scripts/agg_check.py): compact vs
+#                                 # vote-list QC parity + one-pairing
+#                                 # flatness across committee sizes,
+#                                 # non-zero exit on any divergence
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -40,6 +45,11 @@ fi
 if [ "${MESH:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/mesh_check.py "$@"
+fi
+
+if [ "${AGG:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/agg_check.py "$@"
 fi
 
 if [ "${BYZ:-0}" = "1" ]; then

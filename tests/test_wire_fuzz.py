@@ -199,3 +199,261 @@ def test_adversarial_well_formed_frames_decode_then_fail_verify():
             buf = bytearray(f)
             buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
             _decode_must_not_crash(bytes(buf))
+
+
+# ---------------------------------------------------------------------------
+# compact-certificate corpus (ISSUE 9): the aggregated QC/TC wire form
+# is a NEW attack surface — a sentinel vote count, a version byte, one
+# aggregate signature and a signer bitmap.  Malformed variants must die
+# in the codec (SerializationError) or in verification (ConsensusError),
+# never as an unhandled crash, and never be silently accepted.
+
+
+def _bls_compact_fixture(n: int = 4):
+    """(committee, sorted pks, quorum votes, compact QC) over one block
+    digest, using small-scalar secrets (bench.py fixture idiom)."""
+    from hotstuff_tpu.consensus.config import Committee
+    from hotstuff_tpu.consensus.messages import QC, make_signer_bitmap
+    from hotstuff_tpu.crypto import PublicKey
+    from hotstuff_tpu.crypto.bls import BlsSecretKey, prove_possession
+    from hotstuff_tpu.crypto.bls.curve import G1Point
+
+    sks = [BlsSecretKey(i + 2) for i in range(n)]
+    by_pk = {PublicKey(sk.public_key().to_bytes()): sk for sk in sks}
+    com = Committee.new(
+        [
+            (pk, 1, ("127.0.0.1", 23_000 + i))
+            for i, pk in enumerate(sorted(by_pk))
+        ],
+        scheme="bls",
+        pops={pk: prove_possession(sk).to_bytes() for pk, sk in by_pk.items()},
+    )
+    pks = com.sorted_keys()
+    digest = Digest.of(b"compact fuzz block")
+    qc_probe = QC(hash=digest, round=9)
+    msg = qc_probe.digest().to_bytes()
+    quorum = com.quorum_threshold()
+    votes = [
+        (pk, Signature(by_pk[pk].sign(msg).to_bytes()))
+        for pk in pks[:quorum]
+    ]
+    agg = G1Point.sum(
+        [
+            G1Point.from_bytes(sig.to_bytes(), subgroup_check=False)
+            for _, sig in votes
+        ]
+    ).to_bytes()
+    qc = QC(
+        hash=digest,
+        round=9,
+        votes=[],
+        agg_sig=Signature(agg),
+        signers=make_signer_bitmap([pk for pk, _ in votes], pks),
+    )
+    return com, pks, votes, qc
+
+
+def test_compact_qc_wire_corpus():
+    """Truncations, bitmap/size mismatches, sub-quorum bitmaps and
+    garbage aggregates: clean decode errors or verification rejections
+    only."""
+    from hotstuff_tpu.consensus.errors import (
+        ConsensusError,
+        QCRequiresQuorum,
+    )
+    from hotstuff_tpu.consensus.messages import (
+        COMPACT_SENTINEL,
+        MAX_SIGNER_BITMAP,
+        QC,
+        make_signer_bitmap,
+    )
+    from hotstuff_tpu.crypto.scheme import make_cpu_verifier
+    from hotstuff_tpu.utils.codec import Encoder
+
+    com, pks, votes, qc = _bls_compact_fixture()
+    verifier = make_cpu_verifier("bls")
+
+    # the genuine compact certificate round-trips under the pinned
+    # decoder and verifies (inside a timeout frame — QCs never travel
+    # bare)
+    pk0 = pks[0]
+    frame = bytes([2])  # TAG_TIMEOUT
+    enc = Encoder()
+    qc.encode(enc)
+    from hotstuff_tpu.consensus.messages import encode_pk
+
+    enc.u64(9)
+    encode_pk(enc, pk0)
+    enc.var_bytes(b"\x00" * 48)  # placeholder timeout signature
+    frame += enc.finish()
+    _, timeout = decode_message(frame, scheme="bls")
+    assert timeout.high_qc.is_compact
+    assert timeout.high_qc.wire_size() == qc.wire_size()
+    timeout.high_qc.verify(com, verifier)  # must not raise
+
+    # 1. truncated bitmap / truncated aggregate: every prefix of the
+    #    compact frame dies cleanly in the codec
+    for cut in range(len(frame)):
+        try:
+            decode_message(frame[:cut], scheme="bls")
+        except SerializationError:
+            pass
+
+    # 2. aggregate-size mismatch: a 64-byte "aggregate" under the BLS
+    #    scheme pin (48) is a codec error, not crypto's problem
+    wrong = Encoder()
+    wrong.raw(qc.hash.to_bytes()).u64(qc.round)
+    wrong.u32(COMPACT_SENTINEL).u8(1)
+    wrong.var_bytes(b"\x11" * 64)  # ed25519-sized blob
+    wrong.var_bytes(qc.signers)
+    bad_qc_wire = wrong.finish()
+    tc_like = bytes([2]) + bad_qc_wire + frame[1 + qc.wire_size():]
+    with pytest.raises(SerializationError):
+        decode_message(tc_like, scheme="bls")
+
+    # 3. bitmap above the decode cap dies in the codec
+    huge = Encoder()
+    huge.raw(qc.hash.to_bytes()).u64(qc.round)
+    huge.u32(COMPACT_SENTINEL).u8(1)
+    huge.var_bytes(qc.agg_sig.to_bytes())
+    huge.var_bytes(b"\xff" * (MAX_SIGNER_BITMAP + 1))
+    with pytest.raises(SerializationError):
+        decode_message(
+            bytes([2]) + huge.finish() + frame[1 + qc.wire_size():],
+            scheme="bls",
+        )
+
+    # 4. sub-quorum bitmap: decodes fine (structure is legal), fails
+    #    check_weight exactly like a sub-quorum vote list
+    sub = QC(
+        hash=qc.hash,
+        round=qc.round,
+        votes=[],
+        agg_sig=qc.agg_sig,
+        signers=make_signer_bitmap([pks[0]], pks),
+    )
+    with pytest.raises(QCRequiresQuorum):
+        sub.check_weight(com)
+
+    # 5. out-of-range signer bit: bit index beyond the committee takes
+    #    the UnknownAuthority path in verification, never a crash
+    oob = QC(
+        hash=qc.hash,
+        round=qc.round,
+        votes=[],
+        agg_sig=qc.agg_sig,
+        signers=qc.signers[:-1] + bytes([qc.signers[-1] | 0xF0]),
+    )
+    with pytest.raises(ConsensusError):
+        oob.check_weight(com)
+
+    # 6. garbage aggregate over a valid quorum bitmap: decodes cleanly,
+    #    MUST fail verify (the one-pairing check), not decode
+    garbage = QC(
+        hash=qc.hash,
+        round=qc.round,
+        votes=[],
+        agg_sig=Signature(b"\x99" * 48),
+        signers=qc.signers,
+    )
+    garbage.check_weight(com)  # structurally a quorum
+    with pytest.raises(ConsensusError):
+        garbage.verify(com, verifier)
+
+    # 7. an ed25519-pinned decoder refuses ANY compact certificate —
+    #    the scheme has no aggregate form, so the sentinel itself is
+    #    malformed input
+    with pytest.raises(SerializationError):
+        decode_message(frame, scheme="ed25519")
+
+    # 8. single-byte mutations of the genuine compact frame never crash
+    rng = random.Random(0xF026)
+    for _ in range(300):
+        buf = bytearray(frame)
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        try:
+            decode_message(bytes(buf), scheme="bls")
+        except SerializationError:
+            pass
+
+
+def test_compact_tc_wire_corpus():
+    """The compact TC's per-group form: group-count cap, per-group
+    bitmap rules, and garbage aggregates failing verify not decode."""
+    from hotstuff_tpu.consensus.errors import ConsensusError
+    from hotstuff_tpu.consensus.messages import (
+        MAX_COMPACT_GROUPS,
+        TC,
+        make_signer_bitmap,
+        timeout_digest,
+    )
+    from hotstuff_tpu.crypto.bls import BlsSecretKey
+    from hotstuff_tpu.crypto.bls.curve import G1Point
+    from hotstuff_tpu.crypto.scheme import make_cpu_verifier
+
+    com, pks, _, _ = _bls_compact_fixture()
+    verifier = make_cpu_verifier("bls")
+    by_pk = {}
+    for i in range(len(pks)):
+        sk = BlsSecretKey(i + 2)
+        from hotstuff_tpu.crypto import PublicKey
+
+        by_pk[PublicKey(sk.public_key().to_bytes())] = sk
+
+    # genuine compact TC: quorum split across two high-qc-round groups
+    def group(authors, hq):
+        msg = timeout_digest(11, hq).to_bytes()
+        sigs = [
+            G1Point.from_bytes(
+                by_pk[pk].sign(msg).to_bytes(), subgroup_check=False
+            )
+            for pk in authors
+        ]
+        return (
+            hq,
+            Signature(G1Point.sum(sigs).to_bytes()),
+            make_signer_bitmap(authors, pks),
+        )
+
+    tc = TC(round=11, votes=[], groups=[group(pks[:2], 8), group(pks[2:3], 9)])
+    frame = encode_tc(tc)
+    _, decoded = decode_message(frame, scheme="bls")
+    assert decoded.is_compact
+    assert sorted(decoded.high_qc_rounds()) == [8, 8, 9]
+    decoded.verify(com, verifier)  # must not raise
+
+    # a node present in TWO groups is authority reuse
+    dup = TC(round=11, votes=[], groups=[group(pks[:2], 8), group(pks[1:3], 9)])
+    with pytest.raises(ConsensusError):
+        dup.verify(com, verifier)
+
+    # garbage aggregate in one group: decodes, fails verify
+    g8, g9 = tc.groups
+    forged = TC(
+        round=11,
+        votes=[],
+        groups=[g8, (g9[0], Signature(b"\x42" * 48), g9[2])],
+    )
+    _, fdec = decode_message(encode_tc(forged), scheme="bls")
+    with pytest.raises(ConsensusError):
+        fdec.verify(com, verifier)
+
+    # group count over the cap dies in the codec
+    from hotstuff_tpu.consensus.messages import COMPACT_SENTINEL
+    from hotstuff_tpu.utils.codec import Encoder
+
+    enc = Encoder().u8(3)  # TAG_TC
+    enc.u64(11).u32(COMPACT_SENTINEL).u8(1)
+    enc.u8(MAX_COMPACT_GROUPS + 1)
+    with pytest.raises(SerializationError):
+        decode_message(enc.finish(), scheme="bls")
+
+    # mutations of the genuine compact TC frame never crash
+    rng = random.Random(0xF027)
+    for _ in range(300):
+        buf = bytearray(frame)
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        try:
+            decode_message(bytes(buf), scheme="bls")
+        except SerializationError:
+            pass
