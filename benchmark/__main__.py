@@ -78,6 +78,54 @@ def task_local(args) -> int:
     return 0
 
 
+def task_load(args) -> int:
+    """Saturation sweep through the admission-controlled ingest plane
+    (benchmark/loadgen.py, docs/LOAD.md): walk the offered rate up
+    until goodput plateaus, then drive 2x saturation against a small
+    proposer buffer and check the backpressure invariant (sheds
+    observed, zero silent drop-newest).  Prints the ``+ LOAD`` SUMMARY
+    block plus one machine-readable JSON line; exit code 1 when the
+    overload run recorded silent drops."""
+    import json
+
+    from .loadgen import format_load_block, run_sweep
+
+    result = run_sweep(
+        nodes=args.nodes,
+        start_rate=args.start_rate,
+        duration=args.duration,
+        max_steps=args.max_steps,
+        clients=args.clients,
+        conns_per_node=args.conns,
+        tx_size=args.tx_size,
+        seed=args.seed,
+        overload_max_pending=args.overload_max_pending,
+    )
+    block = (
+        "\n"
+        "-----------------------------------------\n"
+        " SUMMARY:\n"
+        "-----------------------------------------\n"
+        + format_load_block(result)
+        + "-----------------------------------------\n"
+    )
+    print(block)
+    _save_result(
+        block,
+        0,
+        args.nodes,
+        result["saturation_tx_s"],
+        "load",
+        ok=result["goodput_tx_s"] > 0,
+    )
+    # last line: the machine-readable document (scripts/load_check.py)
+    print(json.dumps({"load": result}, default=str))
+    if result["overload"]["drop_newest"]:
+        Print.error("overload run recorded SILENT proposer drops")
+        return 1
+    return 0
+
+
 def task_chaos(args) -> int:
     """One committee run under a seeded fault scenario, with the
     committee-wide safety/liveness invariant verdict appended to the
@@ -413,6 +461,39 @@ def main(argv=None) -> int:
         "co-location artifact",
     )
     p.set_defaults(fn=task_local)
+
+    p = sub.add_parser(
+        "load",
+        help="saturation sweep through the admission-controlled ingest "
+        "plane: open-loop Poisson client fleet, credit-honoring, "
+        "goodput-plateau detection + 2x-overload backpressure check "
+        "(docs/LOAD.md)",
+    )
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument(
+        "--start-rate",
+        type=int,
+        default=500,
+        help="first offered rate of the sweep (doubles per step)",
+    )
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds per sweep step")
+    p.add_argument("--max-steps", type=int, default=6)
+    p.add_argument("--clients", type=int, default=64,
+                   help="virtual clients modeled by the fleet")
+    p.add_argument("--conns", type=int, default=2,
+                   help="connections per node")
+    p.add_argument("--tx-size", type=int, default=512)
+    p.add_argument("--seed", type=int, default=1,
+                   help="Poisson arrival-process seed")
+    p.add_argument(
+        "--overload-max-pending",
+        type=int,
+        default=2_000,
+        help="proposer buffer cap for the 2x-overload run (small so a "
+        "short window can actually reach the shed watermark)",
+    )
+    p.set_defaults(fn=task_load)
 
     p = sub.add_parser(
         "chaos",

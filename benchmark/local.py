@@ -226,6 +226,29 @@ class LocalBench:
             self.transport,
         ]
 
+    def _client_cmd(self, py: str) -> list[str]:
+        """The client process command line — subclass hook (LoadBench
+        replaces the fixed-burst client with the Poisson fleet)."""
+        return [
+            py,
+            "-m",
+            "hotstuff_tpu.node.client",
+            "--committee",
+            PathMaker.committee_file(),
+            "--rate",
+            str(self.rate),
+            "--size",
+            str(self.tx_size),
+            "--homes",
+            str(self.payload_homes),
+            "--duration",
+            str(self.duration),
+            "--warmup",
+            "2",
+            "--faults",
+            str(self.faults),
+        ]
+
     def _spawn_node(self, i: int, append: bool = False) -> subprocess.Popen:
         """Boot (or, with ``append=True``, re-boot) node ``i`` as its
         own process.  The store persists across restarts, so a respawned
@@ -327,29 +350,9 @@ class LocalBench:
                 for i in range(self.nodes - self.faults):
                     self._spawn_node(i)
 
-            # Launch the producer-path client.
-            self._spawn(
-                [
-                    py,
-                    "-m",
-                    "hotstuff_tpu.node.client",
-                    "--committee",
-                    PathMaker.committee_file(),
-                    "--rate",
-                    str(self.rate),
-                    "--size",
-                    str(self.tx_size),
-                    "--homes",
-                    str(self.payload_homes),
-                    "--duration",
-                    str(self.duration),
-                    "--warmup",
-                    "2",
-                    "--faults",
-                    str(self.faults),
-                ],
-                PathMaker.client_log_file(),
-            )
+            # Launch the producer-path client (subclass hook: LoadBench
+            # swaps in the credit-aware open-loop fleet, loadgen.py).
+            self._spawn(self._client_cmd(py), PathMaker.client_log_file())
 
             # Wait for the client to actually START sending before timing
             # the measurement window: boot cost varies hugely (CPU runs

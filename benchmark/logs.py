@@ -270,16 +270,39 @@ class LogParser:
         duration = max(end - self.client_start, 1e-9)
         return self.committed_payloads() / duration, duration
 
-    def end_to_end_latency(self) -> float | None:
-        """Mean sample-payload send -> containing-block commit latency (s).
-        None when no sample payload landed in the window — reporting 0 ms
-        for "no data" would read as a (great) measurement."""
+    def _sample_latencies(self) -> list[float]:
+        """Send -> containing-block commit latency (s) per committed
+        sample payload."""
         lat = []
         for payload, sent in self.samples.items():
             block = self.payload_to_block.get(payload)
             if block is not None and block in self.commits:
                 lat.append(self.commits[block] - sent)
+        return lat
+
+    def end_to_end_latency(self) -> float | None:
+        """Mean sample-payload send -> containing-block commit latency (s).
+        None when no sample payload landed in the window — reporting 0 ms
+        for "no data" would read as a (great) measurement."""
+        lat = self._sample_latencies()
         return mean(lat) if lat else None
+
+    def end_to_end_latency_percentiles(self) -> tuple[float, float] | None:
+        """(p50, p99) over the sample-latency population (s), or None
+        without committed samples.  Nearest-rank on the sorted
+        latencies: the population is small (one tagged sample per
+        burst), so interpolation would manufacture precision the
+        samples don't carry."""
+        lat = sorted(self._sample_latencies())
+        if not lat:
+            return None
+
+        def rank(p: float) -> float:
+            import math
+
+            return lat[min(len(lat) - 1, math.ceil(p * len(lat)) - 1)]
+
+        return rank(0.50), rank(0.99)
 
     def commit_round_gap(self) -> tuple[float, int] | None:
         """(mean, max) gap between consecutive COMMITTED rounds, or None
@@ -307,6 +330,13 @@ class LogParser:
         e2e_lat_txt = (
             f"{round(e2e_lat * 1000)} ms" if e2e_lat is not None
             else "n/a (no sample payload committed in the window)"
+        )
+        pcts = self.end_to_end_latency_percentiles()
+        e2e_pct_txt = (
+            f" End-to-end latency p50/p99:"
+            f" {round(pcts[0] * 1000)} / {round(pcts[1] * 1000)} ms\n"
+            if pcts is not None
+            else ""
         )
         # the latency population is payload-carrying blocks (see
         # consensus_latency): a window with only empty 2-chain-driver
@@ -349,7 +379,8 @@ class LogParser:
             f" End-to-end TPS: {round(e_tps)} payloads/s\n"
             + e_bps_txt
             + f" End-to-end latency: {e2e_lat_txt}\n"
-            f" Committed blocks: {len(self.commits)}\n"
+            + e2e_pct_txt
+            + f" Committed blocks: {len(self.commits)}\n"
             f" View-change timeouts: {self.timeouts}\n"
             + self._round_gap_txt()
             + f" Client rate warnings: {self.rate_warnings}\n"

@@ -131,6 +131,17 @@ def run_scaling(
                     "qc_bytes": parser.qc_wire_bytes or 0,
                     "agg_claims": parser.agg_claims,
                     "compact_qcs": parser.compact_qcs,
+                    # ingest-plane columns (ISSUE 10): admission sheds
+                    # and silent proposer drops, committee-wide — the
+                    # second is nonzero only when backpressure failed
+                    "ingest_shed": sum(
+                        (s.get("ingest") or {}).get("shed_total", 0)
+                        for s in stats
+                    ),
+                    "ingest_drops": sum(
+                        (s.get("ingest") or {}).get("drop_newest", 0)
+                        for s in stats
+                    ),
                 }
             )
     finally:
@@ -148,7 +159,8 @@ def format_report(
         "",
         f"{'nodes':>6} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
         f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p/m':>13} "
-        f"{'qc B':>6} {'agg':>5} {'pred 1-core/node':>17}",
+        f"{'qc B':>6} {'agg':>5} {'shed':>6} {'dropN':>5} "
+        f"{'pred 1-core/node':>17}",
     ]
     for r in rows:
         window = max(r["window_s"], 1e-9)
@@ -172,11 +184,16 @@ def format_report(
         qc_txt = f"{qc_bytes}" if qc_bytes else "-"
         agg_claims = r.get("agg_claims", 0)
         agg_txt = f"{agg_claims}" if agg_claims else "-"
+        shed = r.get("ingest_shed", 0)
+        shed_txt = f"{shed}" if shed else "-"
+        drops = r.get("ingest_drops", 0)
+        drops_txt = f"{drops}" if drops else "-"
         lines.append(
             f"{r['nodes']:>6} {r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
             f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>13} "
-            f"{qc_txt:>6} {agg_txt:>5} {predicted:>17.0f}"
+            f"{qc_txt:>6} {agg_txt:>5} {shed_txt:>6} {drops_txt:>5} "
+            f"{predicted:>17.0f}"
         )
     lines += [
         "",
@@ -199,6 +216,10 @@ def format_report(
         "served by the aggregate one-pairing route (BLS compact form: "
         "48 B agg sig + ceil(n/8) B signer bitmap vs n x 144 B vote "
         "lists; '-' for ed25519 vote-list committees);",
+        "- shed / dropN: payloads the ingest plane shed with a typed "
+        "BUSY reply vs payloads SILENTLY dropped at the full proposer "
+        "buffer — dropN must stay '-' whenever admission control is "
+        "doing its job (docs/LOAD.md);",
         "- pred: payloads/s one node sustains on a DEDICATED core (the "
         "reference topology, one host per node) = 1/c.  Committee size "
         "multiplies the fleet's total work, not the per-node cost, so "

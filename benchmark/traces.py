@@ -181,6 +181,10 @@ class TraceSet:
         self.byz_spans: list[tuple[str, str, int, int | None]] = []
         # individual attack events: (w_corr, node, kind, round)
         self.byz_events: list[tuple[int, str, str, int]] = []
+        # ingest-plane records (ISSUE 10): (w_corr, node, kind, value).
+        # "shed" carries the shed payload count in the value, "credit"
+        # the granted credit window (sampled every 64th decision).
+        self.ingest_events: list[tuple[int, str, str, int]] = []
         # verify-pipeline profiler spans (ISSUE 4): node -> list of
         # (stage, w_end_corr, dur_ns).  A span record's timestamps mark
         # the span's END; its duration rides in the "u" field.
@@ -234,6 +238,19 @@ class TraceSet:
                         self.byz_events.append(
                             (w, node, kind, int(r.get("r", 0)))
                         )
+                    continue
+                if e.startswith("ingest."):
+                    # admission-plane records must never reach _block
+                    # either ("d" is None); the shed count / credit
+                    # window rides the "u" field
+                    self.ingest_events.append(
+                        (
+                            self._corr(node, r["w"]),
+                            node,
+                            e[len("ingest."):],
+                            int(r.get("u") or 0),
+                        )
+                    )
                     continue
                 if e in ("tc", "round.enter", "recv.timeout", "recv.tc",
                          "sync.req", "sync.reply", "sync.done",
@@ -314,6 +331,7 @@ class TraceSet:
             self.byz_spans.append((node, label, w, None))
         self.byz_spans.sort(key=lambda s: s[2])
         self.byz_events.sort()
+        self.ingest_events.sort()
 
     # ---- derived views -----------------------------------------------------
 
@@ -470,6 +488,25 @@ class TraceSet:
                 + (f"; attacks: {shown}" if shown else "")
                 + "\n"
             )
+        if self.ingest_events:
+            shed = sum(
+                v for _w, _n, k, v in self.ingest_events if k == "shed"
+            )
+            credits = [
+                v for _w, _n, k, v in self.ingest_events if k == "credit"
+            ]
+            nodes = sorted({n for _w, n, _k, _v in self.ingest_events})
+            lines.append(
+                f" Ingest plane journaled: {len(self.ingest_events)}"
+                f" edge(s) on {', '.join(nodes)};"
+                f" payloads shed: {shed}"
+                + (
+                    f"; credit window mean {mean(credits):.0f}"
+                    if credits
+                    else ""
+                )
+                + "\n"
+            )
         if self.verify_spans:
             total: Counter = Counter()
             count = 0
@@ -519,6 +556,7 @@ class TraceSet:
         anchors.extend(w for _, _, w, _ in self.byz_spans)
         anchors.extend(w for _, _, _, w in self.byz_spans if w is not None)
         anchors.extend(w for w, _, _, _ in self.byz_events)
+        anchors.extend(w for w, _, _, _ in self.ingest_events)
         for rows in self.verify_spans.values():
             # a span's start = its end stamp minus its duration
             anchors.extend(w - dur for _, w, dur in rows)
@@ -702,6 +740,62 @@ class TraceSet:
                         "args": {"kind": kind, "round": rnd, "node": node},
                     }
                 )
+        if self.ingest_events:
+            # dedicated ingest-plane track (one pid past the adversary
+            # plane): per-node lanes with admission sheds as instant
+            # markers and the granted credit window as a counter series
+            ingest_pid = len(self.nodes) + 2
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": ingest_pid,
+                    "tid": 0,
+                    "args": {"name": "ingest plane"},
+                }
+            )
+            lanes = sorted({n for _w, n, _k, _v in self.ingest_events})
+            tid_of = {n: i for i, n in enumerate(lanes)}
+            for n, tid in tid_of.items():
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": ingest_pid,
+                        "tid": tid,
+                        "args": {"name": f"ingest {n}"},
+                    }
+                )
+            for w, node, kind, value in self.ingest_events:
+                if kind == "credit":
+                    events.append(
+                        {
+                            "name": "ingest credit",
+                            "cat": "ingest",
+                            "ph": "C",
+                            "pid": ingest_pid,
+                            "tid": tid_of[node],
+                            "ts": us(w),
+                            "args": {"credit": value},
+                        }
+                    )
+                else:
+                    events.append(
+                        {
+                            "name": f"ingest {kind} x{value}",
+                            "cat": "ingest",
+                            "ph": "i",
+                            "s": "t",
+                            "pid": ingest_pid,
+                            "tid": tid_of[node],
+                            "ts": us(w),
+                            "args": {
+                                "kind": kind,
+                                "count": value,
+                                "node": node,
+                            },
+                        }
+                    )
         for node, rows in sorted(self.verify_spans.items()):
             # verify-pipeline profiler track (ISSUE 4): one thread lane
             # under the journaling node's process, so the dispatch

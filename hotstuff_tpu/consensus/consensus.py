@@ -34,12 +34,14 @@ from .wire import (
     ACK,
     SCHEME_WIRE_SIZES,
     TAG_PRODUCER,
+    TAG_PRODUCER_V2,
     TAG_PROPOSE,
     TAG_SYNC_REQUEST,
     TAG_TC,
     TAG_TIMEOUT,
     TAG_VOTE,
     decode_message,
+    encode_ingest_ack,
 )
 
 log = logging.getLogger(__name__)
@@ -121,7 +123,10 @@ class PayloadBodies:
 
 class ConsensusReceiverHandler:
     #: wire tag -> label on the received-message counters (index == tag)
-    TAG_NAMES = ("propose", "vote", "timeout", "tc", "sync_request", "producer")
+    TAG_NAMES = (
+        "propose", "vote", "timeout", "tc", "sync_request", "producer",
+        "producer_v2",
+    )
 
     def __init__(
         self,
@@ -131,10 +136,15 @@ class ConsensusReceiverHandler:
         scheme: str | None = None,
         bodies: PayloadBodies | None = None,
         telemetry=None,
+        admission=None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
         self.tx_producer = tx_producer
+        # Ingest admission controller (ingest/admission.py): every
+        # producer frame consults it; None keeps the legacy
+        # always-accept path (bare component tests).
+        self.admission = admission
         # fail at construction (node boot), not per-message in dispatch
         if scheme is not None and scheme not in SCHEME_WIRE_SIZES:
             raise ValueError(f"unknown committee scheme '{scheme}'")
@@ -204,6 +214,9 @@ class ConsensusReceiverHandler:
                 # producer-channel edge (ROADMAP PR 2 follow-up): lets
                 # traces attribute payload starvation vs consensus stall
                 j.record("recv.producer", 0, payload[0], "client")
+            elif tag == TAG_PRODUCER_V2:
+                # sampled: the batch's first digest stands for the frame
+                j.record("recv.producer", 0, payload[0][0], "client")
         if tag == TAG_SYNC_REQUEST:
             await self.tx_helper.put(payload)
         elif tag == TAG_PROPOSE:
@@ -225,13 +238,73 @@ class ConsensusReceiverHandler:
                         "match its digest"
                     )
                     return
-                if self.bodies is not None:
-                    await self.bodies.admit(digest, body)
+            if self.admission is not None:
+                decision = self.admission.admit(1)
+                if decision.shed:
+                    # typed BUSY instead of a silent drop: the legacy
+                    # b"Ack" stays byte-compatible on the accept path,
+                    # v1 clients that don't parse the busy frame just
+                    # discard it and retry at their own pace
+                    try:
+                        await writer.send(
+                            encode_ingest_ack(
+                                0,
+                                decision.shed,
+                                decision.credit,
+                                decision.retry_after_ms,
+                            )
+                        )
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+            if body and self.bodies is not None:
+                await self.bodies.admit(digest, body)
             try:
                 await writer.send(ACK)
             except (ConnectionError, OSError):
                 pass
             await self.tx_producer.put(digest)
+        elif tag == TAG_PRODUCER_V2:
+            # content addressing first: poisoned items are dropped and
+            # never consume admission credit (a client can't burn the
+            # committee's window with garbage bodies)
+            from ..crypto import Digest
+
+            valid = []
+            for digest, body in payload:
+                if body and Digest.of(body) != digest:
+                    log.warning(
+                        "Dropping batched producer payload whose body "
+                        "does not match its digest"
+                    )
+                    if self._dropped is not None:
+                        self._dropped.inc()
+                    continue
+                valid.append((digest, body))
+            if self.admission is not None:
+                decision = self.admission.admit(len(valid))
+            else:
+                from ..ingest import Decision
+
+                decision = Decision(len(valid), 0, 0, 0)
+            # the accepted prefix enters; the shed suffix is the
+            # client's to resubmit after retry_after_ms (order is
+            # preserved on the wire, so "first N" is well-defined)
+            for digest, body in valid[: decision.accepted]:
+                if body and self.bodies is not None:
+                    await self.bodies.admit(digest, body)
+                await self.tx_producer.put(digest)
+            try:
+                await writer.send(
+                    encode_ingest_ack(
+                        decision.accepted,
+                        decision.shed,
+                        decision.credit,
+                        decision.retry_after_ms,
+                    )
+                )
+            except (ConnectionError, OSError):
+                pass
         else:
             await self.tx_consensus.put((tag, payload))
 
@@ -246,6 +319,7 @@ class Consensus:
         self.helper: Helper | None = None
         self.synchronizer: Synchronizer | None = None
         self.tx_producer: asyncio.Queue | None = None
+        self.admission = None
         self._tasks: list[asyncio.Task] = []
 
     @classmethod
@@ -292,6 +366,15 @@ class Consensus:
                     "evicted": b.evicted,
                 },
             )
+        # Ingest admission controller (ingest/admission.py): constructed
+        # before the receiver so the handler can consult it from the
+        # first frame; bound to the proposer's buffer once the proposer
+        # exists below (until then occupancy reads 0 — boot window).
+        from ..ingest import AdmissionController
+
+        admission = AdmissionController(
+            journal=telemetry.journal if telemetry is not None else None,
+        )
         tx_producer: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         # The core's three select sources merge into ONE event queue
         # (core.make_event_channels); producers keep channel-shaped
@@ -407,6 +490,7 @@ class Consensus:
                 scheme=committee.wire_scheme(),
                 bodies=payload_bodies,
                 telemetry=telemetry,
+                admission=admission,
             ),
             fault_plane=fault_plane,
         )
@@ -546,8 +630,52 @@ class Consensus:
             network=make_reliable(),
             telemetry=telemetry,
             adversary=adversary,
+            admission=admission,
         )
         self._tasks.append(self.proposer.spawn())
+        self.admission = admission
+        # Credit windows now track the real buffer: occupancy is the
+        # proposer's pending map, capacity its (env-tunable) cap.
+        admission.bind(
+            lambda p=self.proposer: len(p.pending),
+            capacity=self.proposer.max_pending,
+        )
+        if telemetry is not None:
+            telemetry.gauge(
+                "ingest_credit",
+                "Current admission credit window (payloads)",
+                fn=lambda a=admission: a.last_credit,
+            )
+            telemetry.gauge(
+                "ingest_accepted",
+                "Producer payloads admitted by the ingest plane",
+                fn=lambda a=admission: a.accepted_total,
+            )
+            telemetry.gauge(
+                "ingest_shed",
+                "Producer payloads shed with a typed BUSY reply",
+                fn=lambda a=admission: a.shed_total,
+            )
+            telemetry.gauge(
+                "ingest_busy_frames",
+                "Producer frames answered with a BUSY ingest ACK",
+                fn=lambda a=admission: a.busy_frames,
+            )
+            telemetry.gauge(
+                "ingest_connections",
+                "Live accepted connections on the consensus port",
+                fn=lambda r=self.receiver: getattr(r, "connections", 0),
+            )
+            # one section carries the whole admission story: the
+            # controller's own counters plus the buffer's silent-drop
+            # count (zero whenever backpressure is doing its job)
+            telemetry.add_section(
+                "ingest",
+                lambda a=admission, p=self.proposer: {
+                    **a.stats(),
+                    "drop_newest": p.drop_newest,
+                },
+            )
 
         self.helper = Helper(
             committee,

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from collections import OrderedDict
 
 from ..crypto import Digest, PublicKey, SignatureService
@@ -59,6 +60,16 @@ SEEN_CAP = 200_000
 MAX_INFLIGHT = 1_024
 
 
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return default
+
+
 class Proposer:
     def __init__(
         self,
@@ -71,8 +82,22 @@ class Proposer:
         network: ReliableSender | None = None,
         telemetry=None,
         adversary=None,
+        admission=None,
     ):
         self.name = name
+        # Ingest admission controller (ingest/admission.py): fed the
+        # committed-payload counts from Cleanup messages — the drain
+        # signal its credit window is derived from.  None = no ingest
+        # plane (component tests construct the proposer bare).
+        self.admission = admission
+        # Buffer bound, overridable per run (HOTSTUFF_MAX_PENDING) so
+        # load tests can shrink the buffer and reach the admission
+        # watermark without queuing 100k payloads first.
+        self.max_pending = _env_int("HOTSTUFF_MAX_PENDING", MAX_PENDING)
+        # Payloads silently dropped at the full buffer — with admission
+        # control active this staying at ZERO under overload is the
+        # acceptance signal (sheds happen at the ingest door instead).
+        self.drop_newest = 0
         # Byzantine adversary plane (faults/adversary.py): None on
         # honest nodes; the equivocation seam in _make_block consults it
         self.adversary = adversary
@@ -131,11 +156,18 @@ class Proposer:
                 "Own proposals whose commit/orphan fate is undecided",
                 fn=lambda: len(self.inflight),
             )
+            telemetry.gauge(
+                "proposer_drop_newest",
+                "Payloads silently dropped at the full buffer "
+                "(admission control should keep this at zero)",
+                fn=lambda: self.drop_newest,
+            )
 
     def _buffer_payload(self, digest: Digest) -> None:
         if digest in self.seen:
             return  # duplicate of a buffered or recently proposed payload
-        if len(self.pending) >= MAX_PENDING:
+        if len(self.pending) >= self.max_pending:
+            self.drop_newest += 1
             return  # drop newest under overload (bounded like reference)
         self.seen[digest] = None
         while len(self.seen) > SEEN_CAP:
@@ -379,6 +411,9 @@ class Proposer:
                         # waste block capacity on duplicates.  They stay
                         # in `seen` so a re-delivered copy is not
                         # re-buffered either.
+                        if self.admission is not None and message.payloads:
+                            # drain signal for the ingest credit window
+                            self.admission.on_committed(len(message.payloads))
                         for digest in message.payloads:
                             self.pending.pop(digest, None)
                             self.committed_seen[digest] = None

@@ -27,8 +27,17 @@ TAG_TIMEOUT = 2
 TAG_TC = 3
 TAG_SYNC_REQUEST = 4
 TAG_PRODUCER = 5
+TAG_PRODUCER_V2 = 6
 
 ACK = b"Ack"
+
+#: producer frame v2 (ingest plane, docs/LOAD.md): versioned batched
+#: payload submission.  The version byte is explicit so a v3 layout can
+#: change the body without a new tag; any other value is a CodecError.
+PRODUCER_FRAME_VERSION = 2
+#: payload items per v2 frame (wire sanity bound: a full batch of
+#: maximum bodies stays well under framing.MAX_FRAME)
+MAX_PRODUCER_BATCH = 512
 
 # Committee-scheme wire sizes for key/signature fields: (pk, sig) bytes.
 # One committee never mixes schemes, so the network decode path narrows
@@ -108,11 +117,101 @@ def encode_producer(payload: Digest, body: bytes = b"") -> bytes:
     return enc.finish()
 
 
+def encode_producer_batch(items) -> bytes:
+    """Producer frame v2: ``items`` is a sequence of (Digest, body)
+    pairs submitted in one frame.  Batching amortizes the per-frame
+    syscall/decode cost for high-rate clients; the ingest ACK the node
+    replies with carries the admission decision for the whole batch
+    (accepted prefix / shed suffix — the decode side preserves order)."""
+    if not items or len(items) > MAX_PRODUCER_BATCH:
+        raise ValueError(
+            f"producer batch must carry 1..{MAX_PRODUCER_BATCH} items"
+        )
+    enc = Encoder().u8(TAG_PRODUCER_V2).u8(PRODUCER_FRAME_VERSION)
+    enc.u32(len(items))
+    for digest, body in items:
+        enc.raw(digest.to_bytes())
+        enc.var_bytes(body)
+    return enc.finish()
+
+
+# ---- ingest ACK (the reply frame on the producer socket) -------------------
+
+#: first byte of an ingest ACK — disjoint from the legacy ``b"Ack"``
+#: (0x41) so a reply frame's kind is decidable from one byte
+INGEST_ACK_TAG = 0xA2
+INGEST_OK = 0
+INGEST_BUSY = 1
+
+
+class IngestAck:
+    """Typed producer ACK: the admission decision for one frame.
+
+    ``status`` is INGEST_BUSY when anything was shed; ``credit`` is the
+    node's current credit window (payloads the client may have in
+    flight before the next ACK); ``retry_after_ms`` is the node's
+    drain-rate-derived pause hint (0 unless busy)."""
+
+    __slots__ = ("status", "accepted", "shed", "credit", "retry_after_ms")
+
+    def __init__(self, status, accepted, shed, credit, retry_after_ms):
+        self.status = status
+        self.accepted = accepted
+        self.shed = shed
+        self.credit = credit
+        self.retry_after_ms = retry_after_ms
+
+    @property
+    def busy(self) -> bool:
+        return self.status == INGEST_BUSY
+
+
+def encode_ingest_ack(
+    accepted: int, shed: int, credit: int, retry_after_ms: int
+) -> bytes:
+    status = INGEST_BUSY if shed else INGEST_OK
+    u32max = (1 << 32) - 1
+    return (
+        Encoder()
+        .u8(INGEST_ACK_TAG)
+        .u8(PRODUCER_FRAME_VERSION)
+        .u8(status)
+        .u32(min(u32max, max(0, accepted)))
+        .u32(min(u32max, max(0, shed)))
+        .u32(min(u32max, max(0, credit)))
+        .u32(min(u32max, max(0, retry_after_ms)))
+        .finish()
+    )
+
+
+def decode_ingest_ack(data: bytes) -> IngestAck | None:
+    """Reply-frame decode for producer clients: None for the legacy
+    ``b"Ack"`` (or any frame that isn't an ingest ACK), the typed ACK
+    otherwise.  Raises SerializationError on a malformed ingest ACK."""
+    if not data or data[0] != INGEST_ACK_TAG:
+        return None
+    try:
+        dec = Decoder(data)
+        dec.u8()
+        version = dec.u8()
+        if version != PRODUCER_FRAME_VERSION:
+            raise CodecError(f"unknown ingest ACK version {version}")
+        status = dec.u8()
+        if status not in (INGEST_OK, INGEST_BUSY):
+            raise CodecError(f"invalid ingest ACK status {status}")
+        ack = IngestAck(status, dec.u32(), dec.u32(), dec.u32(), dec.u32())
+        dec.finish()
+        return ack
+    except CodecError as e:
+        raise SerializationError(str(e)) from e
+
+
 def decode_message(data: bytes, scheme: str | None = None):
     """bytes -> (tag, payload). Raises SerializationError on malformed input.
 
     Payload by tag: Propose -> Block, Vote -> Vote, Timeout -> Timeout,
-    TC -> TC, SyncRequest -> (Digest, PublicKey), Producer -> Digest.
+    TC -> TC, SyncRequest -> (Digest, PublicKey), Producer ->
+    (Digest, body), ProducerV2 -> tuple of (Digest, body) pairs.
 
     ``scheme`` (the committee's signature scheme) narrows accepted
     key/signature wire sizes to that scheme's; None accepts the union.
@@ -146,6 +245,20 @@ def decode_message(data: bytes, scheme: str | None = None):
             out = (Digest(dec.raw(Digest.SIZE)), decode_pk(dec))
         elif tag == TAG_PRODUCER:
             out = (Digest(dec.raw(Digest.SIZE)), dec.var_bytes(MAX_PAYLOAD_BODY))
+        elif tag == TAG_PRODUCER_V2:
+            version = dec.u8()
+            if version != PRODUCER_FRAME_VERSION:
+                raise CodecError(f"unknown producer frame version {version}")
+            count = dec.u32()
+            if not 1 <= count <= MAX_PRODUCER_BATCH:
+                raise CodecError(
+                    f"producer batch count {count} outside "
+                    f"1..{MAX_PRODUCER_BATCH}"
+                )
+            out = tuple(
+                (Digest(dec.raw(Digest.SIZE)), dec.var_bytes(MAX_PAYLOAD_BODY))
+                for _ in range(count)
+            )
         else:
             raise CodecError(f"unknown message tag {tag}")
         dec.finish()

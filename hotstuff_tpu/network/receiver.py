@@ -94,6 +94,11 @@ class Receiver:
             self._writers.discard(stream_writer)
             stream_writer.close()
 
+    @property
+    def connections(self) -> int:
+        """Live accepted connections (ingest_connections gauge)."""
+        return len(self._writers)
+
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()

@@ -195,6 +195,22 @@ def _apply_mesh_devices(args) -> None:
         os.environ["HOTSTUFF_MESH_DEVICES"] = str(max(1, n))
 
 
+def _apply_ingest(args) -> None:
+    """Bridge the ingest-plane knobs into their env-first homes:
+    ``--max-pending`` -> HOTSTUFF_MAX_PENDING (proposer buffer cap, the
+    admission controller's capacity) and ``--ingest-watermark`` ->
+    HOTSTUFF_INGEST_WATERMARK (shed threshold as a fraction of that
+    cap).  See docs/LOAD.md."""
+    import os
+
+    n = getattr(args, "max_pending", None)
+    if n is not None:
+        os.environ["HOTSTUFF_MAX_PENDING"] = str(max(1, n))
+    w = getattr(args, "ingest_watermark", None)
+    if w is not None:
+        os.environ["HOTSTUFF_INGEST_WATERMARK"] = str(w)
+
+
 def _apply_fault_plane(args) -> None:
     """Activate the chaos plane when ``--fault-plane`` was given: the
     flag value (a spec file path or inline JSON) lands in
@@ -232,6 +248,7 @@ async def _run_node(args) -> None:
     _apply_profile(args)
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
+    _apply_ingest(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -289,6 +306,7 @@ async def _run_many(args) -> None:
     _apply_profile(args)
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
+    _apply_ingest(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -510,6 +528,29 @@ def main(argv=None) -> int:
     p_run.add_argument(
         "--mesh-devices", type=int, default=None, metavar="N", help=mesh_help
     )
+    max_pending_help = (
+        "proposer payload buffer cap / ingest admission capacity "
+        "(default 100000, or the HOTSTUFF_MAX_PENDING env knob)"
+    )
+    watermark_help = (
+        "buffer-occupancy fraction above which the ingest plane sheds "
+        "producer payloads with a typed BUSY reply (default 0.75, or "
+        "HOTSTUFF_INGEST_WATERMARK)"
+    )
+    p_run.add_argument(
+        "--max-pending",
+        type=int,
+        default=None,
+        metavar="N",
+        help=max_pending_help,
+    )
+    p_run.add_argument(
+        "--ingest-watermark",
+        type=float,
+        default=None,
+        metavar="F",
+        help=watermark_help,
+    )
 
     p_many = sub.add_parser(
         "run-many",
@@ -544,6 +585,20 @@ def main(argv=None) -> int:
     p_many.add_argument(
         "--mesh-devices", type=int, default=None, metavar="N", help=mesh_help
     )
+    p_many.add_argument(
+        "--max-pending",
+        type=int,
+        default=None,
+        metavar="N",
+        help=max_pending_help,
+    )
+    p_many.add_argument(
+        "--ingest-watermark",
+        type=float,
+        default=None,
+        metavar="F",
+        help=watermark_help,
+    )
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
@@ -568,6 +623,20 @@ def main(argv=None) -> int:
     p_dep.add_argument(
         "--mesh-devices", type=int, default=None, metavar="N", help=mesh_help
     )
+    p_dep.add_argument(
+        "--max-pending",
+        type=int,
+        default=None,
+        metavar="N",
+        help=max_pending_help,
+    )
+    p_dep.add_argument(
+        "--ingest-watermark",
+        type=float,
+        default=None,
+        metavar="F",
+        help=watermark_help,
+    )
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
@@ -590,6 +659,7 @@ def main(argv=None) -> int:
         _apply_profile(args)
         _apply_verify_pipeline(args)
         _apply_mesh_devices(args)
+        _apply_ingest(args)
         asyncio.run(
             _deploy_testbed(
                 args.nodes,

@@ -98,6 +98,24 @@ GUARDS = (
         +1,
         0.75,
     ),
+    # admission-controlled payload plane (ISSUE 10): committed goodput
+    # and client-observed tail latency from a short loadgen run against
+    # a live 4-node committee.  Both are end-to-end numbers through the
+    # whole consensus stack on a shared single-core rig, so the
+    # per-guard gates are wide; skip-if-missing covers references from
+    # before the load block existed.
+    (
+        "load.goodput_tx_s",
+        lambda doc: (doc.get("load") or {}).get("goodput_tx_s"),
+        -1,
+        0.5,
+    ),
+    (
+        "load.client_p99_ms",
+        lambda doc: (doc.get("load") or {}).get("client_p99_ms"),
+        +1,
+        0.75,
+    ),
 )
 
 #: the ratcheted metric: lower is better, fresh must stay within

@@ -33,6 +33,12 @@
 #                                 # vote-list QC parity + one-pairing
 #                                 # flatness across committee sizes,
 #                                 # non-zero exit on any divergence
+#   LOAD=1 scripts/trace.sh       # ONLY the admission-plane load check
+#                                 # (scripts/load_check.py): open-loop
+#                                 # saturation sweep + 2x-saturation
+#                                 # overload with a squeezed proposer
+#                                 # buffer, non-zero exit on any silent
+#                                 # drop-newest
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -55,6 +61,11 @@ fi
 if [ "${BYZ:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/byz_check.py "$@"
+fi
+
+if [ "${LOAD:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/load_check.py "$@"
 fi
 
 timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

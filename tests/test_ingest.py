@@ -1,0 +1,366 @@
+"""Admission-plane tests (ISSUE 10): credit computation, shed
+determinism and retry-after bounds on the controller; the loadgen
+scrape helpers and SUMMARY percentiles; and a slow end-to-end run
+driving a 4-node committee past saturation — sheds must be typed and
+counted while the proposer buffer never silently drops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from hotstuff_tpu.ingest import AdmissionController, Decision
+from hotstuff_tpu.ingest.admission import (
+    CREDIT_SAMPLE_EVERY,
+    MIN_CREDIT,
+    RETRY_MAX_MS,
+    RETRY_MIN_MS,
+)
+
+from .common import async_test, committee, fresh_base_port, keys
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class FakeJournal:
+    def __init__(self):
+        self.records: list[tuple[str, int | None]] = []
+
+    def record(self, event, round_=0, digest=None, peer="", dur_ns=None):
+        self.records.append((event, dur_ns))
+
+
+def _controller(occupancy=0, **kw):
+    kw.setdefault("capacity", 1_000)
+    kw.setdefault("watermark", 0.5)
+    kw.setdefault("horizon_ms", 500.0)
+    kw.setdefault("time_fn", FakeClock())
+    ctl = AdmissionController(**kw)
+    state = {"occ": occupancy}
+    ctl.bind(lambda: state["occ"])
+    return ctl, state
+
+
+# ---- credit computation ----------------------------------------------------
+
+
+def test_admit_under_watermark_accepts_all_with_floor_credit():
+    ctl, _ = _controller()
+    d = ctl.admit(10)
+    assert d == Decision(10, 0, MIN_CREDIT, 0)
+    assert not d.busy
+    assert ctl.accepted_total == 10 and ctl.shed_total == 0
+    assert ctl.busy_frames == 0
+
+
+def test_credit_is_drain_rate_times_horizon():
+    ctl, _ = _controller()
+    ctl.commit_rate = 2_000.0  # payloads/s
+    d = ctl.admit(1)
+    # one 500 ms horizon of drain: 1000 payloads, capped by the
+    # watermark headroom left after this batch (500 - 1 = 499)
+    assert d.credit == 499
+    ctl.commit_rate = 400.0
+    assert ctl.admit(1).credit == 200  # window below headroom wins
+
+
+def test_credit_never_exceeds_watermark_headroom():
+    ctl, state = _controller()
+    ctl.commit_rate = 1e9
+    for occ in (0, 100, 499, 500, 900):
+        state["occ"] = occ
+        d = ctl.admit(0)
+        assert d.credit == max(0, 500 - occ)
+
+
+def test_commit_rate_ewma():
+    clock = FakeClock()
+    ctl, _ = _controller(time_fn=clock)
+    ctl.on_committed(100)  # first feed only anchors the clock
+    assert ctl.commit_rate == 0.0
+    clock.t = 1.0
+    ctl.on_committed(100)  # inst 100/s, alpha = 1/RATE_TAU_S = 0.5
+    assert ctl.commit_rate == pytest.approx(50.0)
+    clock.t = 2.0
+    ctl.on_committed(100)
+    assert ctl.commit_rate == pytest.approx(75.0)
+    # dt >= tau snaps straight to the instantaneous rate
+    clock.t = 10.0
+    ctl.on_committed(80)
+    assert ctl.commit_rate == pytest.approx(10.0)
+    ctl.on_committed(0)  # no-op feeds don't disturb the estimate
+    assert ctl.commit_rate == pytest.approx(10.0)
+
+
+# ---- shed determinism ------------------------------------------------------
+
+
+def test_shed_split_is_deterministic_in_state():
+    ctl, state = _controller()
+    state["occ"] = 490  # limit 500 -> headroom 10
+    first = ctl.admit(25)
+    assert (first.accepted, first.shed) == (10, 15)
+    assert first.busy
+    # same (occupancy, rate, requested) -> exactly the same decision
+    for _ in range(5):
+        assert ctl.admit(25) == first
+    state["occ"] = 500  # at the watermark: everything sheds
+    d = ctl.admit(3)
+    assert (d.accepted, d.shed) == (0, 3)
+
+
+def test_shed_counters_accumulate():
+    ctl, state = _controller()
+    state["occ"] = 500
+    for _ in range(4):
+        ctl.admit(2)
+    assert ctl.shed_total == 8
+    assert ctl.busy_frames == 4
+    assert ctl.accepted_total == 0
+
+
+# ---- retry-after bounds ----------------------------------------------------
+
+
+def test_retry_after_zero_rate_is_max_clamp():
+    ctl, state = _controller()
+    state["occ"] = 500
+    assert ctl.admit(1).retry_after_ms == RETRY_MAX_MS
+
+
+def test_retry_after_fast_drain_is_min_clamp():
+    ctl, state = _controller()
+    state["occ"] = 500
+    ctl.commit_rate = 1e6  # drains any excess near-instantly
+    assert ctl.admit(1).retry_after_ms == RETRY_MIN_MS
+
+
+def test_retry_after_always_within_bounds():
+    ctl, state = _controller()
+    for occ in (500, 600, 1_000):
+        for rate in (0.0, 0.5, 10.0, 1e3, 1e9):
+            for req in (1, 64, 10_000):
+                state["occ"] = occ
+                ctl.commit_rate = rate
+                d = ctl.admit(req)
+                if d.shed:
+                    assert RETRY_MIN_MS <= d.retry_after_ms <= RETRY_MAX_MS
+                else:
+                    assert d.retry_after_ms == 0
+
+
+def test_retry_after_scales_with_excess():
+    ctl, state = _controller()
+    ctl.commit_rate = 100.0  # payloads/s
+    state["occ"] = 510  # excess 10+req over the 500 limit
+    short = ctl.admit(10).retry_after_ms
+    state["occ"] = 900
+    long = ctl.admit(10).retry_after_ms
+    assert RETRY_MIN_MS <= short < long <= RETRY_MAX_MS
+    # 20 excess over 100/s = 200 ms, 410 excess = 4100 ms
+    assert short == 200 and long == 4_100
+
+
+# ---- env knobs and journal -------------------------------------------------
+
+
+def test_watermark_env_clamped(monkeypatch):
+    monkeypatch.setenv("HOTSTUFF_INGEST_WATERMARK", "7.5")
+    assert AdmissionController(capacity=100).watermark == 1.0
+    monkeypatch.setenv("HOTSTUFF_INGEST_WATERMARK", "-1")
+    assert AdmissionController(capacity=100).watermark == 0.01
+    monkeypatch.setenv("HOTSTUFF_INGEST_WATERMARK", "not-a-float")
+    assert AdmissionController(capacity=100).watermark == 0.75
+
+
+def test_bind_retargets_capacity():
+    ctl, _ = _controller()
+    assert ctl.capacity == 1_000
+    ctl.bind(lambda: 0, capacity=40)
+    assert ctl.capacity == 40
+    # limit is now 20; a 25-payload batch sheds 5
+    d = ctl.admit(25)
+    assert (d.accepted, d.shed) == (20, 5)
+
+
+def test_journal_sheds_every_busy_and_samples_credit():
+    journal = FakeJournal()
+    ctl, state = _controller(journal=journal)
+    state["occ"] = 500
+    for _ in range(CREDIT_SAMPLE_EVERY + 1):
+        ctl.admit(2)
+    sheds = [r for r in journal.records if r[0] == "ingest.shed"]
+    credits = [r for r in journal.records if r[0] == "ingest.credit"]
+    # every busy decision journals its shed count...
+    assert len(sheds) == CREDIT_SAMPLE_EVERY + 1
+    assert all(v == 2 for _, v in sheds)
+    # ...while the credit series is sampled (decision 1, then 65, ...)
+    assert len(credits) == 2
+
+
+def test_stats_snapshot_keys():
+    ctl, state = _controller()
+    state["occ"] = 7
+    ctl.admit(3)
+    s = ctl.stats()
+    assert s["occupancy"] == 7 and s["accepted_total"] == 3
+    for key in (
+        "capacity",
+        "watermark",
+        "commit_rate",
+        "shed_total",
+        "busy_frames",
+        "last_credit",
+    ):
+        assert key in s
+
+
+# ---- loadgen scrape helpers ------------------------------------------------
+
+
+def test_scrape_load_stats_takes_last_document():
+    from benchmark.loadgen import scrape_load_stats
+
+    log = (
+        "2026-01-01T00:00:00.000Z [INFO] Load stats: "
+        + json.dumps({"offered": 10})
+        + "\n2026-01-01T00:00:09.000Z [INFO] Load stats: "
+        + json.dumps({"offered": 20, "shed_client": 3})
+        + "\n"
+    )
+    assert scrape_load_stats(log) == {"offered": 20, "shed_client": 3}
+    assert scrape_load_stats("no stats here") == {}
+
+
+def test_scrape_ingest_sums_sections():
+    from benchmark.loadgen import scrape_ingest
+
+    docs = [
+        {"ingest": {"accepted_total": 10, "shed_total": 2, "busy_frames": 1,
+                    "drop_newest": 0}},
+        {"ingest": {"accepted_total": 5, "shed_total": 0, "busy_frames": 0,
+                    "drop_newest": 1}},
+        {"other": {}},  # a node without the section doesn't poison the sum
+    ]
+    out = scrape_ingest(docs)
+    assert out["accepted_total"] == 15 and out["shed_total"] == 2
+    assert out["busy_frames"] == 1 and out["drop_newest"] == 1
+    assert out["present"] is True
+    assert scrape_ingest([{}])["present"] is False
+
+
+def test_log_parser_latency_percentiles():
+    from benchmark.logs import LogParser
+
+    # three sample payloads committed 100/200/300 ms after their sends
+    node = (
+        "Timeout delay set to 5000 ms\n"
+        "2026-01-01T00:00:01.000Z [INFO] Created block 1 (payloads p1,p2,p3)"
+        " -> b1\n"
+        "2026-01-01T00:00:01.300Z [INFO] Committed block 1 -> b1\n"
+    )
+    client = (
+        "2026-01-01T00:00:00.900Z [INFO] Transactions rate: 100 tx/s\n"
+        "2026-01-01T00:00:01.200Z [INFO] Sending sample payload p1\n"
+        "2026-01-01T00:00:01.100Z [INFO] Sending sample payload p2\n"
+        "2026-01-01T00:00:01.000Z [INFO] Sending sample payload p3\n"
+    )
+    parser = LogParser([node], [client])
+    pcts = parser.end_to_end_latency_percentiles()
+    assert pcts is not None
+    p50, p99 = pcts
+    assert p50 == pytest.approx(0.2, abs=1e-6)
+    assert p99 == pytest.approx(0.3, abs=1e-6)
+    assert "End-to-end latency p50/p99:" in parser.result()
+    # no committed samples -> None, and the SUMMARY omits the line
+    empty = LogParser([node], ["nothing"])
+    assert empty.end_to_end_latency_percentiles() is None
+    assert "p50/p99" not in empty.result()
+
+
+# ---- end to end: committee past saturation ---------------------------------
+
+
+@pytest.mark.slow
+@async_test
+async def test_e2e_overload_sheds_without_silent_drops(tmp_path, monkeypatch):
+    """Drive a live 4-node committee well past what it can commit with a
+    deliberately tiny proposer buffer: the admission plane must shed
+    (typed BUSY and/or client-side credit starvation) while the buffer
+    never silently drops (drop_newest == 0 on every node)."""
+    from benchmark.loadgen import run_load
+    from hotstuff_tpu.consensus import Consensus, Parameters
+    from hotstuff_tpu.crypto import SignatureService
+    from hotstuff_tpu.store import Store
+
+    # a buffer this small WOULD overflow in seconds at 3000 tx/s if
+    # credits failed; the low watermark makes sheds reachable fast
+    monkeypatch.setenv("HOTSTUFF_MAX_PENDING", "200")
+    monkeypatch.setenv("HOTSTUFF_INGEST_WATERMARK", "0.5")
+
+    base = fresh_base_port()
+    com = committee(base)
+    nodes = []
+    for i in range(4):
+        name, secret = keys()[i]
+        store = Store(str(tmp_path / f"db_{i}"))
+        commit_q: asyncio.Queue = asyncio.Queue()
+        stack = await Consensus.spawn(
+            name,
+            com,
+            Parameters(timeout_delay=2_000, sync_retry_delay=5_000),
+            SignatureService(secret),
+            store,
+            commit_q,
+            bind_host="127.0.0.1",
+        )
+        nodes.append((stack, commit_q, store))
+
+    async def drain(q: asyncio.Queue):
+        while True:
+            await q.get()
+
+    drains = [asyncio.ensure_future(drain(q)) for _, q, _ in nodes]
+    try:
+        stats = await run_load(
+            [("127.0.0.1", base + i) for i in range(4)],
+            rate=3_000,
+            duration=6.0,
+            clients=16,
+            conns_per_node=1,
+            size=64,
+            seed=7,
+        )
+        assert stats, "fleet produced no stats"
+        assert stats["accepted"] > 0 or stats["submitted"] > 0
+        server_shed = sum(s.admission.shed_total for s, _, _ in nodes)
+        total_shed = server_shed + stats["shed_client"]
+        assert total_shed > 0, (
+            f"no sheds at 3000 tx/s vs a 200-payload buffer: {stats}"
+        )
+        for stack, _, _ in nodes:
+            assert stack.proposer.drop_newest == 0, (
+                "proposer silently dropped payloads despite admission "
+                f"control (occupancy cap {stack.proposer.max_pending})"
+            )
+        # credits actually constrained the fleet: the committee's
+        # buffers stayed at or below the configured cap throughout
+        for stack, _, _ in nodes:
+            assert len(stack.proposer.pending) <= stack.proposer.max_pending
+    finally:
+        for t in drains:
+            t.cancel()
+        for stack, _, _ in nodes:
+            await stack.shutdown()
+        for _, _, store in nodes:
+            store.close()

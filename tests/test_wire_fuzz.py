@@ -457,3 +457,151 @@ def test_compact_tc_wire_corpus():
             decode_message(bytes(buf), scheme="bls")
         except SerializationError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# producer-frame-v2 / ingest-ACK corpus (ISSUE 10): the admission plane
+# adds a versioned batched submission frame on the consensus port and a
+# typed reply frame on the producer socket — both face unauthenticated
+# clients, so the same "clean decode or clean error" property is
+# load-bearing.
+
+
+def _v2_frame(n: int = 5, body_size: int = 48) -> bytes:
+    from hotstuff_tpu.consensus.wire import encode_producer_batch
+
+    items = []
+    for i in range(n):
+        body = bytes([i]) * body_size
+        items.append((Digest.of(body), body))
+    return encode_producer_batch(items)
+
+
+def test_producer_v2_round_trip():
+    from hotstuff_tpu.consensus.wire import TAG_PRODUCER_V2
+
+    frame = _v2_frame(7)
+    tag, payload = decode_message(frame)
+    assert tag == TAG_PRODUCER_V2
+    assert len(payload) == 7
+    for digest, body in payload:
+        assert digest == Digest.of(body)
+    # item order is preserved — the accepted-prefix admission contract
+    # depends on it
+    assert [b[0] for _, b in payload] == list(range(7))
+
+
+def test_producer_v2_batch_bounds():
+    from hotstuff_tpu.consensus.wire import (
+        MAX_PRODUCER_BATCH,
+        encode_producer_batch,
+    )
+
+    with pytest.raises(ValueError):
+        encode_producer_batch([])
+    d = Digest.of(b"x")
+    with pytest.raises(ValueError):
+        encode_producer_batch([(d, b"")] * (MAX_PRODUCER_BATCH + 1))
+    # the cap itself encodes and round-trips
+    frame = encode_producer_batch([(d, b"")] * MAX_PRODUCER_BATCH)
+    _, payload = decode_message(frame)
+    assert len(payload) == MAX_PRODUCER_BATCH
+
+
+def test_producer_v2_wire_corpus():
+    """Truncations, bad version byte, oversized declared count, and
+    single-byte mutations: SerializationError or clean decode only."""
+    from hotstuff_tpu.consensus.wire import (
+        MAX_PRODUCER_BATCH,
+        PRODUCER_FRAME_VERSION,
+        TAG_PRODUCER_V2,
+    )
+
+    frame = _v2_frame(5)
+    decode_message(frame)  # sanity: the original decodes
+
+    # every truncation dies cleanly
+    for cut in range(len(frame)):
+        _decode_must_not_crash(frame[:cut])
+    _decode_must_not_crash(frame + b"\x00")  # trailing junk
+
+    # any version byte except the pinned one is malformed input
+    for version in range(256):
+        if version == PRODUCER_FRAME_VERSION:
+            continue
+        mutated = bytes([frame[0], version]) + frame[2:]
+        with pytest.raises(SerializationError):
+            decode_message(mutated)
+
+    # declared count of 0 and counts past the batch cap die in the
+    # codec, never as an allocation attempt
+    import struct
+
+    head = bytes([TAG_PRODUCER_V2, PRODUCER_FRAME_VERSION])
+    for count in (0, MAX_PRODUCER_BATCH + 1, 0xFFFFFFFF):
+        with pytest.raises(SerializationError):
+            decode_message(head + struct.pack("<I", count))
+
+    # a count larger than the items actually present dies cleanly
+    inflated = head + struct.pack("<I", 9) + frame[6:]
+    with pytest.raises(SerializationError):
+        decode_message(inflated)
+
+    rng = random.Random(0xF028)
+    for _ in range(400):
+        buf = bytearray(frame)
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        _decode_must_not_crash(bytes(buf))
+
+
+def test_ingest_ack_round_trip_and_corpus():
+    from hotstuff_tpu.consensus.wire import (
+        INGEST_ACK_TAG,
+        INGEST_BUSY,
+        INGEST_OK,
+        decode_ingest_ack,
+        encode_ingest_ack,
+    )
+
+    # OK form: nothing shed, no retry hint
+    ok = decode_ingest_ack(encode_ingest_ack(12, 0, 640, 0))
+    assert ok is not None and not ok.busy and ok.status == INGEST_OK
+    assert (ok.accepted, ok.shed, ok.credit, ok.retry_after_ms) == (
+        12, 0, 640, 0,
+    )
+    # BUSY form: a nonzero shed flips the status
+    busy = decode_ingest_ack(encode_ingest_ack(3, 9, 0, 250))
+    assert busy is not None and busy.busy and busy.status == INGEST_BUSY
+    assert (busy.accepted, busy.shed) == (3, 9)
+    # encode clamps instead of wrapping
+    big = decode_ingest_ack(encode_ingest_ack(1 << 40, -5, 0, 1 << 40))
+    assert big.accepted == (1 << 32) - 1 and big.shed == 0
+
+    # non-ACK frames are None, not errors: the legacy reply and
+    # anything else that doesn't lead with the ACK tag
+    assert decode_ingest_ack(b"Ack") is None
+    assert decode_ingest_ack(b"") is None
+    assert decode_ingest_ack(b"\x00\x01\x02") is None
+
+    frame = encode_ingest_ack(3, 9, 64, 250)
+    # bad version / bad status are malformed, not silently decoded
+    with pytest.raises(SerializationError):
+        decode_ingest_ack(bytes([INGEST_ACK_TAG, 99]) + frame[2:])
+    with pytest.raises(SerializationError):
+        decode_ingest_ack(frame[:2] + bytes([7]) + frame[3:])
+    # truncations and trailing junk die cleanly
+    for cut in range(1, len(frame)):
+        with pytest.raises(SerializationError):
+            decode_ingest_ack(frame[:cut])
+    with pytest.raises(SerializationError):
+        decode_ingest_ack(frame + b"\x00")
+
+    # mutations: typed ACK, None, or SerializationError — never a crash
+    rng = random.Random(0xF029)
+    for _ in range(400):
+        buf = bytearray(frame)
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        try:
+            decode_ingest_ack(bytes(buf))
+        except SerializationError:
+            pass
