@@ -615,6 +615,83 @@ def bench_load() -> dict | None:
         return None
 
 
+def bench_state(blocks_n: int = 256, per_block: int = 8) -> dict | None:
+    """Replicated execution-layer micro-bench (ISSUE 11): typed-op
+    apply throughput through ``StateMachine.apply_block`` over a WAL
+    store, then the wall cost of a full snapshot serve (manifest +
+    chunks) + adopt cycle into a fresh store — the no-replay rejoin
+    path a crash-recovered node takes.  Feeds the ``state.apply_tx_s``
+    and ``state.sync_catchup_s`` perfgate guards; returns None (key
+    omitted, guards skip) on any failure so the kernel benchmarks above
+    still publish."""
+    import os
+    import tempfile
+
+    try:
+        from hotstuff_tpu.crypto import Digest
+        from hotstuff_tpu.store import Store
+        from hotstuff_tpu.store.state import (
+            OP_BODY_OFFSET,
+            StateMachine,
+            encode_ops,
+        )
+
+        class _Committed:
+            __slots__ = ("round", "payloads", "_digest")
+
+            def __init__(self, round_, payloads):
+                self.round = round_
+                self.payloads = payloads
+                self._digest = Digest.random()
+
+            def digest(self):
+                return self._digest
+
+        with tempfile.TemporaryDirectory() as tmp:
+            src_store = Store(os.path.join(tmp, "src"))
+            blocks = []
+            for r in range(1, blocks_n + 1):
+                payloads = tuple(
+                    Digest.random() for _ in range(per_block)
+                )
+                for d in payloads:
+                    body = b"\x00" * OP_BODY_OFFSET + encode_ops(
+                        [("put", b"bench/%d" % r, d.to_bytes())]
+                    )
+                    src_store.engine.put(b"p" + d.to_bytes(), body)
+                blocks.append(_Committed(r, payloads))
+            src = StateMachine(src_store)
+            t0 = time.perf_counter()
+            for block in blocks:
+                src.apply_block(block)
+            apply_s = time.perf_counter() - t0
+
+            dst = StateMachine(Store(os.path.join(tmp, "dst")))
+            t0 = time.perf_counter()
+            manifest = src.manifest()
+            entries = []
+            for index in range(manifest.chunk_count):
+                entries.extend(src.chunk(index))
+            dst.adopt(manifest, entries)
+            catchup_s = time.perf_counter() - t0
+            if dst.root != src.root:
+                raise RuntimeError("adopted root diverged from source")
+            out = {
+                "apply_tx_s": round(src.applied_payloads / apply_s),
+                "applied_blocks": src.applied_blocks,
+                "applied_payloads": src.applied_payloads,
+                "typed_ops": src.typed_ops,
+                "sync_catchup_s": round(catchup_s, 4),
+                "snapshot_entries": len(entries),
+            }
+            src_store.engine.close()
+            dst.store.engine.close()
+            return out
+    except Exception as e:  # the bench must survive a broken state layer
+        print(f"bench_state skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def probe_tunnel(inflight: int = 16, reps: int = 7) -> dict:
     """Tunnel weather, two views over the same tiny resident-arg jit
     call, pinned in the output so end-to-end swings between rounds are
@@ -701,6 +778,10 @@ def main() -> int:
     # load guards skip instead of failing the kernel bench
     load = bench_load()
 
+    # replicated execution-layer apply/snapshot costs; key omitted on
+    # failure so the perfgate state guards skip instead of failing
+    state = bench_state()
+
     print(
         json.dumps(
             {
@@ -719,6 +800,7 @@ def main() -> int:
                 "pipeline": bench_pipeline(),
                 "agg_qc": bench_agg_qc(),
                 **({"load": load} if load is not None else {}),
+                **({"state": state} if state is not None else {}),
             }
         )
     )

@@ -100,6 +100,7 @@ def task_load(args) -> int:
         tx_size=args.tx_size,
         seed=args.seed,
         overload_max_pending=args.overload_max_pending,
+        read_fraction=args.read_fraction,
     )
     block = (
         "\n"
@@ -492,6 +493,14 @@ def main(argv=None) -> int:
         default=2_000,
         help="proposer buffer cap for the 2x-overload run (small so a "
         "short window can actually reach the shed watermark)",
+    )
+    p.add_argument(
+        "--read-fraction",
+        type=float,
+        default=0.0,
+        help="mixed fleet: probability each arrival is a QC-anchored "
+        "ledger read against the replicated execution layer instead "
+        "of a write (docs/STATE.md)",
     )
     p.set_defaults(fn=task_load)
 

@@ -29,10 +29,13 @@ import math
 import os
 import re
 
-from .logs import RE_COMMITTED, _ts
+from .logs import RE_COMMITTED, RE_STATE_ROOT, _ts
 
 # commit observation: (wall-clock seconds, round, block digest)
 Commit = tuple[float, int, str]
+
+# state-root observation: (state version, root digest, round)
+StateRoot = tuple[int, str, int]
 
 # Adversary-plane activity lines (core/proposer/adversary log contract,
 # mirroring the RE_COMMITTED approach: the node's log IS its history).
@@ -61,6 +64,65 @@ def commits_from_logs(logs_dir: str) -> dict[str, list[Commit]]:
             for ts, rnd, digest in RE_COMMITTED.findall(content)
         ]
     return out
+
+
+def state_roots_from_logs(logs_dir: str) -> dict[str, list[StateRoot]]:
+    """Per-node replicated-execution state roots from a logs directory:
+    one (version, root, round) observation per applied commit.  A
+    snapshot-rejoined node's sequence legitimately skips the versions it
+    slept through — agreement is checked per VERSION, not per index."""
+    out: dict[str, list[StateRoot]] = {}
+    for path in sorted(glob.glob(os.path.join(logs_dir, "node-*.log"))):
+        name = os.path.basename(path)[: -len(".log")]
+        with open(path) as f:
+            content = f.read()
+        out[name] = [
+            (int(version), root, int(rnd))
+            for _ts_, version, root, rnd in RE_STATE_ROOT.findall(content)
+        ]
+    return out
+
+
+def check_state_root_agreement(
+    roots_by_node: dict[str, list[StateRoot]],
+) -> tuple[bool | None, list[str], dict]:
+    """Every node that reports a state root at a given version must
+    report the SAME root — the replicated execution layer is
+    deterministic, so divergence means a node executed (or *reported*,
+    under byz shadow-committers) a different history.  A node may
+    re-report a version across restarts, but only with the same root.
+    Returns (ok, violations, details); ok is ``None`` when no node
+    logged any state root (execution layer absent from the run)."""
+    violations: list[str] = []
+    chosen: dict[int, tuple[str, str]] = {}  # version -> (root, first node)
+    observed = 0
+    for node in sorted(roots_by_node):
+        seen_here: dict[int, str] = {}
+        for version, root, _rnd in roots_by_node[node]:
+            observed += 1
+            prev = seen_here.get(version)
+            if prev is not None and prev != root:
+                violations.append(
+                    f"{node} reported two state roots at version "
+                    f"{version}: {prev} vs {root}"
+                )
+            seen_here[version] = root
+            got = chosen.get(version)
+            if got is None:
+                chosen[version] = (root, node)
+            elif got[0] != root:
+                violations.append(
+                    f"state-root divergence at version {version}: "
+                    f"{got[1]} -> {got[0]}, {node} -> {root}"
+                )
+    details = {
+        "versions_compared": len(chosen),
+        "max_version": max(chosen) if chosen else 0,
+        "nodes_reporting": sum(1 for r in roots_by_node.values() if r),
+    }
+    if not observed:
+        return None, [], details
+    return (not violations), violations, details
 
 
 def byz_activity_from_logs(logs_dir: str) -> dict[str, dict[str, int]]:
@@ -246,10 +308,16 @@ def chaos_block(
     liveness_violations: list[str],
     details: dict,
     heal_rel: float | None = None,
+    state_ok: bool | None = None,
+    state_violations: list[str] | tuple = (),
+    state_details: dict | None = None,
 ) -> str:
     """Render the ``+ CHAOS`` SUMMARY section.  ``liveness_ok=None``
     means the scenario never heals (unbounded rule) — liveness is n/a,
-    not a failure."""
+    not a failure.  ``state_ok`` is the state-root agreement verdict:
+    None with ``state_details=None`` omits the line (caller has no
+    execution layer), None WITH details renders n/a (layer present but
+    no roots logged)."""
     lines = [
         " + CHAOS:\n",
         f" Scenario: {scenario} (seed {seed})\n",
@@ -265,6 +333,28 @@ def chaos_block(
             f"   ! ... and {len(safety_violations) - len(shown)} more "
             "conflicting-commit violations\n"
         )
+    if state_details is not None:
+        if state_ok is None:
+            lines.append(
+                " State-root agreement: n/a (no state roots logged)\n"
+            )
+        else:
+            sd = state_details
+            lines.append(
+                " State-root agreement: "
+                f"{'PASS' if state_ok else 'FAIL'}"
+                f" ({sd.get('versions_compared', 0)} versions,"
+                f" {sd.get('nodes_reporting', 0)} nodes,"
+                f" max v{sd.get('max_version', 0)})\n"
+            )
+            s_shown = list(state_violations)[:8]
+            for v in s_shown:
+                lines.append(f"   ! {v}\n")
+            if len(state_violations) > len(s_shown):
+                lines.append(
+                    f"   ! ... and {len(state_violations) - len(s_shown)} "
+                    "more state-root violations\n"
+                )
     if liveness_ok is None:
         lines.append(" Liveness: n/a (scenario never heals)\n")
     else:
@@ -291,11 +381,12 @@ def byz_block(
     activity: dict[str, dict[str, int]],
     safety_ok: bool,
     trusted_result: tuple[bool, list[str]] | None = None,
+    trusted_state_result: tuple[bool | None, list[str]] | None = None,
 ) -> str:
     """Render the ``+ BYZ`` SUMMARY section: which nodes attacked, with
     what policies and how often; what the honest committee rejected; and
-    (under ``quorum_mode: trusted-subset``) the safety verdict once the
-    adversarial histories are discarded."""
+    (under ``quorum_mode: trusted-subset``) the safety AND state-root
+    verdicts once the adversarial histories are discarded."""
     lines = [" + BYZ:\n"]
     for name, info in sorted(adversaries.items()):
         who = f" Adversary {name}"
@@ -337,6 +428,15 @@ def byz_block(
         )
         for v in t_viol:
             lines.append(f"   ! {v}\n")
+    if trusted_state_result is not None:
+        ts_ok, ts_viol = trusted_state_result
+        verdict = "n/a" if ts_ok is None else ("PASS" if ts_ok else "FAIL")
+        lines.append(
+            " Trusted-subset state roots (adversaries excluded): "
+            f"{verdict}\n"
+        )
+        for v in ts_viol[:8]:
+            lines.append(f"   ! {v}\n")
     return "".join(lines)
 
 
@@ -360,6 +460,14 @@ def check_run(
     adversaries = adversaries_from_spec(spec, authorities)
     if adversaries:
         safety_viol = attribute_violations(safety_viol, adversaries)
+    # replicated-execution invariant: honest nodes' state roots agree
+    # per version.  n/a (no roots logged) never fails a run; a FAIL does
+    # — diverging execution is a safety violation even when the commit
+    # histories themselves agree.
+    roots = state_roots_from_logs(logs_dir)
+    state_ok, state_viol, state_details = check_state_root_agreement(roots)
+    if adversaries:
+        state_viol = attribute_violations(state_viol, adversaries)
     heal_rel = last_heal(spec)
     liveness = spec.get("liveness", {})
     if math.isinf(heal_rel):
@@ -369,6 +477,8 @@ def check_run(
         block = chaos_block(
             spec.get("name", "custom"), int(spec.get("seed", 0)),
             safety_ok, safety_viol, live_ok, live_viol, details,
+            state_ok=state_ok, state_violations=state_viol,
+            state_details=state_details,
         )
         all_ok = safety_ok
     else:
@@ -382,25 +492,35 @@ def check_run(
             spec.get("name", "custom"), int(spec.get("seed", 0)),
             safety_ok, safety_viol, live_ok, live_viol, details,
             heal_rel=heal_rel,
+            state_ok=state_ok, state_violations=state_viol,
+            state_details=state_details,
         )
         all_ok = safety_ok and live_ok
+    all_ok = all_ok and state_ok is not False
     if adversaries:
         trusted_result = None
+        trusted_state_result = None
         if spec.get("quorum_mode") == "trusted-subset":
             trusted_result = trusted_subset_recheck(
                 commits, set(adversaries)
             )
+            ts_ok, ts_viol, _ts_details = check_state_root_agreement(
+                {n: r for n, r in roots.items() if n not in adversaries}
+            )
+            trusted_state_result = (ts_ok, ts_viol)
         block += byz_block(
             adversaries,
             byz_activity_from_logs(logs_dir),
             safety_ok,
             trusted_result,
+            trusted_state_result,
         )
     return all_ok, block
 
 
 __all__ = [
     "Commit",
+    "StateRoot",
     "adversaries_from_spec",
     "attribute_violations",
     "byz_activity_from_logs",
@@ -409,6 +529,8 @@ __all__ = [
     "check_liveness",
     "check_run",
     "check_safety",
+    "check_state_root_agreement",
     "commits_from_logs",
+    "state_roots_from_logs",
     "trusted_subset_recheck",
 ]

@@ -162,6 +162,90 @@ class _LoadConn:
             self.writer = None
 
 
+class _ReadConn:
+    """One framed connection issuing QC-anchored ledger reads
+    (``TAG_STATE_READ``) against a node's replicated execution layer.
+
+    Reads are NOT admission-controlled (the node answers at its last
+    applied version without touching the ingest plane), so there is no
+    credit window — just a FIFO of send timestamps matched to the
+    in-order reply stream for round-trip latency."""
+
+    def __init__(self, address):
+        self.address = address
+        self.writer: asyncio.StreamWriter | None = None
+        self._sink: asyncio.Task | None = None
+        self.alive = False
+        self.sent = 0
+        self.replies = 0
+        self.found = 0
+        self.version_max = 0
+        self.latencies: list[float] = []
+        self._pending: list[float] = []  # FIFO of send times
+
+    async def connect(self) -> None:
+        from hotstuff_tpu.network.framing import set_nodelay
+
+        reader, writer = await asyncio.open_connection(*self.address)
+        try:
+            set_nodelay(writer)
+            sink = asyncio.ensure_future(self._read_replies(reader))
+        except BaseException:
+            writer.close()
+            raise
+        self.writer = writer
+        self._sink = sink
+        self.alive = True
+        self._pending.clear()
+
+    def send_read(self, frame: bytes) -> None:
+        from hotstuff_tpu.network.framing import write_frame
+
+        if not self.alive:
+            return
+        try:
+            write_frame(self.writer, frame)
+        except (ConnectionError, OSError):
+            self.mark_dead()
+            return
+        self.sent += 1
+        self._pending.append(asyncio.get_running_loop().time())
+
+    async def _read_replies(self, reader: asyncio.StreamReader) -> None:
+        from hotstuff_tpu.consensus.wire import decode_state_value
+        from hotstuff_tpu.network.framing import read_frame
+
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                frame = await read_frame(reader)
+                sv = decode_state_value(frame)
+                if sv is None:
+                    continue
+                self.replies += 1
+                if self._pending:
+                    lat = loop.time() - self._pending.pop(0)
+                    if len(self.latencies) < 10_000:
+                        self.latencies.append(lat)
+                if sv.found:
+                    self.found += 1
+                self.version_max = max(self.version_max, sv.state_version)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            self.mark_dead()
+
+    def mark_dead(self) -> None:
+        self.alive = False
+        self.close()
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.cancel()
+            self._sink = None
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+
+
 async def run_load(
     addresses,
     rate: int,
@@ -172,14 +256,23 @@ async def run_load(
     seed: int = 1,
     warmup: float = 0.0,
     expect_faults: int = 0,
+    read_fraction: float = 0.0,
 ) -> dict:
     """Drive a Poisson arrival process at ``rate`` tx/s for ``duration``
     seconds over ``conns_per_node`` connections to each node, honoring
-    per-connection admission credits.  Returns the stats dict that is
-    also written to the log as the ``Load stats:`` contract line."""
+    per-connection admission credits.  With ``read_fraction > 0`` each
+    arrival is a LEDGER READ with that probability instead of a write:
+    a ``TAG_STATE_READ`` round-trip against a recently written payload
+    digest, answered at the node's last applied state version (a
+    lagging node serves a QC-anchored stale read — the miss/hit split
+    and the version spread are the measurement).  Returns the stats
+    dict that is also written to the log as the ``Load stats:``
+    contract line."""
     from hotstuff_tpu.consensus.wire import (
         MAX_PRODUCER_BATCH,
+        STATE_READ_LEDGER,
         encode_producer_batch,
+        encode_state_read,
     )
     from hotstuff_tpu.crypto import Digest
     from hotstuff_tpu.node.client import wait_for_nodes
@@ -198,7 +291,12 @@ async def run_load(
     conns = [
         _LoadConn(a) for a in live_addrs for _ in range(conns_per_node)
     ]
-    for c in conns:
+    # one dedicated read connection per node — read replies must not
+    # interleave with the write plane's credit-bearing ingest ACKs
+    read_conns = (
+        [_ReadConn(a) for a in live_addrs] if read_fraction > 0 else []
+    )
+    for c in conns + read_conns:
         try:
             await asyncio.wait_for(c.connect(), 2.0)
         except (OSError, asyncio.TimeoutError):
@@ -209,7 +307,7 @@ async def run_load(
     async def reconnector() -> None:
         while True:
             await asyncio.sleep(2.0)
-            for c in conns:
+            for c in conns + read_conns:
                 if not c.alive:
                     try:
                         await asyncio.wait_for(c.connect(), 1.5)
@@ -230,12 +328,16 @@ async def run_load(
         clients,
         len(conns),
     )
+    if read_fraction > 0:
+        log.info("Read fraction: %.2f", read_fraction)
 
     loop = asyncio.get_running_loop()
     start = loop.time()
     next_arrival = start + rng.expovariate(rate)
     offered = submitted = client_shed = counter = 0
     rr = 0  # connection rotation cursor across ticks
+    reads_offered = read_rr = 0
+    recent: list = []  # recently written digests, the read working set
     try:
         while True:
             now = loop.time()
@@ -248,6 +350,24 @@ async def run_load(
             while next_arrival <= now and next_arrival - start < duration:
                 due += 1
                 next_arrival += rng.expovariate(rate)
+            # a read needs a working set — until the first write lands,
+            # every arrival stays a write
+            if due and read_conns and recent:
+                reads_due = sum(
+                    1 for _ in range(due) if rng.random() < read_fraction
+                )
+                due -= reads_due
+                reads_offered += reads_due
+                live_readers = [r for r in read_conns if r.alive]
+                for _ in range(reads_due):
+                    if not live_readers:
+                        break
+                    target = live_readers[read_rr % len(live_readers)]
+                    read_rr += 1
+                    digest = recent[rng.randrange(len(recent))]
+                    target.send_read(
+                        encode_state_read(STATE_READ_LEDGER, digest)
+                    )
             if due:
                 offered += due
                 eligible = [
@@ -285,6 +405,10 @@ async def run_load(
                         # NOTE: used to compute performance.
                         log.info("Sending sample payload %s", digest)
                     batches[i].append((digest, body))
+                    if read_conns:
+                        recent.append(digest.to_bytes())
+                        if len(recent) > 1024:
+                            del recent[:512]
                     budgets[i] -= 1
                     counter += 1
                     placed += 1
@@ -304,7 +428,11 @@ async def run_load(
             )
     finally:
         reconnect_task.cancel()
-        for c in conns:
+        # reads in flight when the window closes would miss their
+        # replies — give the in-order streams a moment to drain
+        if read_conns and any(r._pending for r in read_conns):
+            await asyncio.sleep(0.25)
+        for c in conns + read_conns:
             c.close()
 
     window = loop.time() - start
@@ -320,6 +448,23 @@ async def run_load(
         "shed_client": client_shed,
         "busy_frames": sum(c.busy_frames for c in conns),
     }
+    if read_conns:
+        lat = sorted(
+            x for r in read_conns for x in r.latencies
+        )
+        stats["reads"] = {
+            "fraction": read_fraction,
+            "offered": reads_offered,
+            "sent": sum(r.sent for r in read_conns),
+            "replies": sum(r.replies for r in read_conns),
+            "found": sum(r.found for r in read_conns),
+            "version_max": max(
+                (r.version_max for r in read_conns), default=0
+            ),
+            "p50_ms": (
+                round(lat[len(lat) // 2] * 1e3, 2) if lat else None
+            ),
+        }
     # NOTE: this log entry is used to compute performance.
     log.info("Load stats: %s", json.dumps(stats))
     return stats
@@ -382,6 +527,7 @@ class LoadBench:
         timeout_delay: int = 5_000,
         verifier: str = "cpu",
         base_port: int | None = None,
+        read_fraction: float = 0.0,
     ):
         from .local import LocalBench
 
@@ -399,6 +545,7 @@ class LoadBench:
         self.clients = clients
         self.conns_per_node = conns_per_node
         self.seed = seed
+        self.read_fraction = read_fraction
         self.bench.extra_env["HOTSTUFF_TELEMETRY"] = "1"
         if max_pending is not None:
             self.bench.extra_env["HOTSTUFF_MAX_PENDING"] = str(max_pending)
@@ -430,6 +577,8 @@ class LoadBench:
             "2",
             "--faults",
             str(b.faults),
+            "--read-fraction",
+            str(self.read_fraction),
         ]
 
     def run(self) -> dict:
@@ -471,6 +620,9 @@ class LoadBench:
             "drop_newest": ingest["drop_newest"],
             "telemetry_present": ingest["present"],
             "fleet": fleet,
+            **(
+                {"reads": fleet["reads"]} if fleet.get("reads") else {}
+            ),
         }
 
 
@@ -485,6 +637,7 @@ def run_sweep(
     seed: int = 1,
     overload_max_pending: int = 2_000,
     plateau_gain: float = 0.10,
+    read_fraction: float = 0.0,
 ) -> dict:
     """Saturation sweep: double the offered rate until goodput improves
     by less than ``plateau_gain`` (or ``max_steps`` runs), then drive
@@ -505,6 +658,7 @@ def run_sweep(
             conns_per_node=conns_per_node,
             tx_size=tx_size,
             seed=seed,
+            read_fraction=read_fraction,
         ).run()
         rows.append(row)
         goodput = row["goodput_tx_s"] or 0.0
@@ -532,6 +686,7 @@ def run_sweep(
         tx_size=tx_size,
         seed=seed,
         max_pending=overload_max_pending,
+        read_fraction=read_fraction,
     ).run()
     sheds = overload["shed_server"] + overload["shed_client"]
     overload["backpressure_held"] = (
@@ -548,6 +703,9 @@ def run_sweep(
         "goodput_tx_s": sat_row["goodput_tx_s"],
         "client_p50_ms": sat_row["client_p50_ms"],
         "client_p99_ms": sat_row["client_p99_ms"],
+        **(
+            {"reads": sat_row["reads"]} if sat_row.get("reads") else {}
+        ),
     }
 
 
@@ -589,6 +747,16 @@ def format_load_block(result: dict) -> str:
             else "no sheds observed (offered rate below the watermark)"
         )
     )
+    reads = result.get("reads")
+    if reads:
+        lines += [
+            "",
+            f" Mixed reads ({reads['fraction']:.0%} of arrivals):"
+            f" {reads['sent']} sent, {reads['replies']} answered,"
+            f" {reads['found']} found,"
+            f" p50 {txt(reads['p50_ms'], ' ms')},"
+            f" served at state version <= {reads['version_max']}",
+        ]
     lines += [
         "",
         f" Overload (2x saturation = {o['offered_tx_s']} tx/s):",
@@ -605,11 +773,13 @@ def quick_load(
     rate: int = 2_000,
     duration: float = 10.0,
     max_pending: int | None = None,
+    read_fraction: float = 0.0,
 ) -> dict:
     """One fixed-rate run for the bench.py ``load`` block / perfgate
     guards: goodput + client percentiles without the full sweep."""
     row = LoadBench(
-        nodes=nodes, rate=rate, duration=duration, max_pending=max_pending
+        nodes=nodes, rate=rate, duration=duration, max_pending=max_pending,
+        read_fraction=read_fraction,
     ).run()
     return {
         "offered_tx_s": row["offered_tx_s"],
@@ -619,6 +789,7 @@ def quick_load(
         "shed_server": row["shed_server"],
         "shed_client": row["shed_client"],
         "drop_newest": row["drop_newest"],
+        **({"reads": row["reads"]} if row.get("reads") else {}),
     }
 
 
@@ -640,6 +811,13 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--warmup", type=float, default=2.0)
     parser.add_argument("--faults", type=int, default=0)
+    parser.add_argument(
+        "--read-fraction",
+        type=float,
+        default=0.0,
+        help="probability each arrival is a QC-anchored ledger read "
+        "instead of a write (0 = pure write fleet)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=1)
     args = parser.parse_args(argv)
 
@@ -661,6 +839,8 @@ def main(argv=None) -> int:
         )
     if args.rate < 1 or args.conns < 1 or args.clients < 1:
         parser.error("--rate, --conns and --clients must be >= 1")
+    if not 0.0 <= args.read_fraction < 1.0:
+        parser.error("--read-fraction must be in [0, 1)")
     committee = read_committee(args.committee)
     addresses = [a.address for a in committee.authorities.values()]
     asyncio.run(
@@ -674,6 +854,7 @@ def main(argv=None) -> int:
             seed=args.seed,
             warmup=args.warmup,
             expect_faults=args.faults,
+            read_fraction=args.read_fraction,
         )
     )
     return 0

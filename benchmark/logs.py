@@ -42,6 +42,12 @@ RE_CREATED = re.compile(
     _TS + r".*Created block (\d+) \(payloads (\S*)\) -> (\S+)"
 )
 RE_COMMITTED = re.compile(_TS + r".*Committed block (\d+) -> (\S+)")
+# replicated-execution state root per applied commit (core contract:
+# ``State root <version> -> <root> (round <round>)``) — the basis of the
+# cross-node state-root agreement invariant (benchmark/invariants.py)
+RE_STATE_ROOT = re.compile(
+    _TS + r".*State root (\d+) -> (\S+) \(round (\d+)\)"
+)
 RE_TIMEOUT = re.compile(_TS + r".*Timeout reached for round (\d+)")
 RE_TIMEOUT_DELAY = re.compile(r"Timeout delay set to (\d+) ms")
 RE_CLIENT_RATE = re.compile(_TS + r".*Transactions rate: (\d+) tx/s")

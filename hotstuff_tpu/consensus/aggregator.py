@@ -649,6 +649,14 @@ class Aggregator:
             timeout, self.committee.for_round(timeout.round)
         )
 
+    def timeout_weight(self, round_: Round) -> int:
+        """Stake currently accumulated toward a TC for ``round_`` (0 once
+        the TC was emitted, or if no timeout arrived).  The core's
+        round-sync rule reads this to join a round the rest of the
+        committee is provably timing out."""
+        maker = self.timeouts_aggregators.get(round_)
+        return maker.weight if maker is not None else 0
+
     def cleanup(self, round_: Round) -> None:
         self.votes_aggregators = {
             r: v for r, v in self.votes_aggregators.items() if r >= round_

@@ -232,6 +232,12 @@ class Committee:
         # (reference config.rs:67-72).
         return 2 * self.total_votes() // 3 + 1
 
+    def validity_threshold(self) -> int:
+        # f + 1: the smallest stake that must contain at least one honest
+        # authority.  If N = 3f + 1 + k (0 <= k < 3) then
+        # ceil(N/3) = f + 1.
+        return (self.total_votes() + 2) // 3
+
     def address(self, name: PublicKey) -> Address | None:
         auth = self.authorities.get(name)
         return auth.address if auth is not None else None

@@ -24,24 +24,31 @@ from ..network import Receiver as NetworkReceiver
 from ..network import Writer
 from ..store import Store
 from .config import Committee, Parameters
-from .core import Core, make_event_channels
+from .core import CONSENSUS_STATE_KEY, Core, make_event_channels
 from .errors import SerializationError
 from .helper import Helper
 from .leader import LeaderElector
 from .proposer import Proposer
+from .statesync import StateSyncClient, StateSyncServer
 from .synchronizer import Synchronizer
 from .wire import (
     ACK,
     SCHEME_WIRE_SIZES,
+    STATE_READ_LEDGER,
     TAG_PRODUCER,
     TAG_PRODUCER_V2,
     TAG_PROPOSE,
+    TAG_STATE_CHUNK,
+    TAG_STATE_MANIFEST,
+    TAG_STATE_READ,
+    TAG_STATE_REQUEST,
     TAG_SYNC_REQUEST,
     TAG_TC,
     TAG_TIMEOUT,
     TAG_VOTE,
     decode_message,
     encode_ingest_ack,
+    encode_state_value,
 )
 
 log = logging.getLogger(__name__)
@@ -125,7 +132,8 @@ class ConsensusReceiverHandler:
     #: wire tag -> label on the received-message counters (index == tag)
     TAG_NAMES = (
         "propose", "vote", "timeout", "tc", "sync_request", "producer",
-        "producer_v2",
+        "producer_v2", "state_request", "state_manifest", "state_chunk",
+        "state_read",
     )
 
     def __init__(
@@ -137,10 +145,22 @@ class ConsensusReceiverHandler:
         bodies: PayloadBodies | None = None,
         telemetry=None,
         admission=None,
+        tx_state_requests: asyncio.Queue | None = None,
+        tx_state_sync: asyncio.Queue | None = None,
+        state=None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
         self.tx_producer = tx_producer
+        # State-sync plumbing (consensus/statesync.py): peer snapshot
+        # requests go to the server actor; manifest/chunk replies go to
+        # the boot-time sync client.  ``state`` is the node's
+        # StateMachine, consulted inline for TAG_STATE_READ (the
+        # QC-anchored stale-read path — a lagging node answers at its
+        # last applied version while it catches up).
+        self.tx_state_requests = tx_state_requests
+        self.tx_state_sync = tx_state_sync
+        self.state = state
         # Ingest admission controller (ingest/admission.py): every
         # producer frame consults it; None keeps the legacy
         # always-accept path (bare component tests).
@@ -217,6 +237,13 @@ class ConsensusReceiverHandler:
             elif tag == TAG_PRODUCER_V2:
                 # sampled: the batch's first digest stands for the frame
                 j.record("recv.producer", 0, payload[0][0], "client")
+            elif tag == TAG_STATE_REQUEST:
+                j.record(
+                    "recv.state_req",
+                    payload.from_round,
+                    None,
+                    str(payload.origin)[:8],
+                )
         if tag == TAG_SYNC_REQUEST:
             await self.tx_helper.put(payload)
         elif tag == TAG_PROPOSE:
@@ -305,8 +332,51 @@ class ConsensusReceiverHandler:
                 )
             except (ConnectionError, OSError):
                 pass
+        elif tag == TAG_STATE_REQUEST:
+            if self.tx_state_requests is not None:
+                await self.tx_state_requests.put(payload)
+        elif tag in (TAG_STATE_MANIFEST, TAG_STATE_CHUNK):
+            # replies matter only while the one-shot boot catch-up is
+            # collecting; afterwards nothing drains the queue, so late
+            # frames are shed instead of wedging the receiver on a put
+            if self.tx_state_sync is not None:
+                try:
+                    self.tx_state_sync.put_nowait((tag, payload))
+                except asyncio.QueueFull:
+                    pass
+        elif tag == TAG_STATE_READ:
+            await self._serve_state_read(writer, payload)
         else:
             await self.tx_consensus.put((tag, payload))
+
+    async def _serve_state_read(self, writer: Writer, payload) -> None:
+        """QC-anchored stale read: answer at the last applied version —
+        by construction while catching up, too — with the anchor
+        (version, root, last_round) in the reply."""
+        space, key = payload
+        state = self.state
+        if state is None:
+            reply = encode_state_value(False, 0, b"\x00" * 32, 0, 0, b"")
+        else:
+            version, root, last_round = state.anchor()
+            found, entry_round, value = False, 0, b""
+            if space == STATE_READ_LEDGER:
+                hit = state.read_ledger(key)
+                if hit is not None:
+                    entry_round, seq = hit
+                    found, value = True, seq.to_bytes(4, "little")
+            else:
+                hit = state.read_user(key)
+                if hit is not None:
+                    entry_round, value = hit
+                    found = True
+            reply = encode_state_value(
+                found, version, root, last_round, entry_round, value
+            )
+        try:
+            await writer.send(reply)
+        except (ConnectionError, OSError):
+            pass
 
 
 class Consensus:
@@ -320,6 +390,8 @@ class Consensus:
         self.synchronizer: Synchronizer | None = None
         self.tx_producer: asyncio.Queue | None = None
         self.admission = None
+        self.state_machine = None
+        self.state_server = None
         self._tasks: list[asyncio.Task] = []
 
     @classmethod
@@ -347,6 +419,46 @@ class Consensus:
             verifier = CpuVerifier()
 
         payload_bodies = PayloadBodies(store, parameters.payload_body_budget)
+        # Replicated execution layer (store/state.py): the commit path
+        # applies every committed block through it; the receiver serves
+        # QC-anchored stale reads from it; the state-sync actors below
+        # snapshot it for crash-recovered peers.
+        from ..store.state import StateMachine
+
+        state_machine = StateMachine(store)
+        self.state_machine = state_machine
+        tx_state_requests: asyncio.Queue = asyncio.Queue(
+            maxsize=CHANNEL_CAPACITY
+        )
+        tx_state_sync: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+        if telemetry is not None:
+            telemetry.gauge(
+                "state_version",
+                "Applied state version (committed blocks folded into "
+                "the state root)",
+                fn=lambda s=state_machine: s.version,
+            )
+            telemetry.gauge(
+                "state_last_round",
+                "Round of the last block applied to the state machine",
+                fn=lambda s=state_machine: s.last_round,
+            )
+            telemetry.gauge(
+                "state_applied_payloads",
+                "Payload digests folded into the replicated ledger",
+                fn=lambda s=state_machine: s.applied_payloads,
+            )
+            telemetry.gauge(
+                "state_typed_ops",
+                "Typed user-KV operations materialized from local bodies",
+                fn=lambda s=state_machine: s.typed_ops,
+            )
+            telemetry.gauge(
+                "state_snapshots_served",
+                "Snapshot manifests served to syncing peers",
+                fn=lambda s=state_machine: s.snapshots_served,
+            )
+            telemetry.add_section("state", state_machine.stats)
         if telemetry is not None:
             telemetry.gauge(
                 "payload_pending_bytes",
@@ -491,6 +603,9 @@ class Consensus:
                 bodies=payload_bodies,
                 telemetry=telemetry,
                 admission=admission,
+                tx_state_requests=tx_state_requests,
+                tx_state_sync=tx_state_sync,
+                state=state_machine,
             ),
             fault_plane=fault_plane,
         )
@@ -617,7 +732,39 @@ class Consensus:
             payload_bodies=payload_bodies,
             telemetry=telemetry,
             adversary=adversary,
+            state_machine=state_machine,
         )
+        # State-sync plane (statesync.py): every node serves snapshots;
+        # a recovering node (surviving consensus state ⇒ this is a
+        # restart, not a first boot) additionally runs the one-shot
+        # boot catch-up before entering the protocol.  Modes:
+        # HOTSTUFF_STATE_SYNC=auto (default: catch up when recovering),
+        # always (also on a fresh join), 0/off (never).
+        self.state_server = StateSyncServer(
+            name,
+            committee,
+            state_machine,
+            rx_requests=tx_state_requests,
+            high_qc=lambda c=self.core: c.high_qc,
+            network=make_sender(),
+            telemetry=telemetry,
+        )
+        sync_mode = os.environ.get("HOTSTUFF_STATE_SYNC", "auto").lower()
+        if sync_mode not in ("0", "off", "never"):
+            recovering = (await store.read(CONSENSUS_STATE_KEY)) is not None
+            if (recovering or sync_mode == "always") and (
+                committee.broadcast_addresses(name)
+            ):
+                self.core.state_sync = StateSyncClient(
+                    name,
+                    committee,
+                    state_machine,
+                    verifier,
+                    rx_replies=tx_state_sync,
+                    network=make_sender(),
+                    telemetry=telemetry,
+                )
+        self._tasks.append(self.state_server.spawn())
         self._tasks.append(self.core.spawn())
 
         self.proposer = Proposer(
@@ -698,7 +845,9 @@ class Consensus:
     async def shutdown(self) -> None:
         if self.receiver is not None:
             await self.receiver.shutdown()
-        for component in (self.core, self.proposer, self.helper):
+        for component in (
+            self.core, self.proposer, self.helper, self.state_server,
+        ):
             if component is not None:
                 component.shutdown()
         if self.synchronizer is not None:

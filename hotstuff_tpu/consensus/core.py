@@ -26,7 +26,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..crypto import PublicKey, SignatureService
+from ..crypto import Digest, PublicKey, SignatureService
 from ..crypto.async_service import AsyncVerifyService
 from ..crypto.service import VerifierBackend
 from ..network import SimpleSender
@@ -282,6 +282,7 @@ class Core:
         payload_bodies=None,
         telemetry=None,
         adversary=None,
+        state_machine=None,
     ):
         self.name = name
         self.committee = committee
@@ -330,6 +331,13 @@ class Core:
         # honest nodes; on attacking nodes the vote/timeout/commit
         # seams below consult it for the active policy windows.
         self.adversary = adversary
+        # Replicated execution layer (store/state.py): committed blocks
+        # are applied in commit order and summarized by a state root.
+        self.state = state_machine
+        # Boot-time snapshot catch-up (statesync.StateSyncClient), set
+        # by Consensus.spawn on recovering nodes; run() consults it
+        # once, right after load_state.
+        self.state_sync = None
         self.aggregator = Aggregator(committee, verifier, self_key=name)
         # Async claim preverifier (crypto/async_service.py): device
         # backends get a coalescing off-loop dispatch service (shared
@@ -470,11 +478,18 @@ class Core:
         to_commit = [block]
         parent = block
         while self.last_committed_round + 1 < parent.round:
-            ancestor = await self.synchronizer.get_parent_block(parent)
+            ancestor = await self.synchronizer.get_parent_block(
+                parent, floor=self.last_committed_round
+            )
             if ancestor is None:
                 raise SerializationError(
                     "missing ancestor while committing a delivered chain"
                 )
+            if ancestor.round <= self.last_committed_round:
+                # snapshot barrier (genesis stand-in) or an ancestor the
+                # cursor already covers: nothing below this point needs
+                # (re-)committing
+                break
             to_commit.append(ancestor)
             parent = ancestor
 
@@ -496,6 +511,7 @@ class Core:
             # logging hides the other blocks' payloads from the harness
             # and undercounts TPS after every view change.
             reported = b.digest()
+            shadow = None
             adversary = self.adversary
             if (
                 adversary is not None
@@ -507,13 +523,30 @@ class Core:
                 # reports the shadow branch for colluder-led rounds —
                 # a REAL divergent history the safety checker must
                 # catch and attribute to the colluding authorities
-                reported = adversary.shadow_block(b).digest()
+                shadow = adversary.shadow_block(b).digest()
+                reported = shadow
                 adversary.count("byz_shadow_commits")
                 adversary.record("shadow-commit", b.round, reported)
                 self.log.info(
                     "byz shadow-commit round %d -> %s", b.round, reported
                 )
             self.log.info("Committed block %d -> %s", b.round, reported)
+            if self.state is not None:
+                # execution layer: apply in commit order; the REPORTED
+                # root chains over the reported (possibly shadow)
+                # digests, so a colluder's claimed state diverges
+                # exactly where its claimed digest log does
+                root = self.state.apply_block(b, reported_digest=shadow)
+                if root is not None:
+                    if self._journal is not None:
+                        self._journal.record("state.apply", b.round, b.digest())
+                    # NOTE: this log entry is used to compute performance.
+                    self.log.info(
+                        "State root %d -> %s (round %d)",
+                        self.state.version,
+                        Digest(root),
+                        b.round,
+                    )
         # Tell the proposer what committed: (a) it prunes those digests
         # from its buffer — with single-homed clients (node/client.py)
         # queues are disjoint so this is defense-in-depth against
@@ -685,6 +718,32 @@ class Core:
 
             if self.name == self.leader_elector.get_leader(self.round):
                 await self._generate_proposal(tc)
+        elif (
+            timeout.round > self.round
+            and self.aggregator.timeout_weight(timeout.round)
+            >= self.committee.validity_threshold()
+        ):
+            # Round synchronization (timeout-join): f+1 stake — at least
+            # one honest authority — is provably timing out a round
+            # AHEAD of ours, so that round is legitimate; join it and
+            # emit our own timeout so the TC can complete.  Without
+            # this, a node that missed a one-shot TC broadcast (e.g. it
+            # was inside its state-sync bootstrap when the round
+            # turned) wedges one round behind a committee whose TC
+            # needs this node's timeout — mutual starvation where every
+            # node re-broadcasts timeouts for a round no one else is
+            # in.  A snapshot rejoin under partition makes that window
+            # routine rather than exotic.
+            self.log.info(
+                "Joining timeout round %d (round sync, own round %d)",
+                timeout.round,
+                self.round,
+            )
+            self.round = timeout.round
+            self._saw_proposal = False
+            self.state_changed = True
+            self.aggregator.cleanup(self.round)
+            await self._local_timeout_round()
 
     async def _local_timeout_round(self) -> None:
         self.log.warning("Timeout reached for round %d", self.round)
@@ -746,8 +805,15 @@ class Core:
             self._trace.mark_proposed(block.digest().to_bytes(), block.round)
 
         # b0 <- |qc0; b1| <- |qc1; block|: suspend if ancestors are missing
-        # (the synchronizer will re-inject the block via loopback).
-        ancestors = await self.synchronizer.get_ancestors(block)
+        # (the synchronizer will re-inject the block via loopback).  The
+        # floor is the snapshot barrier: after a QC-anchored snapshot
+        # adoption, ancestry at or below the commit cursor is certified
+        # by the block's own verified QC and already covered by the
+        # snapshot — it resolves to the genesis stand-in instead of a
+        # fetch, so the node can vote (and restore quorum) immediately.
+        ancestors = await self.synchronizer.get_ancestors(
+            block, floor=self.last_committed_round
+        )
         if ancestors is None:
             self.log.debug("Processing of %s suspended: missing parent", block.digest())
             return
@@ -1129,6 +1195,30 @@ class Core:
 
     async def run(self) -> None:
         await self.load_state()
+
+        # Snapshot catch-up BEFORE entering the protocol: adopt a
+        # QC-anchored peer snapshot and jump the commit cursor past the
+        # missed history, so the first post-rejoin commit's ancestor
+        # walk spans only the sync window — never the outage (the
+        # "no history replay" half of state-sync; statesync.py).
+        if self.state_sync is not None:
+            try:
+                adopted = await self.state_sync.bootstrap(
+                    self.last_committed_round
+                )
+            except Exception as e:  # noqa: BLE001 — catch-up is an
+                # optimization; any failure degrades to normal replay
+                self.log.warning("State-sync bootstrap failed: %s", e)
+                adopted = 0
+            if adopted > self.last_committed_round:
+                self.log.info(
+                    "State sync advanced commit cursor %d -> %d "
+                    "(history replay skipped)",
+                    self.last_committed_round,
+                    adopted,
+                )
+                self.last_committed_round = adopted
+                self.state_changed = True
 
         # Bootstrap: propose if we lead the (possibly recovered) round.
         self.timer.reset()

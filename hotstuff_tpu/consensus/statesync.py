@@ -1,0 +1,317 @@
+"""State-sync actors: snapshot serving and boot-time catch-up.
+
+The replicated execution layer (store/state.py) gives every node a
+versioned, root-summarized state.  This module is the protocol on top:
+
+- ``StateSyncServer`` — a Helper-style actor answering StateRequest
+  frames from peers: a manifest (full or delta, anchored by this node's
+  current high QC) or one snapshot chunk.
+
+- ``StateSyncClient`` — the boot-time catch-up path.  A crash-recovered
+  (or explicitly opted-in fresh) node broadcasts a manifest request,
+  adopts the best QC-verified offer that is meaningfully ahead of its
+  own cursor, fetches the chunks from that peer, and installs them.
+  The core then advances ``last_committed_round`` to the snapshot
+  round, so the commit-time ancestor walk never replays the missed
+  history — rejoin cost is the snapshot transfer, not the outage
+  length.
+
+Trust model: a chained state root summarizes history the snapshot
+deliberately omits, so it cannot be recomputed from snapshot content.
+The client trusts a manifest only when its embedded QC verifies against
+the client's own committee AND ``qc.round >= manifest.last_round`` —
+i.e. some quorum certified progress at least as far as the offered
+cursor.  A lying peer can still under-report (harmless: the delta apply
+path re-derives everything deterministically) but cannot fabricate a
+certified future.
+
+Snapshot cuts are best-effort under concurrent commits: entries that
+race a commit between manifest and chunk serving may shift chunks or
+arrive from a newer version.  Duplicates are idempotent puts; anything
+missed at rounds beyond the manifest cursor is re-materialized by the
+normal apply path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+from ..crypto import PublicKey
+from ..network import SimpleSender
+from ..store.state import SnapshotManifest, StateMachine
+from .config import Committee
+from .errors import ConsensusError
+from .wire import (
+    STATE_REQ_CHUNK,
+    STATE_REQ_DELTA,
+    STATE_REQ_MANIFEST,
+    TAG_STATE_CHUNK,
+    TAG_STATE_MANIFEST,
+    StateRequest,
+    encode_state_chunk,
+    encode_state_manifest,
+    encode_state_request,
+)
+
+log = logging.getLogger(__name__)
+
+#: a manifest must be at least this many rounds ahead of the local
+#: commit cursor to be worth adopting — below it, the ordinary commit
+#: path catches up faster than a snapshot round-trip
+SYNC_MIN_LAG_ROUNDS = 8
+#: manifest collection window and chunk-transfer deadline (seconds)
+SYNC_MANIFEST_WAIT_S = 1.0
+SYNC_CHUNK_WAIT_S = 5.0
+
+
+class StateSyncServer:
+    """Answers peers' StateRequest frames from the local state machine,
+    anchoring every manifest with this node's current high QC."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        state: StateMachine,
+        rx_requests: asyncio.Queue,
+        high_qc,
+        network: SimpleSender | None = None,
+        telemetry=None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.state = state
+        self.rx_requests = rx_requests
+        self.high_qc = high_qc  # () -> the core's current high QC
+        self.network = network if network is not None else SimpleSender()
+        self._journal = telemetry.journal if telemetry is not None else None
+        self._task: asyncio.Task | None = None
+
+    async def run(self) -> None:
+        while True:
+            req: StateRequest = await self.rx_requests.get()
+            address = self.committee.address(req.origin)
+            if address is None or req.origin == self.name:
+                log.warning(
+                    "Dropping state request from unknown origin %s",
+                    req.origin,
+                )
+                continue
+            if req.kind == STATE_REQ_CHUNK:
+                entries = self.state.chunk(req.index, req.from_round)
+                reply = encode_state_chunk(
+                    self.state.version, req.index, req.from_round, entries
+                )
+            else:
+                from_round = (
+                    req.from_round if req.kind == STATE_REQ_DELTA else 0
+                )
+                m = self.state.manifest(from_round)
+                reply = encode_state_manifest(
+                    m.version,
+                    m.root,
+                    m.last_round,
+                    m.applied_payloads,
+                    m.chunk_count,
+                    from_round,
+                    self.high_qc(),
+                    self.name,
+                )
+                self.state.snapshots_served += 1
+                if self._journal is not None:
+                    self._journal.record(
+                        "sync.serve", m.last_round, None, str(req.origin)[:8]
+                    )
+            await self.network.send(address, reply)
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.get_running_loop().create_task(
+            self.run(), name="state-sync-server"
+        )
+        return self._task
+
+    def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.network.close()
+
+
+class StateSyncClient:
+    """One-shot boot-time catch-up.  ``bootstrap`` returns the adopted
+    snapshot round (0 when nothing was adopted); the caller advances
+    the consensus commit cursor past the snapshotted history."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        state: StateMachine,
+        verifier,
+        rx_replies: asyncio.Queue,
+        network: SimpleSender | None = None,
+        min_lag: int | None = None,
+        manifest_wait_s: float | None = None,
+        chunk_wait_s: float = SYNC_CHUNK_WAIT_S,
+        telemetry=None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.state = state
+        self.verifier = verifier
+        self.rx_replies = rx_replies
+        self.network = network if network is not None else SimpleSender()
+        if min_lag is None:
+            min_lag = int(
+                os.environ.get("HOTSTUFF_STATE_SYNC_LAG", SYNC_MIN_LAG_ROUNDS)
+            )
+        if manifest_wait_s is None:
+            manifest_wait_s = (
+                int(os.environ.get("HOTSTUFF_STATE_SYNC_WAIT_MS", 0)) / 1000
+                or SYNC_MANIFEST_WAIT_S
+            )
+        self.min_lag = min_lag
+        self.manifest_wait_s = manifest_wait_s
+        self.chunk_wait_s = chunk_wait_s
+        self._journal = telemetry.journal if telemetry is not None else None
+        self._qc_cache: set = set()
+        self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
+
+    async def _collect(self, deadline: float):
+        while True:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return None
+            try:
+                return await asyncio.wait_for(
+                    self.rx_replies.get(), timeout=remaining
+                )
+            except asyncio.TimeoutError:
+                return None
+
+    def _acceptable(self, m, from_round: int, floor: int) -> bool:
+        if m.from_round != from_round or m.version <= self.state.version:
+            return False
+        if m.last_round <= floor + self.min_lag:
+            return False
+        if m.qc.is_genesis() or m.qc.round < m.last_round:
+            return False
+        if self.committee.address(m.origin) is None:
+            return False
+        try:
+            m.qc.verify(self.committee, self.verifier, cache=self._qc_cache)
+        except ConsensusError as e:
+            self.log.warning("Rejecting state manifest with bad QC: %s", e)
+            return False
+        return True
+
+    async def bootstrap(self, last_committed_round: int) -> int:
+        peers = [
+            addr for _, addr in self.committee.broadcast_addresses(self.name)
+        ]
+        if not peers:
+            return 0
+        started = time.monotonic()
+        floor = max(last_committed_round, self.state.last_round)
+        # delta when local state survived the crash; full otherwise
+        from_round = self.state.last_round
+        kind = STATE_REQ_DELTA if from_round else STATE_REQ_MANIFEST
+        await self.network.broadcast(
+            peers, encode_state_request(kind, self.name, from_round=from_round)
+        )
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.manifest_wait_s
+        best = None
+        seen = 0
+        while seen < len(peers):
+            msg = await self._collect(deadline)
+            if msg is None:
+                break
+            tag, payload = msg
+            if tag != TAG_STATE_MANIFEST:
+                continue  # stray chunk from a previous attempt
+            seen += 1
+            if self._journal is not None:
+                self._journal.record(
+                    "sync.manifest",
+                    payload.last_round,
+                    None,
+                    str(payload.origin)[:8],
+                )
+            if self._acceptable(payload, from_round, floor) and (
+                best is None or payload.version > best.version
+            ):
+                best = payload
+        if best is None:
+            self.log.info(
+                "State sync: no snapshot ahead of round %d (%d offers)",
+                floor,
+                seen,
+            )
+            return 0
+
+        address = self.committee.address(best.origin)
+        pending = set(range(best.chunk_count))
+        for index in pending:
+            await self.network.send(
+                address,
+                encode_state_request(
+                    STATE_REQ_CHUNK,
+                    self.name,
+                    index=index,
+                    from_round=from_round,
+                ),
+            )
+        entries: list = []
+        deadline = loop.time() + self.chunk_wait_s
+        while pending:
+            msg = await self._collect(deadline)
+            if msg is None:
+                break
+            tag, payload = msg
+            if tag != TAG_STATE_CHUNK:
+                continue
+            if (
+                payload.version < best.version
+                or payload.from_round != from_round
+                or payload.index not in pending
+            ):
+                continue
+            pending.discard(payload.index)
+            entries.extend(payload.entries)
+            if self._journal is not None:
+                self._journal.record("sync.chunk", payload.index)
+        if pending:
+            self.log.warning(
+                "State sync abandoned: %d/%d chunks missing from %s",
+                len(pending),
+                best.chunk_count,
+                str(best.origin)[:8],
+            )
+            return 0
+
+        manifest = SnapshotManifest(
+            best.version,
+            best.root,
+            best.last_round,
+            best.applied_payloads,
+            best.chunk_count,
+        )
+        self.state.adopt(manifest, entries)
+        elapsed = time.monotonic() - started
+        if self._journal is not None:
+            self._journal.record("sync.adopt", best.last_round)
+        # NOTE: this log entry is used to compute performance.
+        self.log.info(
+            "Adopted state snapshot version %d at round %d from %s "
+            "(%d entries, %.3f s)",
+            best.version,
+            best.last_round,
+            str(best.origin)[:8],
+            len(entries),
+            elapsed,
+        )
+        return best.last_round

@@ -162,9 +162,22 @@ class Synchronizer:
                 )
         self._ensure_retry_task()
 
-    async def get_parent_block(self, block: Block) -> Block | None:
+    async def get_parent_block(
+        self, block: Block, floor: int = -1
+    ) -> Block | None:
         """The block certified by ``block.qc``; None if it must be fetched
-        (in which case processing of ``block`` is suspended)."""
+        (in which case processing of ``block`` is suspended).
+
+        ``floor`` is the snapshot barrier: a node that adopted a
+        QC-anchored state snapshot holds no block history at or below its
+        commit cursor, and that history must never be fetched — otherwise
+        a snapshot rejoin degenerates into the hop-by-hop ancestry
+        backfill the snapshot exists to skip (and stalls outright when an
+        old proposer is unreachable).  A missing parent certified at or
+        below the floor resolves to the genesis stand-in: the block's own
+        verified QC vouches for it, its state effects are inside the
+        snapshot, and callers only read ``.round`` from it (the 2-chain
+        commit rule can never fire across the cut)."""
         if block.qc.is_genesis():
             return Block.genesis()
         data = await self.store.read(block.parent.to_bytes())
@@ -173,18 +186,24 @@ class Synchronizer:
                 return Block.deserialize(data)
             except Exception as e:
                 raise SerializationError(f"corrupt block in store: {e}") from e
+        if block.qc.round <= floor:
+            return Block.genesis()
         await self._request_parent(block)
         return None
 
-    async def get_ancestors(self, block: Block) -> tuple[Block, Block] | None:
+    async def get_ancestors(
+        self, block: Block, floor: int = -1
+    ) -> tuple[Block, Block] | None:
         """(b0, b1) with b0 <- |qc0; b1| <- |qc1; block|, or None if the
-        parent chain is not yet locally available."""
-        b1 = await self.get_parent_block(block)
+        parent chain is not yet locally available.  ``floor`` applies the
+        snapshot barrier (see get_parent_block) to both hops."""
+        b1 = await self.get_parent_block(block, floor)
         if b1 is None:
             return None
-        b0 = await self.get_parent_block(b1)
+        b0 = await self.get_parent_block(b1, floor)
         if b0 is None:
-            # Delivered blocks have stored ancestors (synchronizer.rs:142-146);
+            # Delivered blocks have stored ancestors (synchronizer.rs:142-146)
+            # except across a snapshot cut (handled by the floor above);
             # reaching here means the store lost data.
             raise SerializationError(
                 f"missing ancestor of delivered block {b1.digest()}"

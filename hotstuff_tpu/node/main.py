@@ -211,6 +211,19 @@ def _apply_ingest(args) -> None:
         os.environ["HOTSTUFF_INGEST_WATERMARK"] = str(w)
 
 
+def _apply_fresh_state(args) -> None:
+    """Bridge ``--fresh-state`` into HOTSTUFF_FRESH_STATE: an explicit
+    escape hatch forcing every booted node to discard its persisted
+    store.  Normally unnecessary — the committee-hash provenance check
+    (node.py) already rejects state from a different committee, and
+    matching state is exactly what crash recovery and snapshot
+    state-sync want to keep."""
+    import os
+
+    if getattr(args, "fresh_state", False):
+        os.environ["HOTSTUFF_FRESH_STATE"] = "1"
+
+
 def _apply_fault_plane(args) -> None:
     """Activate the chaos plane when ``--fault-plane`` was given: the
     flag value (a spec file path or inline JSON) lands in
@@ -249,6 +262,7 @@ async def _run_node(args) -> None:
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
     _apply_ingest(args)
+    _apply_fresh_state(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
         committee_file=args.committee,
@@ -307,6 +321,7 @@ async def _run_many(args) -> None:
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
     _apply_ingest(args)
+    _apply_fresh_state(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
     # Co-location hint: the verifier layer coalesces all these nodes'
@@ -410,15 +425,12 @@ async def _deploy_testbed(
         secret.write(f".node_{i}.json")
 
     # The testbed's keypairs are FRESH every run, so a leftover .db_*
-    # from an earlier deployment can never belong to this committee —
-    # recovering its consensus state would boot the new committee at a
-    # stale round with another committee's high_qc (observed: a fresh
-    # testbed "recovering" to round ~800).  Wipe before boot.
-    import shutil
-
-    for i in range(nodes):
-        shutil.rmtree(f".db_{i}", ignore_errors=True)
-
+    # from an earlier deployment can never belong to this committee.
+    # No blanket wipe here anymore: Node.new's committee-hash provenance
+    # check detects the mismatch and discards the stale store by
+    # construction (the "fresh testbed recovers to round ~800" class),
+    # while state that DOES match the committee survives for crash
+    # recovery and snapshot state-sync.  --fresh-state forces a wipe.
     booted = []
     for i in range(nodes):
         node = await Node.new(
@@ -544,12 +556,20 @@ def main(argv=None) -> int:
         metavar="N",
         help=max_pending_help,
     )
+    fresh_state_help = (
+        "discard any persisted store before booting (escape hatch; by "
+        "default matching state is recovered and mismatched-committee "
+        "state is rejected by the provenance check)"
+    )
     p_run.add_argument(
         "--ingest-watermark",
         type=float,
         default=None,
         metavar="F",
         help=watermark_help,
+    )
+    p_run.add_argument(
+        "--fresh-state", action="store_true", help=fresh_state_help
     )
 
     p_many = sub.add_parser(
@@ -599,6 +619,9 @@ def main(argv=None) -> int:
         metavar="F",
         help=watermark_help,
     )
+    p_many.add_argument(
+        "--fresh-state", action="store_true", help=fresh_state_help
+    )
 
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
@@ -637,6 +660,9 @@ def main(argv=None) -> int:
         metavar="F",
         help=watermark_help,
     )
+    p_dep.add_argument(
+        "--fresh-state", action="store_true", help=fresh_state_help
+    )
 
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
@@ -660,6 +686,7 @@ def main(argv=None) -> int:
         _apply_verify_pipeline(args)
         _apply_mesh_devices(args)
         _apply_ingest(args)
+        _apply_fresh_state(args)
         asyncio.run(
             _deploy_testbed(
                 args.nodes,

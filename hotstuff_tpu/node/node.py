@@ -29,6 +29,25 @@ from .config import ConfigError, Secret, read_committee, read_parameters
 
 log = logging.getLogger(__name__)
 
+#: provenance tag on persisted state: the hash of the committee the
+#: store's consensus/state records were produced under.  Disjoint from
+#: every other store namespace (32-byte digests, 8-byte round keys,
+#: ``consensus_state``, ``latest_round``, ``p<digest>``, ``s/...``).
+COMMITTEE_HASH_KEY = b"committee_hash"
+
+
+def committee_hash(committee) -> bytes:
+    """Canonical identity of a committee (or schedule): the digest of
+    its sorted-key JSON form — the same serialization the config files
+    carry, so identical files hash identically across nodes."""
+    import json
+
+    from ..crypto.digest import sha512_trunc
+
+    return sha512_trunc(
+        json.dumps(committee.to_json(), sort_keys=True).encode()
+    )
+
 
 class _DeviceDispatch:
     """Forced-device view of a shared BatchVerifier for the async verify
@@ -259,6 +278,33 @@ class Node:
         )
 
         self.store = Store(store_path)
+        # Committee-hash provenance: persisted consensus/execution state
+        # is only valid under the committee that produced it.  A store
+        # carrying another committee's history (the testbed's recycled
+        # .db_* paths — the "fresh deploy recovers to round ~800" class)
+        # is rejected EXPLICITLY and discarded, which is what makes the
+        # old boot-time blanket wipe unnecessary on the happy path.
+        # HOTSTUFF_FRESH_STATE=1 (--fresh-state) stays as the escape
+        # hatch to force a clean slate regardless of provenance.
+        chash = committee_hash(committee)
+        stored_hash = self.store.engine.get(COMMITTEE_HASH_KEY)
+        fresh = os.environ.get("HOTSTUFF_FRESH_STATE", "") not in ("", "0")
+        if fresh or (stored_hash is not None and stored_hash != chash):
+            if fresh:
+                log.info("Discarding persisted state (--fresh-state)")
+            else:
+                log.warning(
+                    "Rejecting persisted state from a different committee "
+                    "(stored %s, ours %s): starting fresh",
+                    stored_hash.hex()[:16],
+                    chash.hex()[:16],
+                )
+            self.store.close()
+            import shutil
+
+            shutil.rmtree(store_path, ignore_errors=True)
+            self.store = Store(store_path)
+        self.store.engine.put(COMMITTEE_HASH_KEY, chash)
         signature_service = make_signing_service(secret.scheme, secret.secret)
         if len(schemes) == 1:
             verifier = make_verifier(verifier_backend, next(iter(schemes)))

@@ -20,6 +20,9 @@ non-zero when either guarded metric regresses past the threshold
     largest benched committee (ISSUE 9; per-guard 75% gate — the value
     is a single host pairing, so only a structural regression such as
     losing the key-sum memo or the native pairing should trip it)
+  * ``state.apply_tx_s`` / ``state.sync_catchup_s`` — replicated
+    execution-layer apply throughput and snapshot serve+adopt wall cost
+    (ISSUE 11; wide per-guard 50% gates, skip-if-missing)
 
 ``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
 (ISSUE 6): the fresh value must stay within ``--ratchet-slack``
@@ -115,6 +118,24 @@ GUARDS = (
         lambda doc: (doc.get("load") or {}).get("client_p99_ms"),
         +1,
         0.75,
+    ),
+    # replicated execution layer (ISSUE 11): typed-op apply throughput
+    # through StateMachine.apply_block and the wall cost of a full
+    # snapshot serve+adopt cycle (the no-replay rejoin path).  Both run
+    # on the WAL engine of a shared single-core rig, so the per-guard
+    # gates are wide; skip-if-missing covers references from before the
+    # state block existed.
+    (
+        "state.apply_tx_s",
+        lambda doc: (doc.get("state") or {}).get("apply_tx_s"),
+        -1,
+        0.5,
+    ),
+    (
+        "state.sync_catchup_s",
+        lambda doc: (doc.get("state") or {}).get("sync_catchup_s"),
+        +1,
+        0.5,
     ),
 )
 

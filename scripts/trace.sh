@@ -39,6 +39,13 @@
 #                                 # overload with a squeezed proposer
 #                                 # buffer, non-zero exit on any silent
 #                                 # drop-newest
+#   STATE=1 scripts/trace.sh      # ONLY the replicated execution-layer
+#                                 # check (scripts/state_check.py):
+#                                 # SIGKILLed node rejoins via snapshot
+#                                 # state-sync with a converging root,
+#                                 # byz-collude FAILs full-history root
+#                                 # agreement while the trusted subset
+#                                 # PASSes, non-zero exit on any break
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -66,6 +73,11 @@ fi
 if [ "${LOAD:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/load_check.py "$@"
+fi
+
+if [ "${STATE:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/state_check.py "$@"
 fi
 
 timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -93,6 +93,42 @@ async def test_synchronizer_miss_requests_then_loopback(tmp_path):
     store.close()
 
 
+@async_test
+async def test_synchronizer_snapshot_barrier(tmp_path):
+    """A missing parent certified at or below the floor (the adopted
+    snapshot's commit cursor) resolves to the genesis stand-in instead of
+    a network fetch: a snapshot rejoin must not backfill pre-snapshot
+    ancestry, which may be unreachable under an active partition."""
+    store = Store(str(tmp_path / "db"))
+    base = fresh_base_port()
+    blocks = chain(2)
+    name = keys()[0][0]
+    sync = Synchronizer(
+        name, committee(base), store, asyncio.Queue(), 10_000
+    )
+    child = blocks[1]  # parent blocks[0] deliberately NOT in the store
+    # at/below the floor: stand-in, and no request or waiter is parked
+    parent = await sync.get_parent_block(child, floor=child.qc.round)
+    assert parent == Block.genesis()
+    assert not sync._requests and not sync._pending
+    # above the floor: the ordinary fetch path engages and suspends
+    assert (
+        await sync.get_parent_block(child, floor=child.qc.round - 1) is None
+    )
+    assert sync._requests and sync._pending
+    # get_ancestors applies the barrier to both hops: the outer hop
+    # finds nothing below the floor to fetch either
+    sync2 = Synchronizer(
+        name, committee(base), store, asyncio.Queue(), 10_000
+    )
+    ancestors = await sync2.get_ancestors(child, floor=child.qc.round)
+    assert ancestors == (Block.genesis(), Block.genesis())
+    assert not sync2._requests
+    sync.shutdown()
+    sync2.shutdown()
+    store.close()
+
+
 def test_parameters_reject_incoherent_backoff():
     """ADVICE r3: a backoff < 1.0 would geometrically SHRINK the round
     timer under consecutive timeouts (view-change storm from a typo); a

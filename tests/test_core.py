@@ -148,6 +148,37 @@ async def test_local_timeout_broadcasts(tmp_path):
 
 
 @async_test
+async def test_timeout_join_round_sync(tmp_path):
+    """f+1 distinct timeouts for a round AHEAD of ours make the core
+    join that round and emit its own timeout (round synchronization): a
+    node that missed a one-shot TC broadcast — routine during a
+    snapshot-sync bootstrap — must not wedge one round behind a
+    committee whose next TC needs this node's timeout."""
+    from hotstuff_tpu.consensus import QC
+
+    base = fresh_base_port()
+    h = make_core(tmp_path, base, name_idx=0, timeout_ms=60_000)
+    try:
+        ks = keys()
+        assert h.core.round == 1
+        # one authority ahead of us: below the f+1 validity threshold,
+        # we stay put
+        await h.core._handle_timeout(
+            signed_timeout(QC.genesis(), 3, ks[1][0], ks[1][1])
+        )
+        assert h.core.round == 1
+        # a second distinct authority reaches f+1 = 2 of 4: join round
+        # 3 and time it out ourselves — and with 3 of 4 timeouts the TC
+        # assembles immediately, advancing the core into round 4
+        await h.core._handle_timeout(
+            signed_timeout(QC.genesis(), 3, ks[2][0], ks[2][1])
+        )
+        assert h.core.round == 4
+    finally:
+        teardown(h)
+
+
+@async_test
 async def test_local_timeout_fires_under_message_flood(tmp_path):
     """View-change liveness bound: a flood of cheap protocol messages
     queued ahead of the timer must delay the local timeout by at most

@@ -345,6 +345,74 @@ def test_commits_from_logs(tmp_path):
     assert ok
 
 
+def test_state_root_agreement():
+    from benchmark.invariants import check_state_root_agreement
+
+    # agreement: same root per version, even when a snapshot-rejoined
+    # node skips versions and a restarted node re-reports one
+    ok, viol, details = check_state_root_agreement({
+        "node-0": [(1, "R1", 1), (2, "R2", 2), (3, "R3", 3)],
+        "node-1": [(1, "R1", 1), (2, "R2", 2), (2, "R2", 2), (3, "R3", 3)],
+        "node-2": [(3, "R3", 3)],  # snapshot rejoin: versions 1-2 skipped
+    })
+    assert ok and not viol
+    assert details["versions_compared"] == 3
+    assert details["max_version"] == 3
+
+    # divergence at one version is a violation naming both parties
+    ok, viol, _ = check_state_root_agreement({
+        "node-0": [(1, "R1", 1), (2, "R2", 2)],
+        "node-1": [(1, "R1", 1), (2, "SHADOW", 2)],
+    })
+    assert not ok
+    assert "version 2" in viol[0]
+    assert "SHADOW" in viol[0]
+
+    # a node contradicting ITSELF at a version is also a violation
+    ok, viol, _ = check_state_root_agreement({
+        "node-0": [(1, "R1", 1), (1, "R1b", 1)],
+    })
+    assert not ok and "two state roots" in viol[0]
+
+    # no roots at all -> n/a, not a failure
+    ok, viol, details = check_state_root_agreement({"node-0": []})
+    assert ok is None and not viol
+    assert details["nodes_reporting"] == 0
+
+
+def test_state_roots_from_logs_and_block_rendering(tmp_path):
+    from benchmark.invariants import (
+        check_state_root_agreement,
+        state_roots_from_logs,
+    )
+
+    (tmp_path / "node-0.log").write_text(
+        "2026-01-01T00:00:01.000Z [INFO] core State root 1 -> AA (round 2)\n"
+        "2026-01-01T00:00:02.000Z [INFO] core State root 2 -> BB (round 3)\n"
+    )
+    (tmp_path / "node-1.log").write_text(
+        "2026-01-01T00:00:01.200Z [INFO] core State root 1 -> AA (round 2)\n"
+        "2026-01-01T00:00:02.300Z [INFO] core State root 2 -> XX (round 3)\n"
+    )
+    roots = state_roots_from_logs(str(tmp_path))
+    assert roots["node-0"] == [(1, "AA", 2), (2, "BB", 3)]
+    ok, viol, details = check_state_root_agreement(roots)
+    assert not ok and len(viol) == 1
+
+    block = chaos_block("x", 0, True, [], None, [], {},
+                        state_ok=ok, state_violations=viol,
+                        state_details=details)
+    assert "State-root agreement: FAIL" in block
+    assert "state-root divergence at version 2" in block
+    block = chaos_block("x", 0, True, [], None, [], {},
+                        state_ok=None, state_violations=[],
+                        state_details={"versions_compared": 0})
+    assert "State-root agreement: n/a" in block
+    # no state_details at all -> line omitted entirely
+    block = chaos_block("x", 0, True, [], None, [], {})
+    assert "State-root" not in block
+
+
 # ---- the chaos runner (config only; full runs live in the slow tier) -------
 
 

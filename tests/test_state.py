@@ -1,0 +1,340 @@
+"""Replicated execution layer unit tests: typed-op codec, incremental
+state root, determinism across replicas, meta persistence, snapshot
+manifest/chunk/adopt roundtrips, delta filtering, and the state wire
+frames (request/manifest/chunk/read/value).
+
+The e2e half (SIGKILL + snapshot rejoin with converging roots) lives in
+tests/test_crash_rejoin_e2e.py; these tests pin the building blocks it
+relies on.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from hotstuff_tpu.consensus.errors import SerializationError
+from hotstuff_tpu.consensus.wire import (
+    MAX_STATE_CHUNK_ENTRIES,
+    STATE_REQ_CHUNK,
+    STATE_REQ_DELTA,
+    STATE_REQ_MANIFEST,
+    STATE_READ_LEDGER,
+    STATE_READ_USER,
+    STATE_VALUE_TAG,
+    TAG_STATE_CHUNK,
+    TAG_STATE_MANIFEST,
+    TAG_STATE_READ,
+    TAG_STATE_REQUEST,
+    decode_message,
+    decode_state_value,
+    encode_state_chunk,
+    encode_state_manifest,
+    encode_state_read,
+    encode_state_request,
+    encode_state_value,
+)
+from hotstuff_tpu.crypto import Digest
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.store.state import (
+    GENESIS_ROOT,
+    MAX_OPS_PER_BODY,
+    OP_BODY_OFFSET,
+    OP_MAGIC,
+    SNAPSHOT_CHUNK_ENTRIES,
+    SnapshotManifest,
+    StateError,
+    StateMachine,
+    decode_ops,
+    encode_ops,
+    fold_root,
+)
+
+from .common import chain, keys, qc_for_block
+
+
+def _store(tmp_path, name: str) -> Store:
+    return Store(str(tmp_path / name))
+
+
+def _typed_body(ops) -> bytes:
+    """A payload body as the ingest plane stores it: the 8-byte producer
+    counter prefix, then the typed-op blob."""
+    return b"\x00" * OP_BODY_OFFSET + encode_ops(ops)
+
+
+# ---- typed-op codec --------------------------------------------------------
+
+
+def test_ops_codec_roundtrip():
+    ops = [
+        ("put", b"alpha", b"1"),
+        ("del", b"beta"),
+        ("put", b"gamma", b""),
+        ("put", b"k" * 256, b"v" * 4096),
+    ]
+    body = _typed_body(ops)
+    assert decode_ops(body) == ops
+    assert decode_ops(_typed_body([])) == []
+
+
+def test_decode_ops_rejects_malformed():
+    # opaque (non-typed) bodies are legal and decode to None
+    assert decode_ops(b"\x00" * OP_BODY_OFFSET + b"not-typed") is None
+    assert decode_ops(b"") is None
+
+    good = _typed_body([("put", b"key", b"value")])
+    # truncation anywhere inside the op must yield None, never raise
+    for cut in range(OP_BODY_OFFSET + len(OP_MAGIC) + 1, len(good)):
+        assert decode_ops(good[:cut]) is None
+
+    prefix = b"\x00" * OP_BODY_OFFSET + OP_MAGIC
+    # zero-length key
+    assert decode_ops(prefix + bytes([0, 0, 0, 0, 0, 0, 0])) is None
+    # unknown op kind
+    assert decode_ops(prefix + bytes([7, 1, 0, 0, 0, 0, 0]) + b"k") is None
+    # delete carrying a value length
+    assert decode_ops(prefix + bytes([1, 1, 0, 1, 0, 0, 0]) + b"k") is None
+    # op-count bomb
+    too_many = _typed_body(
+        [("put", b"k", b"v")] * (MAX_OPS_PER_BODY + 1)
+    )
+    assert decode_ops(too_many) is None
+    # exactly at the cap is fine
+    at_cap = _typed_body([("put", b"k", b"v")] * MAX_OPS_PER_BODY)
+    assert len(decode_ops(at_cap)) == MAX_OPS_PER_BODY
+
+
+def test_fold_root_accepts_bytes_and_digest():
+    d = Digest.random()
+    block = Digest.random().to_bytes()
+    via_digest = fold_root(GENESIS_ROOT, 7, block, [d])
+    via_bytes = fold_root(GENESIS_ROOT, 7, block, [d.to_bytes()])
+    assert via_digest == via_bytes
+    assert via_digest != GENESIS_ROOT
+    # the fold is order- and round-sensitive
+    assert fold_root(GENESIS_ROOT, 8, block, [d]) != via_digest
+
+
+# ---- deterministic apply ---------------------------------------------------
+
+
+def test_apply_is_deterministic_across_replicas(tmp_path):
+    blocks = chain(5)
+    sm_a = StateMachine(_store(tmp_path, "a"))
+    sm_b = StateMachine(_store(tmp_path, "b"))
+    for block in blocks:
+        root_a = sm_a.apply_block(block)
+        root_b = sm_b.apply_block(block)
+        assert root_a == root_b
+    assert sm_a.version == sm_b.version == len(blocks)
+    assert sm_a.root == sm_b.root
+    assert sm_a.reported_root == sm_a.root
+    assert sm_a.last_round == blocks[-1].round
+
+
+def test_reported_root_diverges_under_shadow_digest(tmp_path):
+    blocks = chain(3)
+    honest = StateMachine(_store(tmp_path, "honest"))
+    collude = StateMachine(_store(tmp_path, "collude"))
+    for block in blocks[:-1]:
+        honest.apply_block(block)
+        collude.apply_block(block)
+    honest.apply_block(blocks[-1])
+    collude.apply_block(blocks[-1], reported_digest=Digest.random())
+    # the lie shows up in the claimed root, never in the real state
+    assert collude.root == honest.root
+    assert collude.reported_root != honest.reported_root
+
+
+def test_apply_skips_already_applied_rounds(tmp_path):
+    blocks = chain(2)
+    sm = StateMachine(_store(tmp_path, "db"))
+    assert sm.apply_block(blocks[0]) is not None
+    before = (sm.version, sm.root, sm.applied_payloads)
+    # crash-recovery overlap: the consensus cursor can trail state
+    assert sm.apply_block(blocks[0]) is None
+    assert (sm.version, sm.root, sm.applied_payloads) == before
+    assert sm.apply_block(blocks[1]) is not None
+    assert sm.version == 2
+
+
+def test_meta_persists_across_reopen(tmp_path):
+    store = _store(tmp_path, "db")
+    sm = StateMachine(store)
+    for block in chain(4):
+        sm.apply_block(block)
+    anchor = sm.anchor()
+    reported = sm.reported_root
+    store.engine.close()
+
+    sm2 = StateMachine(_store(tmp_path, "db"))
+    assert sm2.anchor() == anchor
+    assert sm2.reported_root == reported
+    assert sm2.applied_payloads == sm.applied_payloads
+
+
+# ---- typed ops and the read path -------------------------------------------
+
+
+def test_typed_ops_materialize_user_state(tmp_path):
+    store = _store(tmp_path, "db")
+    blocks = chain(3)
+    # stash typed bodies for the first two blocks' payloads, as the
+    # ingest plane would have before commit
+    body0 = _typed_body([("put", b"user", b"v1")])
+    body1 = _typed_body([("put", b"user", b"v2"), ("del", b"gone")])
+    store.engine.put(b"p" + blocks[0].payloads[0].to_bytes(), body0)
+    store.engine.put(b"p" + blocks[1].payloads[0].to_bytes(), body1)
+
+    sm = StateMachine(store)
+    for block in blocks:
+        sm.apply_block(block)
+
+    round_, value = sm.read_user(b"user")
+    assert value == b"v2"
+    assert round_ == blocks[1].round
+    # tombstone and never-written keys both read as absent
+    assert sm.read_user(b"gone") is None
+    assert sm.read_user(b"never") is None
+    assert sm.typed_ops == 3
+
+    # every committed payload is in the ledger index
+    for block in blocks:
+        entry = sm.read_ledger(block.payloads[0].to_bytes())
+        assert entry == (block.round, 0)
+    assert sm.read_ledger(Digest.random().to_bytes()) is None
+
+
+# ---- snapshots -------------------------------------------------------------
+
+
+def test_snapshot_roundtrip_into_fresh_store(tmp_path):
+    src_store = _store(tmp_path, "src")
+    blocks = chain(6)
+    src_store.engine.put(
+        b"p" + blocks[2].payloads[0].to_bytes(),
+        _typed_body([("put", b"carried", b"over")]),
+    )
+    src = StateMachine(src_store)
+    for block in blocks:
+        src.apply_block(block)
+
+    manifest = src.manifest()
+    assert manifest.version == src.version
+    assert manifest.root == src.root
+    entries = []
+    for index in range(manifest.chunk_count):
+        chunk = src.chunk(index)
+        assert 0 < len(chunk) <= SNAPSHOT_CHUNK_ENTRIES
+        entries.extend(chunk)
+
+    dst = StateMachine(_store(tmp_path, "dst"))
+    dst.adopt(manifest, entries)
+    assert dst.anchor() == src.anchor()
+    assert dst.reported_root == src.root
+    assert dst.synced_from_snapshot
+    # the adopted state answers the same reads as the source
+    assert dst.read_user(b"carried") == src.read_user(b"carried")
+    for block in blocks:
+        digest = block.payloads[0].to_bytes()
+        assert dst.read_ledger(digest) == src.read_ledger(digest)
+
+
+def test_delta_entries_filter_by_round(tmp_path):
+    sm = StateMachine(_store(tmp_path, "db"))
+    blocks = chain(6)
+    for block in blocks:
+        sm.apply_block(block)
+    cut = blocks[3].round
+    full = sm._entries()
+    delta = sm._entries(from_round=cut)
+    assert len(full) == len(blocks)
+    assert len(delta) == len([b for b in blocks if b.round > cut])
+    assert set(delta) <= set(full)
+    for _, value in delta:
+        assert int.from_bytes(value[:8], "little") > cut
+    # the delta manifest still anchors at the server's full cursor
+    assert sm.manifest(from_round=cut).version == sm.version
+
+
+def test_adopt_rejects_entries_outside_state_namespace(tmp_path):
+    sm = StateMachine(_store(tmp_path, "db"))
+    manifest = SnapshotManifest(1, Digest.random().to_bytes(), 1, 0, 1)
+    with pytest.raises(StateError):
+        sm.adopt(manifest, [(b"p" + b"\x00" * 32, b"smuggled body")])
+    with pytest.raises(StateError):
+        sm.adopt(manifest, [(b"s/meta", b"cursor overwrite")])
+    # a poisoned snapshot must not move the cursor
+    assert sm.version == 0
+    assert sm.root == GENESIS_ROOT
+
+
+# ---- state wire frames -----------------------------------------------------
+
+
+def test_state_request_wire_roundtrip():
+    origin = keys()[0][0]
+    for kind in (STATE_REQ_MANIFEST, STATE_REQ_CHUNK, STATE_REQ_DELTA):
+        frame = encode_state_request(kind, origin, index=3, from_round=17)
+        tag, msg = decode_message(frame)
+        assert tag == TAG_STATE_REQUEST
+        assert (msg.kind, msg.index, msg.from_round) == (kind, 3, 17)
+        assert msg.origin == origin
+
+
+def test_state_manifest_wire_roundtrip():
+    block = chain(2)[-1]
+    qc = qc_for_block(block)
+    origin = keys()[1][0]
+    root = Digest.random().to_bytes()
+    frame = encode_state_manifest(9, root, block.round, 42, 2, 5, qc, origin)
+    tag, msg = decode_message(frame)
+    assert tag == TAG_STATE_MANIFEST
+    assert (msg.version, msg.root, msg.last_round) == (9, root, block.round)
+    assert (msg.applied_payloads, msg.chunk_count, msg.from_round) == (42, 2, 5)
+    assert msg.qc.hash == qc.hash and msg.qc.round == qc.round
+    assert msg.origin == origin
+
+
+def test_state_chunk_wire_roundtrip_and_cap():
+    entries = [(b"s/l" + bytes([i]) * 32, bytes(8) + bytes([i])) for i in range(5)]
+    frame = encode_state_chunk(4, 1, 10, entries)
+    tag, msg = decode_message(frame)
+    assert tag == TAG_STATE_CHUNK
+    assert (msg.version, msg.index, msg.from_round) == (4, 1, 10)
+    assert list(msg.entries) == entries
+    assert decode_message(encode_state_chunk(1, 0, 0, []))[1].entries == ()
+    with pytest.raises(ValueError):
+        encode_state_chunk(
+            1, 0, 0, [(b"k", b"v")] * (MAX_STATE_CHUNK_ENTRIES + 1)
+        )
+
+
+def test_state_read_wire_roundtrip():
+    for space in (STATE_READ_LEDGER, STATE_READ_USER):
+        tag, msg = decode_message(encode_state_read(space, b"some-key"))
+        assert tag == TAG_STATE_READ
+        assert msg == (space, b"some-key")
+    # unknown read space must be a clean decode error
+    bad = bytearray(encode_state_read(STATE_READ_USER, b"k"))
+    bad[2] = 99
+    with pytest.raises(SerializationError):
+        decode_message(bytes(bad))
+
+
+def test_state_value_reply_roundtrip():
+    root = Digest.random().to_bytes()
+    frame = encode_state_value(True, 11, root, 13, 9, b"payload-value")
+    reply = decode_state_value(frame)
+    assert reply.found is True
+    assert (reply.state_version, reply.root) == (11, root)
+    assert (reply.last_round, reply.entry_round) == (13, 9)
+    assert reply.value == b"payload-value"
+    assert frame[0] == STATE_VALUE_TAG
+    # non-reply frames (e.g. ingest ACKs) pass through as None
+    assert decode_state_value(b"Ack") is None
+    assert decode_state_value(b"") is None
+    miss = decode_state_value(
+        encode_state_value(False, 11, root, 13, 0, b"")
+    )
+    assert miss.found is False and miss.value == b""

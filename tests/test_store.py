@@ -107,6 +107,55 @@ def test_torn_tail_record_discarded(tmp_path):
     eng3.close()
 
 
+def test_torn_tail_every_byte_offset(tmp_path):
+    """Crash-chop the log at EVERY byte offset inside the final record.
+
+    Whatever prefix of the last append survives the crash, replay must keep
+    all fully-written records, drop the torn one, truncate the tail, and
+    leave the engine writable — and a further reopen must see the post-crash
+    writes."""
+    key, value = b"final-key", b"final-value!"
+    record_len = 8 + len(key) + len(value)
+    for cut in range(record_len):
+        path = str(tmp_path / ("db-%d" % cut))
+        eng = WalEngine(path)
+        eng.put(b"keep-a", b"1")
+        eng.put(b"keep-b", b"2")
+        eng.put(key, value)
+        eng.close()
+        wal = os.path.join(path, "wal.log")
+        full = os.path.getsize(wal)
+        with open(wal, "ab") as f:
+            f.truncate(full - record_len + cut)
+        eng2 = WalEngine(path)
+        assert eng2.get(b"keep-a") == b"1"
+        assert eng2.get(b"keep-b") == b"2"
+        assert eng2.get(key) is None
+        assert len(eng2) == 2
+        eng2.put(b"post", b"crash")
+        eng2.close()
+        eng3 = WalEngine(path)
+        assert eng3.get(b"keep-a") == b"1"
+        assert eng3.get(key) is None
+        assert eng3.get(b"post") == b"crash"
+        eng3.close()
+
+
+def test_torn_tail_delete_record(tmp_path):
+    """A torn trailing tombstone must not delete the key it targeted."""
+    path = str(tmp_path / "db")
+    eng = WalEngine(path)
+    eng.put(b"victim", b"alive")
+    eng.delete(b"victim")
+    eng.close()
+    wal = os.path.join(path, "wal.log")
+    with open(wal, "ab") as f:
+        f.truncate(os.path.getsize(wal) - 1)
+    eng2 = WalEngine(path)
+    assert eng2.get(b"victim") == b"alive"
+    eng2.close()
+
+
 def test_delete_tombstone_survives_reopen(tmp_path):
     path = str(tmp_path / "db")
     eng = WalEngine(path)
