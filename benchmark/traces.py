@@ -25,7 +25,11 @@ module merges a run's journals into one committee-wide timeline:
    one track per node, one duration slice per block per node, one flow
    arrow per propose->recv edge, instant markers for timeouts.
 
-Pure stdlib; no dependency on the node runtime (reads JSONL only).
+Pure stdlib; no dependency on the node runtime (reads JSONL only) —
+the only package import is the constant-leaf edge/stage registry
+(``hotstuff_tpu/telemetry/taxonomy.py``), so every rendered edge name
+comes from the same table the ``taxonomy-registry`` lint checks record
+call sites against.
 """
 
 from __future__ import annotations
@@ -36,6 +40,14 @@ import os
 import re
 from collections import Counter, defaultdict
 from statistics import mean
+
+from hotstuff_tpu.telemetry.taxonomy import (
+    BYZ_PREFIX,
+    CONTROL_EDGES,
+    FAULT_PREFIX,
+    INGEST_PREFIX,
+    SPAN_ANNOTATION_STAGES,
+)
 
 #: a block counts as reconstructed when its commit can be attributed —
 #: the propose anchor plus at least one receive edge were journaled
@@ -227,11 +239,11 @@ class TraceSet:
             fault_edges: list[tuple[int, str, str]] = []  # (w_corr, kind, label)
             for r in records:
                 e = r["e"]
-                if e.startswith("byz."):
+                if e.startswith(BYZ_PREFIX):
                     # adversary-plane records must never reach _block
                     # (their "d" may be None)
                     w = self._corr(node, r["w"])
-                    kind = e[len("byz."):]
+                    kind = e[len(BYZ_PREFIX):]
                     if kind in ("open", "close"):
                         byz_edges.append((w, node, kind, r.get("p", "")))
                     else:
@@ -239,7 +251,7 @@ class TraceSet:
                             (w, node, kind, int(r.get("r", 0)))
                         )
                     continue
-                if e.startswith("ingest."):
+                if e.startswith(INGEST_PREFIX):
                     # admission-plane records must never reach _block
                     # either ("d" is None); the shed count / credit
                     # window rides the "u" field
@@ -247,14 +259,12 @@ class TraceSet:
                         (
                             self._corr(node, r["w"]),
                             node,
-                            e[len("ingest."):],
+                            e[len(INGEST_PREFIX):],
                             int(r.get("u") or 0),
                         )
                     )
                     continue
-                if e in ("tc", "round.enter", "recv.timeout", "recv.tc",
-                         "sync.req", "sync.reply", "sync.done",
-                         "recv.sync_req", "sync.expire"):
+                if e in CONTROL_EDGES:
                     continue
                 if e == "recv.producer":
                     producer_seen.setdefault(r["d"], r["m"])
@@ -269,7 +279,7 @@ class TraceSet:
                     # "u"; must not reach _block (d is empty)
                     dur = r.get("u")
                     if dur is not None:
-                        if r["p"] == "pipeline.occupancy":
+                        if r["p"] in SPAN_ANNOTATION_STAGES:
                             # value annotation: "u" is in-flight depth
                             self.occupancy_samples.setdefault(
                                 node, []
@@ -279,9 +289,9 @@ class TraceSet:
                                 (r["p"], self._corr(node, r["w"]), int(dur))
                             )
                     continue
-                if e in ("fault.open", "fault.close"):
+                if e in (FAULT_PREFIX + "open", FAULT_PREFIX + "close"):
                     fault_edges.append(
-                        (self._corr(node, r["w"]), e[6:], r["p"])
+                        (self._corr(node, r["w"]), e[len(FAULT_PREFIX):], r["p"])
                     )
                     continue
                 if e == "timeout":

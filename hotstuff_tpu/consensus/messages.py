@@ -55,6 +55,12 @@ COMPACT_VERSION = 1
 MAX_SIGNER_BITMAP = 512
 #: decode-time cap on compact-TC groups (distinct high_qc_rounds)
 MAX_COMPACT_GROUPS = 64
+#: decode-time cap on vote-list entries in a QC/TC — one vote per
+#: committee member, same 4096-member ceiling the signer bitmap encodes.
+#: Without it a 4-byte wire count of 2**32 drives the vote decode loop
+#: (an allocation bomb the codec's truncation check does not stop,
+#: because each iteration reads only a few valid bytes before failing).
+MAX_CERT_VOTES = 8 * MAX_SIGNER_BITMAP
 
 #: process-wide QC-verify memo hits/misses — the ``qc_verify_cache_hit``
 #: telemetry counter reads these (co-located committees share the
@@ -467,6 +473,8 @@ class QC:
             qc = cls(hash=h, round=rnd, agg_sig=agg, signers=signers)
             qc._wire = dec.since(start)
             return qc
+        if n > MAX_CERT_VOTES:
+            raise CodecError(f"QC vote count {n} exceeds cap {MAX_CERT_VOTES}")
         votes = [(decode_pk(dec), decode_sig(dec)) for _ in range(n)]
         qc = cls(hash=h, round=rnd, votes=votes)
         qc._wire = dec.since(start)
@@ -668,6 +676,8 @@ class TC:
                 agg, bitmap = _decode_agg_and_bitmap(dec)
                 groups.append((hq, agg, bitmap))
             return cls(round=rnd, groups=groups)
+        if n > MAX_CERT_VOTES:
+            raise CodecError(f"TC vote count {n} exceeds cap {MAX_CERT_VOTES}")
         votes = [
             (decode_pk(dec), decode_sig(dec), dec.u64()) for _ in range(n)
         ]
@@ -814,6 +824,13 @@ class Block:
         author = decode_pk(dec)
         rnd = dec.u64()
         n = dec.u32()
+        if n > MAX_BLOCK_PAYLOADS:
+            # Block.verify re-checks this for protocol attribution, but
+            # the decode-time cap stops a forged count from sizing the
+            # digest-vector read at all
+            raise CodecError(
+                f"block payload count {n} exceeds cap {MAX_BLOCK_PAYLOADS}"
+            )
         # one bounds-checked read for the whole digest vector (a block
         # carries up to 512 payload digests — the per-digest raw() call
         # was the hottest decode loop in the profile)

@@ -892,6 +892,10 @@ class AsyncVerifyService:
             self._dispatch = _DispatchLoop(self.pipeline_depth)
         self._wave_serial += 1
         wave = self._wave_serial
+        # guarded-by: gil -- written here on the event loop, popped by
+        # _deliver (loop) and by _on_done's loop-closed fallback (slot
+        # thread); every access is a single dict bytecode, atomic under
+        # the GIL, and the routing reads tolerate one-wave staleness
         self._inflight[wave] = time.monotonic() + (
             deadline if deadline is not None else self._deadline_s()
         )
@@ -967,6 +971,10 @@ class AsyncVerifyService:
         if self._tel_device_wall is not None:
             self._tel_device_wall.add(wall)
         ewma = self._device_ewma_s
+        # guarded-by: gil -- written on the slot thread, read by the
+        # loop-side router (_route_device/_deadline_s); a float rebind
+        # is one atomic store and a stale read only skews the EWMA by
+        # one sample
         self._device_ewma_s = (
             wall if ewma is None else (1 - _EWMA_ALPHA) * ewma + _EWMA_ALPHA * wall
         )

@@ -287,6 +287,9 @@ class Node:
         # HOTSTUFF_FRESH_STATE=1 (--fresh-state) stays as the escape
         # hatch to force a clean slate regardless of provenance.
         chash = committee_hash(committee)
+        # lint: allow(no-blocking-in-async) -- one-time boot path: the
+        # node serves no traffic until new() returns, so a synchronous
+        # engine read cannot stall a live round
         stored_hash = self.store.engine.get(COMMITTEE_HASH_KEY)
         fresh = os.environ.get("HOTSTUFF_FRESH_STATE", "") not in ("", "0")
         if fresh or (stored_hash is not None and stored_hash != chash):
@@ -304,6 +307,7 @@ class Node:
 
             shutil.rmtree(store_path, ignore_errors=True)
             self.store = Store(store_path)
+        # lint: allow(no-blocking-in-async) -- same one-time boot path
         self.store.engine.put(COMMITTEE_HASH_KEY, chash)
         signature_service = make_signing_service(secret.scheme, secret.secret)
         if len(schemes) == 1:

@@ -64,6 +64,12 @@ import time
 from collections import deque
 from contextlib import nullcontext
 
+from .taxonomy import (
+    SPAN_ANNOTATION_STAGES as ANNOTATION_STAGES,
+    SPAN_LEAF_STAGES as LEAF_STAGES,
+    SPAN_PARENT_STAGES as PARENT_STAGES,
+)
+
 DEFAULT_CAPACITY = 65536
 
 #: stage-duration histogram bounds in MILLISECONDS: 1 us doubling up to
@@ -71,39 +77,11 @@ DEFAULT_CAPACITY = 65536
 #: device stages (dispatch ~50 us) don't collapse into the first bucket
 STAGE_BOUNDS_MS: tuple[float, ...] = tuple(1e-3 * 2**i for i in range(28))
 
-#: leaf stages, in pipeline order — the canonical waterfall rows; spans
-#: with other names (parents, ad-hoc) are recorded but never summed
-LEAF_STAGES: tuple[str, ...] = (
-    "coalesce.wait",
-    "route.decide",
-    "pipeline.wait",
-    "stage.pack",
-    "stage.slot_wait",
-    "queue.wait",
-    "flatten",
-    "prepare",
-    "dispatch",
-    "device.execute",
-    "mesh.psum",
-    "readback",
-    "host.verify",
-    "host.pairing",
-    "verdict.fanout",
-)
-
-#: frame spans: overlap the leaves, excluded from waterfall sums
-PARENT_STAGES: tuple[str, ...] = (
-    "e2e",
-    "dispatch.wall",
-    "agg.verify",
-    "scheme.route",
-)
-
-#: value annotations (ISSUE 5): span records whose "dur" field encodes
-#: a VALUE, not a duration — pipeline.occupancy carries the in-flight
-#: wave depth at each device spawn.  Excluded from waterfall sums and
-#: rendered as a counter series on the Perfetto verify-pipeline track.
-ANNOTATION_STAGES: tuple[str, ...] = ("pipeline.occupancy",)
+# the stage tables themselves (leaf pipeline order, parent frames, value
+# annotations) live in telemetry/taxonomy.py — the one registry the
+# analysis plane lints against and benchmark/traces.py renders from;
+# the LEAF_STAGES / PARENT_STAGES / ANNOTATION_STAGES re-exports above
+# keep benchmark/profile.py and existing call sites working unchanged
 
 _RECORDER: "SpanRecorder | None" = None
 _ENV_CHECKED = False

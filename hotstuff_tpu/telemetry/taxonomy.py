@@ -1,0 +1,165 @@
+"""Central span-stage and journal-edge taxonomy (ISSUE 12).
+
+Every span stage name (``telemetry/spans.py``) and every journal edge
+name (``telemetry/journal.py`` records) is registered HERE, and here
+only.  ``benchmark/traces.py`` renders from these same tables, so an
+edge that isn't registered is a **lint error**
+(``hotstuff_tpu/analysis`` rule ``taxonomy-registry``) instead of a
+silently-empty Perfetto track.
+
+Adding an edge or stage is a two-line change: record it at the call
+site, register it here (with the rendering group it belongs to).  The
+lint rule cross-checks both directions: call sites must use registered
+names, and ``traces.py`` must route every registered group.
+
+This module is a pure-constant leaf: stdlib only, no imports, safe for
+``benchmark/traces.py`` (which otherwise has no node-runtime
+dependency) and for the analysis plane running in a bare CI venv.
+"""
+
+# ---- verify-pipeline span stages (telemetry/spans.py) ----------------------
+
+#: leaf stages, in pipeline order — the canonical waterfall rows; spans
+#: with other names (parents, ad-hoc) are recorded but never summed
+SPAN_LEAF_STAGES: tuple = (
+    "coalesce.wait",
+    "route.decide",
+    "pipeline.wait",
+    "stage.pack",
+    "stage.slot_wait",
+    "queue.wait",
+    "flatten",
+    "prepare",
+    "dispatch",
+    "device.execute",
+    "mesh.psum",
+    "readback",
+    "host.verify",
+    "host.pairing",
+    "verdict.fanout",
+)
+
+#: frame spans: overlap the leaves, excluded from waterfall sums
+SPAN_PARENT_STAGES: tuple = (
+    "e2e",
+    "dispatch.wall",
+    "agg.verify",
+    "scheme.route",
+)
+
+#: value annotations: span records whose duration field encodes a VALUE
+#: (e.g. in-flight wave depth), excluded from waterfall sums and
+#: rendered as counter series
+SPAN_ANNOTATION_STAGES: tuple = ("pipeline.occupancy",)
+
+#: BLS-aggregation detail stages (crypto/bls/service.py, tpu/bls.py):
+#: sub-phases of the ``agg.verify`` parent frame — recorded and
+#: histogrammed, never waterfall rows.  Surfaced as unregistered drift
+#: by the taxonomy-registry lint the day it landed (ISSUE 12).
+SPAN_AGG_STAGES: tuple = (
+    "agg.gather",
+    "agg.keysum",
+    "agg.pairing",
+    "agg.accumulate",
+    "agg.snapshot",
+)
+
+#: every registered span stage name (what ``span("...")`` /
+#: ``rec.add("...")`` call sites are checked against)
+SPAN_STAGES: frozenset = frozenset(
+    SPAN_LEAF_STAGES
+    + SPAN_PARENT_STAGES
+    + SPAN_ANNOTATION_STAGES
+    + SPAN_AGG_STAGES
+)
+
+# ---- journal edges (telemetry/journal.py records) --------------------------
+
+#: block-lifecycle edges: ``traces.py`` folds these into per-block
+#: cross-node timelines (propose anchor, receive fan-out, vote, QC,
+#: commit)
+BLOCK_EDGES: tuple = (
+    "propose",
+    "recv.propose",
+    "vote.send",
+    "recv.vote",
+    "qc",
+    "commit",
+)
+
+#: control-plane edges: journaled for the SUMMARY/debugging but
+#: excluded from per-block reconstruction (several carry no digest)
+CONTROL_EDGES: tuple = (
+    "tc",
+    "round.enter",
+    "recv.timeout",
+    "recv.tc",
+    "sync.req",
+    "sync.reply",
+    "sync.done",
+    "sync.expire",
+    "sync.serve",
+    "sync.manifest",
+    "sync.chunk",
+    "sync.adopt",
+    "recv.sync_req",
+    "recv.state_req",
+    "state.apply",
+)
+
+#: producer-channel edges: leader-side payload wait attribution
+PAYLOAD_EDGES: tuple = ("recv.producer", "payload.first")
+
+#: admission-plane edges: value records (shed count / credit window in
+#: the ``u`` field), rendered as the ingest-plane track
+INGEST_EDGES: tuple = ("ingest.shed", "ingest.credit")
+
+#: standalone edges: local timeout complaints, the profiler fan-out
+#: record (stage in ``p``, duration in ``u``), and each ring segment's
+#: identity line
+MISC_EDGES: tuple = ("timeout", "span", "meta")
+
+#: dynamic edge families: the chaos plane journals ``fault.<kind>`` and
+#: the adversary plane ``byz.<kind>`` with scenario-defined kinds; an
+#: f-string edge is lint-legal iff its constant prefix is listed here
+FAULT_PREFIX = "fault."
+BYZ_PREFIX = "byz."
+INGEST_PREFIX = "ingest."
+JOURNAL_EDGE_PREFIXES: tuple = (FAULT_PREFIX, BYZ_PREFIX)
+
+#: every registered static journal edge name (what ``journal.record``
+#: call sites are checked against)
+JOURNAL_EDGES: frozenset = frozenset(
+    BLOCK_EDGES + CONTROL_EDGES + PAYLOAD_EDGES + INGEST_EDGES + MISC_EDGES
+)
+
+
+def is_registered_edge(name: str) -> bool:
+    """Is ``name`` a registered journal edge (static or dynamic)?"""
+    return name in JOURNAL_EDGES or name.startswith(JOURNAL_EDGE_PREFIXES)
+
+
+def is_registered_stage(name: str) -> bool:
+    """Is ``name`` a registered verify-pipeline span stage?"""
+    return name in SPAN_STAGES
+
+
+__all__ = [
+    "SPAN_LEAF_STAGES",
+    "SPAN_PARENT_STAGES",
+    "SPAN_ANNOTATION_STAGES",
+    "SPAN_AGG_STAGES",
+    "SPAN_STAGES",
+    "BLOCK_EDGES",
+    "CONTROL_EDGES",
+    "PAYLOAD_EDGES",
+    "INGEST_EDGES",
+    "MISC_EDGES",
+    "FAULT_PREFIX",
+    "BYZ_PREFIX",
+    "INGEST_PREFIX",
+    "JOURNAL_EDGE_PREFIXES",
+    "JOURNAL_EDGES",
+    "is_registered_edge",
+    "is_registered_stage",
+]

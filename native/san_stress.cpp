@@ -1,0 +1,266 @@
+// Sanitizer stress harness for the native layer (ISSUE 12).
+//
+// Compiled TOGETHER with transport.cpp and store_engine.cpp into a
+// standalone executable (build/san_stress_{tsan,asan}) — a sanitized
+// .so dlopened into an uninstrumented Python would miss the runtime
+// interceptors, so the stress drives the C ABI directly:
+//
+//   store:     per-thread WAL engines (put/get/delete/compact/replay
+//              round-trips) plus one SHARED engine serialized by an
+//              external mutex — the engine is single-writer by design
+//              (hotstuff_tpu/store owns one per node), so the shared
+//              mode models the documented discipline, not free-for-all
+//              concurrency.
+//   transport: one reactor, multi-threaded ht_send/ht_reply against the
+//              reactor thread's epoll loop and the ht_next drain —
+//              every mutex-protected queue handoff in transport.cpp
+//              under genuine cross-thread fire.
+//
+// Exit 0 and "SAN_STRESS OK" on success; any sanitizer report fails
+// the process via halt_on_error=1 (set by scripts/san_check.py).
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <unistd.h>
+
+extern "C" {
+// store_engine.cpp
+void* hs_open(const char* path, int fsync_mode);
+int hs_put(void* h, const uint8_t* k, uint32_t klen, const uint8_t* v,
+           uint32_t vlen);
+int hs_get(void* h, const uint8_t* k, uint32_t klen, uint8_t** out,
+           uint32_t* outlen);
+int hs_delete(void* h, const uint8_t* k, uint32_t klen);
+uint64_t hs_count(void* h);
+int hs_compact(void* h);
+void hs_free(uint8_t* p);
+void hs_close(void* h);
+// transport.cpp
+void* ht_start();
+long ht_listen(void* rp, const char* ip, int port);
+long ht_connect(void* rp, const char* ip, int port);
+int ht_send(void* rp, long peer, const uint8_t* data, int len);
+int ht_reply(void* rp, long conn, const uint8_t* data, int len);
+int ht_next(void* rp, long* src, int* kind, uint8_t* buf, int cap);
+int ht_set_read_paused(void* rp, long conn, int paused);
+int ht_close_conn(void* rp, long conn);
+void ht_stop(void* rp);
+}
+
+namespace {
+
+constexpr int kStoreThreads = 4;
+constexpr int kStoreOps = 400;
+constexpr int kSendThreads = 4;
+constexpr int kSendsPerThread = 250;
+
+bool g_failed = false;
+
+void fail(const char* what) {
+  std::fprintf(stderr, "SAN_STRESS FAIL: %s\n", what);
+  g_failed = true;
+}
+
+// ---- store stress ----------------------------------------------------------
+
+std::string key_of(int t, int i) {
+  char buf[32];
+  std::snprintf(buf, sizeof buf, "k/%d/%d", t, i % 37);
+  return buf;
+}
+
+void store_worker(const std::string& dir, int t) {
+  std::string path = dir + "/own_" + std::to_string(t) + ".wal";
+  void* h = hs_open(path.c_str(), 0);
+  if (!h) return fail("hs_open(per-thread)");
+  for (int i = 0; i < kStoreOps; i++) {
+    std::string k = key_of(t, i);
+    std::string v(1 + (i * 7) % 96, char('a' + t));
+    if (hs_put(h, (const uint8_t*)k.data(), k.size(),
+               (const uint8_t*)v.data(), v.size()) != 0)
+      return fail("hs_put");
+    uint8_t* out = nullptr;
+    uint32_t outlen = 0;
+    if (hs_get(h, (const uint8_t*)k.data(), k.size(), &out, &outlen) != 0 ||
+        outlen != v.size() || std::memcmp(out, v.data(), outlen) != 0) {
+      hs_free(out);
+      return fail("hs_get round-trip");
+    }
+    hs_free(out);
+    if (i % 11 == 3)
+      hs_delete(h, (const uint8_t*)k.data(), k.size());
+    if (i % 97 == 50) hs_compact(h);
+    if (i % 151 == 100) {
+      // close/reopen exercises WAL replay + compaction-on-open
+      hs_close(h);
+      h = hs_open(path.c_str(), 0);
+      if (!h) return fail("hs_open(reopen)");
+    }
+  }
+  hs_close(h);
+}
+
+void store_stress(const std::string& dir) {
+  // per-thread engines: the production topology (one engine per node)
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kStoreThreads; t++)
+    ts.emplace_back(store_worker, dir, t);
+  for (auto& th : ts) th.join();
+
+  // one shared engine behind an external mutex: the documented
+  // discipline when an engine must cross threads
+  std::string path = dir + "/shared.wal";
+  void* h = hs_open(path.c_str(), 0);
+  if (!h) return fail("hs_open(shared)");
+  std::mutex mu;
+  std::vector<std::thread> ss;
+  for (int t = 0; t < kStoreThreads; t++) {
+    ss.emplace_back([&, t] {
+      for (int i = 0; i < kStoreOps; i++) {
+        std::string k = key_of(t, i);
+        std::string v(1 + i % 64, char('A' + t));
+        std::lock_guard<std::mutex> g(mu);
+        if (hs_put(h, (const uint8_t*)k.data(), k.size(),
+                   (const uint8_t*)v.data(), v.size()) != 0)
+          return fail("hs_put(shared)");
+        if (i % 13 == 7)
+          hs_delete(h, (const uint8_t*)k.data(), k.size());
+      }
+    });
+  }
+  for (auto& th : ss) th.join();
+  {
+    std::lock_guard<std::mutex> g(mu);
+    hs_compact(h);
+    if (hs_count(h) == 0) fail("shared engine lost every key");
+    hs_close(h);
+  }
+  std::printf("store stress done\n");
+}
+
+// ---- transport stress ------------------------------------------------------
+
+void transport_stress() {
+  void* rp = ht_start();
+  if (!rp) return fail("ht_start");
+  long listener = -1;
+  int port = 0;
+  for (int attempt = 0; attempt < 100 && listener < 0; attempt++) {
+    port = 36000 + (int)((getpid() + attempt * 7) % 20000);
+    listener = ht_listen(rp, "127.0.0.1", port);
+  }
+  if (listener < 0) {
+    ht_stop(rp);
+    return fail("ht_listen");
+  }
+
+  std::vector<long> peers;
+  for (int i = 0; i < kSendThreads; i++) {
+    long p = ht_connect(rp, "127.0.0.1", port);
+    if (p < 0) {
+      ht_stop(rp);
+      return fail("ht_connect");
+    }
+    peers.push_back(p);
+  }
+
+  std::atomic<long> sent{0}, replied{0};
+  std::atomic<long> got_accepted{0}, got_peer{0};
+  std::atomic<bool> done_sending{false};
+
+  // drain thread: the single ht_next consumer; replies to every 3rd
+  // accepted frame so the reply path runs concurrently with senders
+  std::thread drain([&] {
+    std::vector<uint8_t> buf(1 << 16);
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(30);
+    long pauses = 0;
+    while (std::chrono::steady_clock::now() < deadline) {
+      long src = 0;
+      int kind = 0;
+      int n = ht_next(rp, &src, &kind, buf.data(), (int)buf.size());
+      if (n == -1) {
+        if (done_sending.load() &&
+            got_accepted.load() >= sent.load() &&
+            got_peer.load() >= replied.load())
+          break;
+        usleep(200);
+        continue;
+      }
+      if (n < 0) {
+        fail("ht_next buffer too small");
+        break;
+      }
+      if (kind == 1) {  // frame from an accepted conn
+        long c = got_accepted.fetch_add(1) + 1;
+        if (c % 3 == 0) {
+          if (ht_reply(rp, src, buf.data(), n > 64 ? 64 : n) == 0)
+            replied.fetch_add(1);
+        }
+        if (c % 101 == 50 && pauses < 8) {
+          // flow-control churn against the reactor thread
+          ht_set_read_paused(rp, src, 1);
+          ht_set_read_paused(rp, src, 0);
+          pauses++;
+        }
+      } else if (kind == 2) {  // frame from a connected peer (reply)
+        got_peer.fetch_add(1);
+      }
+      // kinds 3/4 (closes) just drain
+    }
+  });
+
+  std::vector<std::thread> senders;
+  for (int t = 0; t < kSendThreads; t++) {
+    senders.emplace_back([&, t] {
+      std::vector<uint8_t> payload(16 + 97 * t, (uint8_t)t);
+      for (int i = 0; i < kSendsPerThread; i++) {
+        int len = 1 + (int)((i * 131 + t) % payload.size());
+        if (ht_send(rp, peers[t], payload.data(), len) == 0)
+          sent.fetch_add(1);
+        else
+          usleep(100);  // connect still in flight: retry cadence
+        if (i % 50 == 49) usleep(500);  // let the reactor breathe
+      }
+    });
+  }
+  for (auto& th : senders) th.join();
+  done_sending.store(true);
+  drain.join();
+
+  if (got_accepted.load() < sent.load())
+    fail("transport dropped accepted-side frames");
+  if (got_peer.load() < replied.load())
+    fail("transport dropped reply frames");
+
+  for (long p : peers) ht_close_conn(rp, p);
+  ht_stop(rp);
+  std::printf("transport stress done: sent=%ld delivered=%ld replies=%ld\n",
+              sent.load(), got_accepted.load(), got_peer.load());
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* which = argc > 1 ? argv[1] : "all";
+  char tmpl[] = "/tmp/hs_san_XXXXXX";
+  char* dir = mkdtemp(tmpl);
+  if (!dir) {
+    std::fprintf(stderr, "SAN_STRESS FAIL: mkdtemp\n");
+    return 1;
+  }
+  if (std::strcmp(which, "transport") != 0) store_stress(dir);
+  if (std::strcmp(which, "store") != 0) transport_stress();
+  if (g_failed) return 1;
+  std::printf("SAN_STRESS OK\n");
+  return 0;
+}

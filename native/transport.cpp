@@ -669,16 +669,22 @@ void ht_stop(void* rp) {
   char b = 1;
   (void)!write(r->wake_w, &b, 1);
   if (r->thread.joinable()) r->thread.join();
-  std::lock_guard<std::mutex> g(r->mu);
-  for (auto& [id, c] : r->conns) {
-    if (c.fd >= 0) ::close(c.fd);
+  {
+    // scope the guard: the lock_guard must release r->mu BEFORE
+    // delete r, or its destructor unlocks a destroyed mutex inside
+    // freed memory (caught by the TSan stress harness).  The reactor
+    // thread is already joined, so nothing else can take the mutex.
+    std::lock_guard<std::mutex> g(r->mu);
+    for (auto& [id, c] : r->conns) {
+      if (c.fd >= 0) ::close(c.fd);
+    }
+    for (auto& [fd, id] : r->listeners) ::close(fd);
+    ::close(r->epfd);
+    ::close(r->notify_r);
+    ::close(r->notify_w);
+    ::close(r->wake_r);
+    ::close(r->wake_w);
   }
-  for (auto& [fd, id] : r->listeners) ::close(fd);
-  ::close(r->epfd);
-  ::close(r->notify_r);
-  ::close(r->notify_w);
-  ::close(r->wake_r);
-  ::close(r->wake_w);
   delete r;
 }
 

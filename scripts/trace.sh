@@ -46,6 +46,13 @@
 #                                 # byz-collude FAILs full-history root
 #                                 # agreement while the trusted subset
 #                                 # PASSes, non-zero exit on any break
+#   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
+#                                 # (scripts/analysis_check.py): every
+#                                 # hotstuff_tpu/analysis lint rule,
+#                                 # docs/KNOBS.md freshness, and the
+#                                 # native TSan/ASan reactor + store
+#                                 # stress (skip-if-unsupported),
+#                                 # non-zero exit on any finding
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -78,6 +85,12 @@ fi
 if [ "${STATE:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/state_check.py "$@"
+fi
+
+if [ "${LINT:-0}" = "1" ]; then
+    # stdlib-only: the analysis plane never imports jax, so this gate
+    # also runs in the bare CI lint venv
+    exec timeout -k 10 1800 python scripts/analysis_check.py "$@"
 fi
 
 timeout -k 10 240 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -202,6 +202,95 @@ def test_adversarial_well_formed_frames_decode_then_fail_verify():
 
 
 # ---------------------------------------------------------------------------
+# decode-time count caps (ISSUE 12): the wire-decoder-bounds lint rule
+# flagged the QC/TC vote-count and block payload-count reads as
+# unbounded — a forged 4-byte count could size a decode loop before any
+# truncation check fired.  The caps added for it must reject the count
+# itself, before the first element decode or allocation.
+
+
+def test_vote_count_bombs_die_in_the_codec():
+    from hotstuff_tpu.consensus.messages import MAX_CERT_VOTES
+    from hotstuff_tpu.utils.codec import Encoder
+
+    # the cap matches the signer-bitmap member ceiling: no committee the
+    # compact form can name could ever produce more votes
+    assert MAX_CERT_VOTES == 4096
+
+    # QC claiming cap+1 votes, inside a timeout frame (tag 2): rejected
+    # on the count, not after 4097 attempted signature decodes
+    bomb = Encoder()
+    bomb.raw(Digest.of(b"bomb").to_bytes()).u64(7)
+    bomb.u32(MAX_CERT_VOTES + 1)
+    with pytest.raises(SerializationError, match="exceeds cap"):
+        decode_message(bytes([2]) + bomb.finish())
+
+    # exactly AT the cap the count is legal — the absent vote bytes then
+    # die as ordinary truncation, a different failure
+    at_cap = Encoder()
+    at_cap.raw(Digest.of(b"bomb").to_bytes()).u64(7)
+    at_cap.u32(MAX_CERT_VOTES)
+    with pytest.raises(SerializationError) as exc:
+        decode_message(bytes([2]) + at_cap.finish())
+    assert "exceeds cap" not in str(exc.value)
+
+    # TC (tag 3) claiming cap+1 votes: same rejection
+    tc_bomb = Encoder().u64(9).u32(MAX_CERT_VOTES + 1)
+    with pytest.raises(SerializationError, match="exceeds cap"):
+        decode_message(bytes([3]) + tc_bomb.finish())
+
+
+def test_block_payload_count_bomb_dies_in_the_codec():
+    """The payload-count cap was verify-time only (core.py attribution);
+    decode-time enforcement stops the forged count from sizing the
+    digest-vector read at all."""
+    from hotstuff_tpu.consensus.messages import encode_pk
+    from hotstuff_tpu.utils.codec import Encoder
+
+    blocks = chain(2)
+    b = blocks[-1]
+    for count in (MAX_BLOCK_PAYLOADS + 1, 0xFFFFFFFF):
+        enc = Encoder().u8(0)  # TAG_PROPOSE
+        b.qc.encode(enc)
+        enc.flag(False)
+        encode_pk(enc, b.author)
+        enc.u64(b.round)
+        enc.u32(count)
+        with pytest.raises(SerializationError, match="exceeds cap"):
+            decode_message(enc.finish())
+
+
+def test_capped_decoder_truncation_sweep():
+    """A propose frame carrying a real payload vector: the frame
+    decodes whole, every strict prefix dies cleanly, and a count at the
+    protocol cap round-trips (the cap rejects forgeries, not the
+    protocol's own maximum)."""
+    import dataclasses
+
+    blocks = chain(2)
+    payloads = tuple(
+        Digest.of(bytes([i % 256]) * 8) for i in range(64)
+    )
+    b = dataclasses.replace(blocks[-1], payloads=payloads)
+    frame = encode_propose(b)
+    _, decoded = decode_message(frame)
+    assert decoded.payloads == payloads
+
+    for cut in range(len(frame)):
+        _decode_must_not_crash(frame[:cut])
+
+    full = dataclasses.replace(
+        blocks[-1],
+        payloads=tuple(
+            Digest.of(i.to_bytes(4, "little"))
+            for i in range(MAX_BLOCK_PAYLOADS)
+        ),
+    )
+    _, rt = decode_message(encode_propose(full))
+    assert len(rt.payloads) == MAX_BLOCK_PAYLOADS
+
+
+# ---------------------------------------------------------------------------
 # compact-certificate corpus (ISSUE 9): the aggregated QC/TC wire form
 # is a NEW attack surface — a sentinel vote count, a version byte, one
 # aggregate signature and a signer bitmap.  Malformed variants must die
