@@ -38,6 +38,7 @@ def task_local(args) -> int:
         no_claim_dedup=args.no_claim_dedup,
         journal=args.journal,
         profile=args.profile,
+        health=args.health,
     )
     if args.wait_weather is not None:
         bench.wait_weather(threshold_ms=args.wait_weather)
@@ -56,6 +57,14 @@ def task_local(args) -> int:
             )
         else:
             Print.warn("journaling was on but no journal records were found")
+        if args.health:
+            from .traces import merge_campaigns
+
+            campaign = merge_campaigns(
+                PathMaker.journals_path(), PathMaker.campaign_file()
+            )
+            if campaign is not None:
+                Print.info(f"Campaign report written to {campaign}")
     label = (
         args.verifier if args.scheme == "ed25519" else f"bls-{args.verifier}"
     )
@@ -149,6 +158,7 @@ def task_chaos(args) -> int:
         verifier=args.verifier,
         transport=args.transport,
         journal=args.journal,
+        health=args.health,
         spec=spec,
     )
     parser = bench.run()
@@ -165,6 +175,14 @@ def task_chaos(args) -> int:
                 f"Chrome trace written to {out} "
                 "(open in https://ui.perfetto.dev)"
             )
+        if args.health:
+            from .traces import merge_campaigns
+
+            campaign = merge_campaigns(
+                PathMaker.journals_path(), PathMaker.campaign_file()
+            )
+            if campaign is not None:
+                Print.info(f"Campaign report written to {campaign}")
     label = f"chaos-{bench.spec.get('name', args.scenario)}"
     if args.transport != "asyncio":
         label += f"-{args.transport}"
@@ -185,13 +203,19 @@ def task_traces(args) -> int:
     and a Chrome trace-event JSON (open in https://ui.perfetto.dev)."""
     from .traces import TraceSet
 
+    from .traces import merge_campaigns
+
     traces = TraceSet.load(args.dir)
-    if not traces.journals:
+    campaign = merge_campaigns(args.dir, PathMaker.campaign_file())
+    if not traces.journals and campaign is None:
         Print.error(f"no journal segments found under {args.dir}")
         return 1
-    print(traces.summary())
-    out = traces.export_chrome_trace(args.out)
-    Print.info(f"Chrome trace written to {out}")
+    if traces.journals:
+        print(traces.summary())
+        out = traces.export_chrome_trace(args.out)
+        Print.info(f"Chrome trace written to {out}")
+    if campaign is not None:
+        Print.info(f"Campaign report written to {campaign}")
     return 0
 
 
@@ -303,6 +327,7 @@ def task_remote_bench(args) -> int:
         profile=args.profile,
         fault_plane=args.fault_plane,
         fault_seed=args.fault_seed,
+        watch=args.watch,
     )
     return 0
 
@@ -352,6 +377,15 @@ def task_logs(args) -> int:
     # faults/verifier are not recoverable from logs — print '?' rather
     # than plausible-looking defaults; node count = number of node logs
     print(parser.result(faults="?", nodes=parser.num_node_logs, verifier="?"))
+    return 0
+
+
+def task_watch(args) -> int:
+    """Live fleet health dashboard against an already-running committee
+    started with --health (docs/TELEMETRY.md)."""
+    from .watch import task_watch as _watch
+
+    _watch(args)
     return 0
 
 
@@ -454,6 +488,14 @@ def main(argv=None) -> int:
         "'verify pipeline' track in logs/trace.json",
     )
     p.add_argument(
+        "--health",
+        action="store_true",
+        help="health plane on: every node runs the in-process anomaly "
+        "monitor + campaign recorder (HOTSTUFF_HEALTH) and serves "
+        "/metrics + /delta on port+3000 — attach a live dashboard with "
+        "`python -m benchmark watch` (docs/TELEMETRY.md)",
+    )
+    p.add_argument(
         "--no-claim-dedup",
         action="store_true",
         help="give every core a PRIVATE verify service (no cross-core "
@@ -548,6 +590,13 @@ def main(argv=None) -> int:
         help="flight recorder on: fault windows appear as spans on the "
         "chaos-plane track of logs/trace.json",
     )
+    p.add_argument(
+        "--health",
+        action="store_true",
+        help="health plane on in every node (see `local --health`); "
+        "detector firings land in the + HEALTH SUMMARY block and, with "
+        "--journal, on the incidents track of logs/trace.json",
+    )
     p.set_defaults(fn=task_chaos)
 
     p = sub.add_parser("tpu")
@@ -638,6 +687,36 @@ def main(argv=None) -> int:
     )
     p.set_defaults(fn=task_traces)
 
+    p = sub.add_parser(
+        "watch",
+        help="live fleet dashboard: scrape every committee node's "
+        "/delta endpoint, render per-node round/commit-rate/leader/"
+        "route-mix/credit columns and run the fleet anomaly detectors "
+        "(committee must be running with --health)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=1.0, help="seconds between ticks"
+    )
+    p.add_argument(
+        "--duration",
+        type=float,
+        default=0.0,
+        help="stop after this many seconds (0 = until interrupted)",
+    )
+    p.add_argument(
+        "--once",
+        action="store_true",
+        help="render a single frame and exit (no screen clearing)",
+    )
+    p.add_argument(
+        "--timeout-delay",
+        type=int,
+        default=5_000,
+        help="the committee's consensus timeout (ms) — scales the "
+        "leader-stall detector's k*timeout threshold",
+    )
+    p.set_defaults(fn=task_watch)
+
     p = sub.add_parser("aggregate")
     p.set_defaults(fn=task_aggregate)
 
@@ -690,6 +769,14 @@ def main(argv=None) -> int:
         type=int,
         default=0,
         help="seed for a canned --fault-plane scenario",
+    )
+    p.add_argument(
+        "--watch",
+        action="store_true",
+        help="health plane on in every remote node and a live fleet "
+        "dashboard over the instance map during each run; unreachable "
+        "nodes show an explicit STALE column instead of hanging the "
+        "driver",
     )
     p.set_defaults(fn=task_remote_bench)
 

@@ -57,6 +57,7 @@ class ChaosBench(LocalBench):
         transport: str = "asyncio",
         tx_size: int = 512,
         journal: bool = False,
+        health: bool = False,
         spec: dict | None = None,
     ):
         # crash-fault injection (`faults` N) is the scenario's job here;
@@ -71,6 +72,7 @@ class ChaosBench(LocalBench):
             transport=transport,
             tx_size=tx_size,
             journal=journal,
+            health=health,
         )
         self.scenario = scenario
         self.seed = seed

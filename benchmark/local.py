@@ -23,7 +23,7 @@ from hotstuff_tpu.consensus import Committee, Parameters
 from hotstuff_tpu.node.config import Secret, write_committee, write_parameters
 
 from .logs import LogParser
-from .utils import BenchError, PathMaker, Print
+from .utils import METRICS_PORT_OFFSET, BenchError, PathMaker, Print
 
 BASE_PORT = 26_500
 
@@ -48,6 +48,7 @@ class LocalBench:
         no_claim_dedup: bool = False,
         journal: bool = False,
         profile: bool = False,
+        health: bool = False,
     ):
         self.nodes = nodes
         self.rate = rate
@@ -82,6 +83,11 @@ class LocalBench:
         # with journal also on, the spans land in the journals and the
         # merged trace grows a "verify pipeline" track per node process
         self.profile = profile
+        # health=True: live health plane on in every node — online
+        # anomaly detectors + campaign recorder, and a /metrics+/delta
+        # endpoint per node at consensus port + METRICS_PORT_OFFSET so
+        # `python -m benchmark watch` can attach to the running fleet
+        self.health = health
         # in_process=True: the whole committee co-locates in ONE node
         # process (`run-many`, the reference's in-process testbed shape,
         # main.rs:102-148).  On a host with fewer cores than nodes the
@@ -176,6 +182,8 @@ class LocalBench:
             )
         if self.profile:
             wan_env["HOTSTUFF_PROFILE"] = "1"
+        if self.health:
+            wan_env["HOTSTUFF_HEALTH"] = "1"
         proc = subprocess.Popen(
             cmd,
             stdout=f,
@@ -206,7 +214,7 @@ class LocalBench:
         return proc
 
     def _node_cmd(self, i: int) -> list[str]:
-        return [
+        cmd = [
             sys.executable,
             "-m",
             "hotstuff_tpu.node",
@@ -225,6 +233,15 @@ class LocalBench:
             "--transport",
             self.transport,
         ]
+        if self.health:
+            # deterministic scrape address: consensus port + fixed
+            # offset, the same derivation `benchmark watch` applies to
+            # the committee file
+            cmd += [
+                "--metrics-port",
+                str(self.base_port + METRICS_PORT_OFFSET + i),
+            ]
+        return cmd
 
     def _client_cmd(self, py: str) -> list[str]:
         """The client process command line — subclass hook (LoadBench
@@ -321,31 +338,36 @@ class LocalBench:
             # Boot the committee (skip `faults` nodes — crash-fault
             # injection, reference local.py:75-76).
             if self.in_process:
-                self._spawn(
-                    [
-                        py,
-                        "-m",
-                        "hotstuff_tpu.node",
-                        "-vv",
-                        "run-many",
-                        "--keys",
-                        ",".join(
-                            PathMaker.key_file(i)
-                            for i in range(self.nodes - self.faults)
-                        ),
-                        "--committee",
-                        PathMaker.committee_file(),
-                        "--store-prefix",
-                        os.path.join(PathMaker.base_path(), ".db_"),
-                        "--parameters",
-                        PathMaker.parameters_file(),
-                        "--verifier",
-                        self.verifier,
-                        "--transport",
-                        self.transport,
-                    ],
-                    PathMaker.node_log_file(0),
-                )
+                run_many_cmd = [
+                    py,
+                    "-m",
+                    "hotstuff_tpu.node",
+                    "-vv",
+                    "run-many",
+                    "--keys",
+                    ",".join(
+                        PathMaker.key_file(i)
+                        for i in range(self.nodes - self.faults)
+                    ),
+                    "--committee",
+                    PathMaker.committee_file(),
+                    "--store-prefix",
+                    os.path.join(PathMaker.base_path(), ".db_"),
+                    "--parameters",
+                    PathMaker.parameters_file(),
+                    "--verifier",
+                    self.verifier,
+                    "--transport",
+                    self.transport,
+                ]
+                if self.health:
+                    # one co-located process: node 0's derived port
+                    # serves the whole committee's /delta
+                    run_many_cmd += [
+                        "--metrics-port",
+                        str(self.base_port + METRICS_PORT_OFFSET),
+                    ]
+                self._spawn(run_many_cmd, PathMaker.node_log_file(0))
             else:
                 for i in range(self.nodes - self.faults):
                     self._spawn_node(i)

@@ -31,6 +31,7 @@ from __future__ import annotations
 import glob
 import os
 import re
+from collections import Counter
 from datetime import datetime
 from statistics import mean
 
@@ -70,6 +71,11 @@ RE_VERIFY_STATS = re.compile(
 # cumulative JSON document superseding 'Work stats:'; keep the LAST
 # line per node log
 RE_TELEMETRY = re.compile(r"Telemetry snapshot: (\{.*\})")
+# health-plane incident transitions (telemetry/health.py HealthMonitor):
+# one JSON document per detector open/close, timestamped so the SLO
+# burn-rate can be integrated over the run
+RE_HEALTH = re.compile(_TS + r".*Health incident: (\{.*\})")
+RE_HEALTH_ON = re.compile(r"Health monitor running")
 
 
 def _ts(s: str) -> float:
@@ -175,6 +181,23 @@ class LogParser:
                 self.telemetry_docs.append(_json.loads(matches[-1]))
             except ValueError:
                 pass  # truncated log line mid-write
+
+        # health-plane incidents (ISSUE 13): every detector open/close
+        # transition with its wall time, plus how many nodes ran the
+        # in-process monitor (so a quiet run still renders the block —
+        # "detectors on, nothing fired" is the healthy-run proof)
+        self.health_nodes = 0
+        self.health_events: list[tuple[float, dict]] = []
+        for content in node_logs:
+            if RE_HEALTH_ON.search(content):
+                self.health_nodes += 1
+            for ts, blob in RE_HEALTH.findall(content):
+                try:
+                    doc = _json.loads(blob)
+                except ValueError:
+                    continue  # truncated log line mid-write
+                self.health_events.append((_ts(ts), doc))
+        self.health_events.sort(key=lambda e: e[0])
 
         # compact-certificate telemetry (ISSUE 9): the aggregator section
         # records the last emitted QC's wire size (compact = agg sig +
@@ -392,6 +415,7 @@ class LogParser:
             + f" Client rate warnings: {self.rate_warnings}\n"
             + self._verify_stats_txt()
             + self._telemetry_breakdown_txt()
+            + self._health_txt()
             + extra
             + "-----------------------------------------\n"
         )
@@ -460,6 +484,61 @@ class LogParser:
                 f" ({form})\n"
             )
         return out
+
+    def _health_txt(self) -> str:
+        """The ``+ HEALTH`` block (only for runs with the health plane
+        on): per-detector incident counts plus the SLO burn — the
+        fraction of monitored node-time spent inside an open incident.
+        Incidents still open at the end of the log burn until the last
+        observed event."""
+        if not self.health_nodes and not self.health_events:
+            return ""
+        lines = [" + HEALTH (anomaly detectors):\n"]
+        lines.append(f" Nodes monitored: {self.health_nodes}\n")
+        opens: Counter = Counter()
+        open_at: dict[tuple[str, str], float] = {}
+        spans: list[tuple[tuple[str, str], float, float]] = []
+        for t, doc in self.health_events:
+            key = (doc.get("node", ""), doc.get("kind", "?"))
+            if doc.get("phase") == "open":
+                opens[doc.get("kind", "?")] += 1
+                open_at.setdefault(key, t)
+            elif key in open_at:
+                spans.append((key, open_at.pop(key), t))
+        if open_at:
+            end = max(
+                [t for t, _ in self.health_events]
+                + list(self.commits.values())
+            )
+            for key, t0 in open_at.items():
+                spans.append((key, t0, end))
+        if opens:
+            shown = ", ".join(
+                f"{kind} x{c}" if c > 1 else kind
+                for kind, c in sorted(opens.items())
+            )
+            lines.append(
+                f" Incidents: {sum(opens.values())} ({shown})\n"
+            )
+            worst = max(spans, key=lambda s: s[2] - s[1], default=None)
+            if worst is not None:
+                (node, kind), t0, t1 = worst
+                lines.append(
+                    f" Longest incident: {kind} on {node or '?'}"
+                    f" ({t1 - t0:.1f} s)\n"
+                )
+        else:
+            lines.append(" Incidents: 0\n")
+        _, c_dur = self.consensus_throughput()
+        if c_dur and self.health_nodes:
+            burn = sum(t1 - t0 for _, t0, t1 in spans) / (
+                c_dur * self.health_nodes
+            )
+            lines.append(
+                f" SLO burn: {100.0 * min(burn, 1.0):.1f}% of monitored"
+                " node-time inside an open incident\n"
+            )
+        return "".join(lines)
 
     def _telemetry_breakdown_txt(self) -> str:
         """Commit-latency breakdown from the per-node telemetry

@@ -23,7 +23,13 @@ import subprocess
 from .instance import TpuVmManager, _default_runner
 from .logs import LogParser
 from .settings import Settings
-from .utils import BenchError, PathMaker, Print, save_result
+from .utils import (
+    METRICS_PORT_OFFSET,
+    BenchError,
+    PathMaker,
+    Print,
+    save_result,
+)
 
 
 class RemoteBench:
@@ -186,6 +192,7 @@ class RemoteBench:
         profile: bool = False,
         fault_plane: bool = False,
         adversary: bool = False,
+        watch: bool = False,
     ) -> None:
         """Boot clients then nodes in detached remote shells
         (reference remote.py:177-219)."""
@@ -216,6 +223,17 @@ class RemoteBench:
             self._ssh(h, f"mkdir -p {repo}/logs")
         for i in range(nodes - faults):
             host = hosts[i % len(hosts)]
+            node_flags = tel_flags
+            if watch:
+                # health plane + per-node metrics endpoint: the metrics
+                # port shares the consensus port's co-location offset so
+                # the driver can derive it from the instance map alone
+                metrics_port = (
+                    self.settings.consensus_port
+                    + i // len(hosts)
+                    + METRICS_PORT_OFFSET
+                )
+                node_flags += f" --health --metrics-port {metrics_port}"
             node_cmd = (
                 f"( cd {repo} && exec nohup python3 -m hotstuff_tpu.node"
                 f" -vv run"
@@ -224,7 +242,7 @@ class RemoteBench:
                 f" --store .db_{i}"
                 f" --parameters {PathMaker.parameters_file()}"
                 f" --verifier {verifier}"
-                f"{tel_flags}"
+                f"{node_flags}"
                 f" ) > {repo}/logs/node-{i}.log 2>&1 < /dev/null &"
             )
             self._ssh(host["name"], node_cmd)
@@ -294,6 +312,52 @@ class RemoteBench:
                 merged += 1
         return merged
 
+    def _watch_window(
+        self, hosts: list[dict], nodes: int, window_s: float
+    ) -> None:
+        """Live fleet dashboard over the instance map for the length of
+        the measurement window (`remote --watch`).  Targets are the
+        instances' EXTERNAL IPs — the driver sits outside the testbed
+        network — and every scrape runs under the short watch timeout,
+        so an unreachable node shows STALE instead of hanging the
+        sweep."""
+        from hotstuff_tpu.node.config import Secret
+
+        from .watch import FleetWatcher, run_watch
+
+        targets, keys = [], []
+        for i in range(nodes):
+            name = Secret.read(PathMaker.key_file(i)).name
+            keys.append(name)
+            host = hosts[i % len(hosts)]
+            targets.append(
+                {
+                    "index": i,
+                    "name": str(name)[:8],
+                    "key": name,
+                    "host": host["external_ip"] or host["internal_ip"],
+                    "port": self.settings.consensus_port
+                    + i // len(hosts)
+                    + METRICS_PORT_OFFSET,
+                }
+            )
+        order = [str(k)[:8] for k in sorted(keys)]
+        watcher = FleetWatcher(targets, order)
+        view = run_watch(watcher, duration=window_s, interval=2.0)
+        stale = [
+            v.get("name", "?") for v in view["nodes"] if v.get("stale")
+        ]
+        if stale:
+            Print.warn(f"STALE at window end: {', '.join(stale)}")
+        if watcher.incidents:
+            Print.warn(
+                f"{len(watcher.incidents)} incident(s) during the window: "
+                + ", ".join(
+                    f"{i.kind}@{i.node or 'fleet'}"
+                    for _, i in watcher.incidents[-10:]
+                )
+            )
+
     def run(
         self,
         nodes_list: list[int],
@@ -306,6 +370,7 @@ class RemoteBench:
         profile: bool = False,
         fault_plane: str | None = None,
         fault_seed: int = 0,
+        watch: bool = False,
     ) -> None:
         """The sweep driver (reference remote.py:237-298).
 
@@ -348,8 +413,12 @@ class RemoteBench:
                         adversary=bool(
                             chaos_spec and chaos_spec.get("adversary")
                         ),
+                        watch=watch,
                     )
-                    time.sleep(duration + 20)
+                    if watch:
+                        self._watch_window(hosts, nodes, duration + 20)
+                    else:
+                        time.sleep(duration + 20)
                     self.kill()
                     parser = self._logs(hosts, nodes, faults)
                     summary = parser.result(

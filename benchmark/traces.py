@@ -45,6 +45,7 @@ from hotstuff_tpu.telemetry.taxonomy import (
     BYZ_PREFIX,
     CONTROL_EDGES,
     FAULT_PREFIX,
+    HEALTH_PREFIX,
     INGEST_PREFIX,
     SPAN_ANNOTATION_STAGES,
 )
@@ -88,6 +89,58 @@ def load_journals(dir_path: str) -> dict[str, list[dict]]:
     for records in by_node.values():
         records.sort(key=lambda r: r.get("m", 0))
     return dict(by_node)
+
+
+def load_campaigns(dir_path: str) -> dict[str, dict]:
+    """node id -> that node's persisted campaign ring (the
+    ``<node>-campaign.json`` files the on-node recorder writes beside
+    the journal segments; never matched by the ``*.jsonl`` glob above)."""
+    from hotstuff_tpu.telemetry.health import CAMPAIGN_SUFFIX, CampaignRecorder
+
+    out: dict[str, dict] = {}
+    for path in sorted(
+        glob.glob(os.path.join(dir_path, f"*{CAMPAIGN_SUFFIX}"))
+    ):
+        try:
+            doc = CampaignRecorder.load(path)
+        except (OSError, ValueError):
+            continue  # torn write on a crashed node — merge the rest
+        node = doc.get("node") or os.path.basename(path)[
+            : -len(CAMPAIGN_SUFFIX)
+        ]
+        out[node] = doc
+    return out
+
+
+def merge_campaigns(dir_path: str, out_path: str) -> str | None:
+    """Fold every node's campaign ring into one report artifact at
+    ``out_path`` (the ``logs/campaign.json`` the traces task writes).
+    Returns the path, or None when no campaign files exist.  The merged
+    document keeps per-node sample series verbatim and adds a fleet
+    header (nodes, per-node sample counts, common time range) so a
+    campaign can be replotted without re-running anything."""
+    campaigns = load_campaigns(dir_path)
+    if not campaigns:
+        return None
+    spans = {}
+    for node, doc in campaigns.items():
+        ts = [s.get("t", 0.0) for s in doc.get("samples", ())]
+        spans[node] = {
+            "samples": len(ts),
+            "from": min(ts) if ts else None,
+            "to": max(ts) if ts else None,
+        }
+    merged = {
+        "nodes": sorted(campaigns),
+        "coverage": spans,
+        "campaigns": campaigns,
+    }
+    parent = os.path.dirname(out_path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(merged, f, sort_keys=True)
+    return out_path
 
 
 # ---- clock-offset estimation ----------------------------------------------
@@ -197,6 +250,12 @@ class TraceSet:
         # "shed" carries the shed payload count in the value, "credit"
         # the granted credit window (sampled every 64th decision).
         self.ingest_events: list[tuple[int, str, str, int]] = []
+        # health-plane incident windows (ISSUE 13): (node, kind,
+        # w_open_corr, w_close_corr|None).  Each node's in-process
+        # monitor journals open/close per detector, phase in the peer
+        # field (like adversary windows, these are per-node, not
+        # committee-wide).
+        self.health_spans: list[tuple[str, str, int, int | None]] = []
         # verify-pipeline profiler spans (ISSUE 4): node -> list of
         # (stage, w_end_corr, dur_ns).  A span record's timestamps mark
         # the span's END; its duration rides in the "u" field.
@@ -234,6 +293,7 @@ class TraceSet:
     def _reconstruct(self) -> None:
         fault_edges_best: list[tuple[int, str, str]] = []
         byz_edges: list[tuple[int, str, str, str]] = []  # (w, node, kind, label)
+        health_edges: list[tuple[int, str, str, str]] = []  # (w, node, kind, phase)
         for node, records in self.journals.items():
             producer_seen: dict[str, int] = {}  # digest -> monotonic ns
             fault_edges: list[tuple[int, str, str]] = []  # (w_corr, kind, label)
@@ -250,6 +310,18 @@ class TraceSet:
                         self.byz_events.append(
                             (w, node, kind, int(r.get("r", 0)))
                         )
+                    continue
+                if e.startswith(HEALTH_PREFIX):
+                    # health-plane records must never reach _block ("d"
+                    # is None); open/close phase rides the peer field
+                    health_edges.append(
+                        (
+                            self._corr(node, r["w"]),
+                            node,
+                            e[len(HEALTH_PREFIX):],
+                            r.get("p", ""),
+                        )
+                    )
                     continue
                 if e.startswith(INGEST_PREFIX):
                     # admission-plane records must never reach _block
@@ -342,6 +414,18 @@ class TraceSet:
         self.byz_spans.sort(key=lambda s: s[2])
         self.byz_events.sort()
         self.ingest_events.sort()
+        # health incidents pair per (node, detector kind) — each node's
+        # monitor journals only its own firings
+        health_open: dict[tuple[str, str], int] = {}
+        for w, node, kind, phase in sorted(health_edges):
+            key = (node, kind)
+            if phase == "open":
+                health_open.setdefault(key, w)
+            elif key in health_open:
+                self.health_spans.append((node, kind, health_open.pop(key), w))
+        for (node, kind), w in health_open.items():  # still-open incidents
+            self.health_spans.append((node, kind, w, None))
+        self.health_spans.sort(key=lambda s: s[2])
 
     # ---- derived views -----------------------------------------------------
 
@@ -517,6 +601,21 @@ class TraceSet:
                 )
                 + "\n"
             )
+        if self.health_spans:
+            kinds = Counter(k for _n, k, _o, _c in self.health_spans)
+            shown = ", ".join(
+                f"{kind} x{c}" if c > 1 else kind
+                for kind, c in sorted(kinds.items())
+            )
+            still_open = sum(
+                1 for _n, _k, _o, c in self.health_spans if c is None
+            )
+            lines.append(
+                f" Health incidents journaled: {len(self.health_spans)}"
+                f" ({shown})"
+                + (f"; {still_open} never closed" if still_open else "")
+                + "\n"
+            )
         if self.verify_spans:
             total: Counter = Counter()
             count = 0
@@ -567,6 +666,8 @@ class TraceSet:
         anchors.extend(w for _, _, _, w in self.byz_spans if w is not None)
         anchors.extend(w for w, _, _, _ in self.byz_events)
         anchors.extend(w for w, _, _, _ in self.ingest_events)
+        anchors.extend(w for _, _, w, _ in self.health_spans)
+        anchors.extend(w for _, _, _, w in self.health_spans if w is not None)
         for rows in self.verify_spans.values():
             # a span's start = its end stamp minus its duration
             anchors.extend(w - dur for _, w, dur in rows)
@@ -806,6 +907,51 @@ class TraceSet:
                             },
                         }
                     )
+        if self.health_spans:
+            # dedicated incidents track (one pid past the ingest plane):
+            # per-node lanes, one duration slice per detector firing so
+            # an incident reads directly against the consensus rounds and
+            # fault windows it explains
+            health_pid = len(self.nodes) + 3
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": health_pid,
+                    "tid": 0,
+                    "args": {"name": "incidents"},
+                }
+            )
+            lanes = sorted({n for n, _k, _o, _c in self.health_spans})
+            tid_of = {n: i for i, n in enumerate(lanes)}
+            for n, tid in tid_of.items():
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": health_pid,
+                        "tid": tid,
+                        "args": {"name": f"health {n}"},
+                    }
+                )
+            for node, kind, w_open, w_close in self.health_spans:
+                end = w_close if w_close is not None else horizon
+                events.append(
+                    {
+                        "name": kind,
+                        "cat": "health",
+                        "ph": "X",
+                        "pid": health_pid,
+                        "tid": tid_of[node],
+                        "ts": us(w_open),
+                        "dur": max(1.0, us(end) - us(w_open)),
+                        "args": {
+                            "kind": kind,
+                            "node": node,
+                            "closed": w_close is not None,
+                        },
+                    }
+                )
         for node, rows in sorted(self.verify_spans.items()):
             # verify-pipeline profiler track (ISSUE 4): one thread lane
             # under the journaling node's process, so the dispatch
@@ -869,4 +1015,10 @@ class TraceSet:
         return path
 
 
-__all__ = ["load_journals", "estimate_offsets", "TraceSet"]
+__all__ = [
+    "load_journals",
+    "load_campaigns",
+    "merge_campaigns",
+    "estimate_offsets",
+    "TraceSet",
+]

@@ -8,6 +8,12 @@ from __future__ import annotations
 
 import os
 
+#: fixed offset from a node's consensus port to its /metrics + /delta
+#: endpoint — the derivation shared by the bench drivers (which pass
+#: --metrics-port) and `python -m benchmark watch` (which scrapes it
+#: from nothing but the committee file)
+METRICS_PORT_OFFSET = 3_000
+
 
 class PathMaker:
     """Every file-name convention in one place (reference utils.py:12-73)."""
@@ -58,6 +64,12 @@ class PathMaker:
     def trace_file() -> str:
         """The merged Chrome trace-event JSON (open in Perfetto)."""
         return os.path.join(PathMaker.logs_path(), "trace.json")
+
+    @staticmethod
+    def campaign_file() -> str:
+        """The merged campaign report artifact (`benchmark traces`
+        folds every node's <node>-campaign.json ring into it)."""
+        return os.path.join(PathMaker.logs_path(), "campaign.json")
 
     @staticmethod
     def fault_spec_file() -> str:

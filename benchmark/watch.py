@@ -1,0 +1,454 @@
+"""Fleet watch: the live committee dashboard (`python -m benchmark watch`).
+
+The aggregator half of the health plane (ISSUE 13).  It builds the
+committee map from the real key + committee files (the same resolution
+the chaos harness uses — never a re-derived port guess), scrapes every
+node's ``/delta`` endpoint (``telemetry/exporter.py``) through a
+per-node :class:`~hotstuff_tpu.telemetry.health.DeltaDecoder`, and each
+tick renders a terminal dashboard:
+
+  per-node round / commit-rate / expected-leader marker / verify
+  route-mix / ingest credit / lag-vs-fleet-head columns, a fleet-wide
+  commit p50, and the live incident feed.
+
+Fleet-level anomaly detectors run here over the scraped windows — the
+pure functions from ``telemetry/health.py`` that need cross-node
+visibility: expected-leader stall (attributed to the round-robin leader
+of the fleet head round), straggler (round lag, clock-offset aware),
+and state-root divergence at the same version.  Node-local detectors
+(view-change storm, commit collapse, shed storm) run on the nodes
+themselves and surface through the journal / log-line path.
+
+Unreachable nodes never hang the loop: scrapes run with short timeouts
+and a node that misses ``STALE_AFTER`` consecutive pulls shows an
+explicit ``STALE`` status column until it answers again.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from hotstuff_tpu.node.config import Secret, read_committee
+from hotstuff_tpu.telemetry.health import (
+    DeltaDecoder,
+    Incident,
+    Window,
+    leader_stall,
+    root_divergence,
+    straggler,
+)
+
+from .utils import METRICS_PORT_OFFSET, PathMaker, Print
+
+#: per-scrape HTTP timeout — a dead node costs one of these per tick,
+#: never a hang
+SCRAPE_TIMEOUT_S = 0.8
+
+#: consecutive failed scrapes before a node's status column flips STALE
+STALE_AFTER = 3
+
+#: columns: (header, width)
+_COLUMNS = (
+    ("NODE", 8),
+    ("ST", 5),
+    ("ROUND", 7),
+    ("CMT/S", 7),
+    ("LAG", 5),
+    ("LDR", 3),
+    ("ROUTE d/m/c", 12),
+    ("CREDIT", 7),
+    ("P50ms", 7),
+)
+
+
+def _http_get_json(url: str, timeout_s: float = SCRAPE_TIMEOUT_S) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fleet_targets(max_nodes: int = 1024) -> tuple[list, list]:
+    """(targets, leader_order) from the on-disk committee + key files.
+
+    Each target: ``{"index", "name", "key", "host", "port"}`` with
+    ``port`` the node's metrics endpoint (consensus port +
+    METRICS_PORT_OFFSET, the derivation LocalBench uses).
+    ``leader_order`` is the round-robin leader schedule: short names
+    sorted by public key, so ``leader_order[round % n]`` is the
+    expected leader of ``round``.
+    """
+    committee = read_committee(PathMaker.committee_file())
+    targets = []
+    for i in range(max_nodes):
+        path = PathMaker.key_file(i)
+        if not os.path.exists(path):
+            break
+        name = Secret.read(path).name
+        addr = committee.address(name)
+        if addr is None:
+            continue  # key file from an older layout — not a member
+        targets.append(
+            {
+                "index": i,
+                "name": str(name)[:8],
+                "key": name,
+                "host": addr[0],
+                "port": addr[1] + METRICS_PORT_OFFSET,
+            }
+        )
+    if not targets:
+        raise RuntimeError(
+            "no committee found: run `python -m benchmark local --health` "
+            "(or chaos/load) first so .committee.json/.node_*.json exist"
+        )
+    order = [
+        str(k)[:8] for k in sorted(t["key"] for t in targets)
+    ]
+    return targets, order
+
+
+class NodeFeed:
+    """One node's scrape state: the delta decoder plus staleness
+    tracking.  ``poll`` never raises and never blocks past the scrape
+    timeout."""
+
+    def __init__(self, name: str, url: str, opener=None):
+        self.name = name
+        self.url = url
+        self.decoder = DeltaDecoder()
+        self.failures = 0
+        self._get = opener or _http_get_json
+
+    @property
+    def stale(self) -> bool:
+        return self.failures >= STALE_AFTER
+
+    def poll(self, timeout_s: float = SCRAPE_TIMEOUT_S) -> dict | None:
+        """One ``/delta`` pull; the up-to-date flat state or None.  A
+        sequence gap costs one immediate full re-pull (the decoder
+        already reset ``since``), not a wrong merge."""
+        for _ in range(2):
+            try:
+                frame = self._get(
+                    f"{self.url}/delta?since={self.decoder.since}", timeout_s
+                )
+            except (OSError, ValueError):
+                self.failures += 1
+                return None
+            state = self.decoder.apply(frame)
+            if state is not None:
+                self.failures = 0
+                return state
+        self.failures += 1
+        return None
+
+
+def node_view(name: str, flat: dict) -> dict:
+    """Extract one node's dashboard row fields from its flat state."""
+
+    def g(key, default=None):
+        return flat.get(f"{name}.{key}", default)
+
+    return {
+        "name": name,
+        "round": g("metrics.hotstuff_core_round") or g("state.last_round", 0),
+        "commits": g("trace.commits", 0),
+        "credit": g("ingest.last_credit", 0),
+        "shed": g("ingest.shed_total", 0),
+        "version": g("state.version", 0),
+        "root": g("state.root", ""),
+        "p50_ms": g(
+            "metrics.hotstuff_commit_edge_seconds{edge=propose_to_commit}"
+            ".p50_ms",
+            g("trace.edges.propose_to_commit.p50_ms", 0.0),
+        ),
+        "route": tuple(
+            g(f"metrics.hotstuff_verify_route{{route={r}}}", 0)
+            for r in ("device", "mesh", "cpu")
+        ),
+        # node-local detector firings the node itself reports (its own
+        # HealthMonitor section) — surfaced in the live incident feed
+        "alerts": sorted(
+            str(v)
+            for k, v in flat.items()
+            if k.startswith(f"{name}.health.open.")
+        ),
+    }
+
+
+class FleetWatcher:
+    """Scrape -> window -> detect -> render, one committee-wide tick at
+    a time.  ``tick`` is side-effect free beyond the scrapes and its
+    internal windows, and ``render`` is a pure function of the returned
+    view, so tests drive both with fake openers and fixture clocks."""
+
+    def __init__(
+        self,
+        targets: list,
+        leader_order: list,
+        timeout_s: float = 5.0,
+        stall_k: float = 3.0,
+        opener=None,
+        offsets: dict | None = None,
+    ):
+        self.feeds = [
+            NodeFeed(t["name"], f"http://{t['host']}:{t['port']}", opener)
+            for t in targets
+        ]
+        self.leader_order = leader_order
+        self.timeout_s = timeout_s
+        self.stall_k = stall_k
+        # per-node estimated clock offsets (seconds) for the straggler
+        # freshness check; live watch has no journal to estimate from,
+        # so this defaults to zeros — the remote driver may pass better
+        self.offsets = offsets or {}
+        span = max(60.0, 4 * stall_k * timeout_s)
+        self._w_commits = {f.name: Window(span_s=span) for f in self.feeds}
+        self._last_sample: dict = {}  # node -> (t, view)
+        self._pool = ThreadPoolExecutor(max_workers=max(len(self.feeds), 1))
+        self.incidents: list = []  # (t, Incident) history
+        self._open: set = set()  # (kind, node) currently firing
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # -- one tick ---------------------------------------------------------
+
+    def tick(self, now: float) -> dict:
+        states = list(
+            self._pool.map(lambda f: (f, f.poll()), self.feeds)
+        )
+        views = []
+        rounds_by_node: dict = {}
+        roots_by_node: dict = {}
+        for feed, flat in states:
+            if flat is None:
+                prev = self._last_sample.get(feed.name)
+                view = dict(prev[1]) if prev else {"name": feed.name}
+                view["stale"] = feed.stale
+                views.append(view)
+                continue
+            view = node_view(feed.name, flat)
+            view["stale"] = False
+            self._last_sample[feed.name] = (now, view)
+            self._w_commits[feed.name].push(now, float(view["commits"] or 0))
+            rounds_by_node[feed.name] = (now, float(view["round"] or 0))
+            if view["root"]:
+                roots_by_node[feed.name] = (
+                    int(view["version"] or 0),
+                    str(view["root"]),
+                )
+            views.append(view)
+
+        head = max(
+            (float(v.get("round") or 0) for v in views), default=0.0
+        )
+        leader = (
+            self.leader_order[int(head) % len(self.leader_order)]
+            if self.leader_order
+            else ""
+        )
+        fired = self._detect(
+            now, leader, rounds_by_node, roots_by_node, views
+        )
+        self._record(now, fired)
+        p50s = [
+            float(v["p50_ms"])
+            for v in views
+            if v.get("p50_ms") and not v.get("stale")
+        ]
+        return {
+            "t": now,
+            "nodes": views,
+            "head": head,
+            "leader": leader,
+            "fleet_p50_ms": statistics.median(p50s) if p50s else 0.0,
+            "incidents": [i for (_, i) in self.incidents[-8:]],
+            "open": sorted(self._open),
+        }
+
+    #: node-reported kinds keep the severity their detector assigns
+    _SEVERITY = {
+        "leader_stall": "crit",
+        "commit_collapse": "crit",
+        "root_divergence": "crit",
+    }
+
+    def _detect(
+        self, now, leader, rounds_by_node, roots_by_node, views
+    ) -> list:
+        fired = []
+        # incidents the nodes' own monitors hold open (scraped from the
+        # snapshot's health section): the node sees its local anomalies
+        # — shed storms, its own commit stall — before the fleet can
+        for v in views:
+            if v.get("stale"):
+                continue
+            for kind in v.get("alerts") or ():
+                fired.append(
+                    Incident(
+                        kind,
+                        v["name"],
+                        self._SEVERITY.get(kind, "warn"),
+                        "reported by the node's own monitor",
+                    )
+                )
+        if leader and leader in self._w_commits:
+            inc = leader_stall(
+                self._w_commits[leader].samples(),
+                now,
+                self.timeout_s,
+                k=self.stall_k,
+                node=leader,
+            )
+            if inc:
+                fired.append(inc)
+        fired.extend(
+            straggler(rounds_by_node, self.offsets, now)
+        )
+        fired.extend(root_divergence(roots_by_node))
+        return fired
+
+    def _record(self, now, fired) -> None:
+        keys = {(i.kind, i.node) for i in fired}
+        for inc in fired:
+            if (inc.kind, inc.node) not in self._open:
+                self.incidents.append((now, inc))
+        self._open = keys
+
+
+def render(view: dict) -> str:
+    """The dashboard frame for one tick's view — pure string building."""
+    lines = []
+    header = " ".join(h.ljust(w) for h, w in _COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for v in view["nodes"]:
+        stale = v.get("stale", True)
+        round_ = float(v.get("round") or 0)
+        lag = max(view["head"] - round_, 0.0)
+        route = v.get("route") or (0, 0, 0)
+        cells = (
+            v.get("name", "?"),
+            "STALE" if stale else "ok",
+            f"{round_:.0f}",
+            _fmt_rate(v),
+            f"{lag:.0f}",
+            "*" if v.get("name") == view["leader"] else "",
+            "/".join(str(int(r or 0)) for r in route),
+            str(v.get("credit", "") or 0),
+            f"{float(v.get('p50_ms') or 0):.1f}",
+        )
+        lines.append(
+            " ".join(str(c).ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
+        )
+    lines.append(
+        f"fleet: head round {view['head']:.0f}, expected leader "
+        f"{view['leader'] or '?'}, commit p50 {view['fleet_p50_ms']:.1f} ms"
+    )
+    if view["open"]:
+        lines.append(
+            "OPEN INCIDENTS: "
+            + ", ".join(f"{k}@{n or 'fleet'}" for k, n in view["open"])
+        )
+    for inc in view["incidents"]:
+        lines.append(
+            f"  ! [{inc.severity}] {inc.kind} {inc.node or 'fleet'}: "
+            f"{inc.detail}"
+        )
+    return "\n".join(lines)
+
+
+def _fmt_rate(v: dict) -> str:
+    r = v.get("commit_rate")
+    return f"{r:.1f}" if isinstance(r, float) else "-"
+
+
+def run_watch(
+    watcher: FleetWatcher,
+    duration: float = 0.0,
+    interval: float = 1.0,
+    once: bool = False,
+    out=print,
+    clock=time,
+) -> dict:
+    """The watch loop; returns the final tick's view.  ``duration <= 0``
+    means until interrupted."""
+    deadline = clock.time() + duration if duration > 0 else None
+    view: dict = {"nodes": [], "head": 0.0, "leader": "",
+                  "fleet_p50_ms": 0.0, "incidents": [], "open": []}
+    try:
+        while True:
+            t0 = clock.time()
+            view = watcher.tick(t0)
+            # per-node commit rate for display: window-slope, computed
+            # here so tick's view stays raw counters
+            for v in view["nodes"]:
+                w = watcher._w_commits.get(v.get("name", ""), None)
+                samples = w.samples() if w else []
+                if len(samples) >= 2:
+                    (ta, va), (tb, vb) = samples[0], samples[-1]
+                    v["commit_rate"] = (
+                        (vb - va) / (tb - ta) if tb > ta else 0.0
+                    )
+            if out is print and sys.stdout.isatty() and not once:
+                print("\x1b[2J\x1b[H", end="")
+            out(render(view))
+            if once or (deadline is not None and clock.time() >= deadline):
+                return view
+            clock.sleep(max(0.0, interval - (clock.time() - t0)))
+    except KeyboardInterrupt:
+        return view
+    finally:
+        watcher.close()
+
+
+def task_watch(args) -> None:
+    """`python -m benchmark watch` entry point."""
+    targets, order = fleet_targets()
+    Print.heading(
+        f"Watching {len(targets)} committee nodes "
+        f"({targets[0]['host']}:{targets[0]['port']}..)"
+    )
+    watcher = FleetWatcher(
+        targets,
+        order,
+        timeout_s=args.timeout_delay / 1000.0,
+        opener=None,
+    )
+    view = run_watch(
+        watcher,
+        duration=args.duration,
+        interval=args.interval,
+        once=args.once,
+    )
+    if watcher.incidents:
+        Print.warn(
+            f"{len(watcher.incidents)} incident(s) observed: "
+            + ", ".join(
+                f"{i.kind}@{i.node or 'fleet'}"
+                for _, i in watcher.incidents[-10:]
+            )
+        )
+    else:
+        Print.info("no incidents observed")
+    return view
+
+
+__all__ = [
+    "METRICS_PORT_OFFSET",
+    "SCRAPE_TIMEOUT_S",
+    "STALE_AFTER",
+    "fleet_targets",
+    "NodeFeed",
+    "node_view",
+    "FleetWatcher",
+    "render",
+    "run_watch",
+    "task_watch",
+]

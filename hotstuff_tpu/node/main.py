@@ -211,6 +211,18 @@ def _apply_ingest(args) -> None:
         os.environ["HOTSTUFF_INGEST_WATERMARK"] = str(w)
 
 
+def _apply_health(args) -> None:
+    """Activate the live health plane when ``--health`` was given: sets
+    HOTSTUFF_HEALTH (env-first, inherited by child node processes) so
+    every booted node runs the per-node HealthMonitor
+    (telemetry/health.py) — online detectors, ``health.*`` incident
+    journal edges, and the bounded campaign recorder."""
+    import os
+
+    if getattr(args, "health", False):
+        os.environ["HOTSTUFF_HEALTH"] = "1"
+
+
 def _apply_fresh_state(args) -> None:
     """Bridge ``--fresh-state`` into HOTSTUFF_FRESH_STATE: an explicit
     escape hatch forcing every booted node to discard its persisted
@@ -262,6 +274,7 @@ async def _run_node(args) -> None:
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
     _apply_ingest(args)
+    _apply_health(args)
     _apply_fresh_state(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     node = await Node.new(
@@ -321,6 +334,7 @@ async def _run_many(args) -> None:
     _apply_verify_pipeline(args)
     _apply_mesh_devices(args)
     _apply_ingest(args)
+    _apply_health(args)
     _apply_fresh_state(args)
     await telemetry.maybe_start_server(_metrics_port(args))
     key_files = args.keys.split(",")
@@ -568,6 +582,15 @@ def main(argv=None) -> int:
         metavar="F",
         help=watermark_help,
     )
+    health_help = (
+        "enable the live health plane: per-node online anomaly "
+        "detectors (leader-stall, view-change storm, commit collapse, "
+        "shed storm), health.* incident journal edges, the /delta "
+        "streaming-export route, and the bounded campaign recorder "
+        "(docs/TELEMETRY.md; default: off, or the HOTSTUFF_HEALTH env "
+        "knob)"
+    )
+    p_run.add_argument("--health", action="store_true", help=health_help)
     p_run.add_argument(
         "--fresh-state", action="store_true", help=fresh_state_help
     )
@@ -619,6 +642,7 @@ def main(argv=None) -> int:
         metavar="F",
         help=watermark_help,
     )
+    p_many.add_argument("--health", action="store_true", help=health_help)
     p_many.add_argument(
         "--fresh-state", action="store_true", help=fresh_state_help
     )
@@ -660,6 +684,7 @@ def main(argv=None) -> int:
         metavar="F",
         help=watermark_help,
     )
+    p_dep.add_argument("--health", action="store_true", help=health_help)
     p_dep.add_argument(
         "--fresh-state", action="store_true", help=fresh_state_help
     )
@@ -686,6 +711,7 @@ def main(argv=None) -> int:
         _apply_verify_pipeline(args)
         _apply_mesh_devices(args)
         _apply_ingest(args)
+        _apply_health(args)
         _apply_fresh_state(args)
         asyncio.run(
             _deploy_testbed(

@@ -422,6 +422,39 @@ class Node:
                     sample_lag=not probe_running,
                 )
             )
+        self._health_task = None
+        self._health_monitor = None
+        if tel is not None and telemetry.health_enabled():
+            from ..telemetry.health import CAMPAIGN_SUFFIX, HealthMonitor
+
+            # campaign ring persists beside the journal (when journaling
+            # is on) as <node>-campaign.json — a name the journal
+            # loader's *.jsonl glob never matches
+            campaign_path = (
+                os.path.join(jdir, f"{tel.node}{CAMPAIGN_SUFFIX}")
+                if jdir
+                else None
+            )
+            self._health_monitor = HealthMonitor(
+                tel,
+                tel.node,
+                timeout_s=parameters.timeout_delay / 1000.0,
+                campaign_path=campaign_path,
+                logger=logging.getLogger(f"health.{secret.name}"),
+            )
+            self._health_task = asyncio.ensure_future(
+                self._health_monitor.run()
+            )
+            # the live watch scrapes node-local incidents out of the
+            # snapshot: the node's own monitor sees a commit stall a
+            # fleet-side detector could only infer
+            tel.add_section(
+                "health",
+                lambda m=self._health_monitor: {
+                    "open": sorted(i.kind for i in m.open_incidents()),
+                },
+            )
+            log.info("Health monitor running for node %s", tel.node)
         log.info("Node %s successfully booted", secret.name)
         return self
 
@@ -433,10 +466,13 @@ class Node:
             # Here the application would execute the committed payload.
 
     async def shutdown(self) -> None:
-        for attr in ("_stats_task", "_snapshot_task"):
+        for attr in ("_stats_task", "_snapshot_task", "_health_task"):
             task = getattr(self, attr, None)
             if task is not None:
                 task.cancel()
+        monitor = getattr(self, "_health_monitor", None)
+        if monitor is not None:
+            monitor.close()
         if self.consensus is not None:
             await self.consensus.shutdown()
         journal = getattr(self, "_journal", None)

@@ -140,6 +140,36 @@ def snapshot_all() -> dict:
     return {n: t.snapshot() for n, t in _NODES.items()}
 
 
+def health_enabled() -> bool:
+    """Is the per-node health monitor (telemetry/health.py) on?  Off by
+    default: ``HOTSTUFF_HEALTH=1`` / ``--health`` enable it."""
+    env = os.environ.get("HOTSTUFF_HEALTH")
+    return env is not None and env.strip().lower() not in (
+        "", "0", "false", "no", "off",
+    )
+
+
+def export_doc() -> dict:
+    """The health-plane export document (``/delta``): every node's
+    snapshot sections (state-root cursor, ingest, trace) plus every
+    node-labelled registry instrument under a ``metrics`` block — the
+    nested doc the DeltaStream flattens into delta frames."""
+    doc = snapshot_all()
+    for inst in _REGISTRY:
+        labels = getattr(inst, "labels", None) or {}
+        node = labels.get("node")
+        if node is None or node not in doc:
+            continue
+        key = inst.name
+        extra = sorted(
+            (k, v) for k, v in labels.items() if k != "node"
+        )
+        if extra:
+            key += "{" + ",".join(f"{k}={v}" for k, v in extra) + "}"
+        doc[node].setdefault("metrics", {})[key] = inst.to_json()
+    return doc
+
+
 def trace_all(n: int = 32) -> dict:
     """The newest completed per-round trace records per node (/trace)."""
     return {name: t.trace.recent(n) for name, t in _NODES.items()}
@@ -441,6 +471,8 @@ __all__ = [
     "journal_dir",
     "for_node",
     "snapshot_all",
+    "health_enabled",
+    "export_doc",
     "trace_all",
     "reset",
     "maybe_start_server",

@@ -9,11 +9,19 @@ against the node's own connection budget.
 
 Routes:
 
-- ``GET /metrics``  — Prometheus text exposition format 0.0.4
+- ``GET /metrics``  — OpenMetrics 1.0 text exposition (correct
+  ``Content-Type``, counter families without / samples with the
+  ``_total`` suffix, ``# EOF`` terminator) so real Prometheus scrapers
+  work against a node unmodified
 - ``GET /snapshot`` — the same JSON document the periodic ``Telemetry
   snapshot:`` log line carries, one object per node in this process
 - ``GET /trace``    — the newest completed per-round trace records per
   node (the trace ring buffer, ``telemetry/trace.py``)
+- ``GET /delta?since=N`` — incremental health-plane export
+  (``telemetry/health.py``): a compact JSON delta frame of the flat
+  per-node state (gauges, histograms, state-root cursor) against
+  sequence ``N``, or a full frame when ``N`` is unknown — the fleet
+  watcher pulls O(changed) per tick, not O(all)
 
 ``run_snapshot_logger`` is the periodic per-node task: it samples
 event-loop lag (the same probe contract as ``utils/workstats.run_probe``
@@ -29,7 +37,13 @@ import asyncio
 import json
 import logging
 
+from .health import DeltaStream
+
 log = logging.getLogger(__name__)
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 LOG_INTERVAL = 5.0
 LAG_INTERVAL = 0.05
@@ -45,6 +59,7 @@ class MetricsServer:
         self.host = host
         self.port = port  # 0 = ephemeral; replaced by the bound port
         self._server: asyncio.AbstractServer | None = None
+        self._delta = DeltaStream()
 
     async def start(self) -> "MetricsServer":
         self._server = await asyncio.start_server(
@@ -66,12 +81,28 @@ class MetricsServer:
         """(status, content_type, body) for one request."""
         if method != "GET":
             return 405, "text/plain; charset=utf-8", "method not allowed\n"
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if path == "/metrics":
             return (
                 200,
-                "text/plain; version=0.0.4; charset=utf-8",
-                self.registry.render_prometheus(),
+                OPENMETRICS_CONTENT_TYPE,
+                self.registry.render_openmetrics(),
+            )
+        if path == "/delta":
+            from . import export_doc
+
+            since = -1
+            for part in query.split("&"):
+                if part.startswith("since="):
+                    try:
+                        since = int(part[len("since="):])
+                    except ValueError:
+                        since = -1
+            frame = self._delta.frame(export_doc(), since)
+            return (
+                200,
+                "application/json",
+                json.dumps(frame, sort_keys=True) + "\n",
             )
         if path == "/snapshot":
             from . import snapshot_all
@@ -155,4 +186,9 @@ async def run_snapshot_logger(
             logger.info("Telemetry snapshot: %s", doc)
 
 
-__all__ = ["MetricsServer", "run_snapshot_logger", "LOG_INTERVAL"]
+__all__ = [
+    "MetricsServer",
+    "run_snapshot_logger",
+    "LOG_INTERVAL",
+    "OPENMETRICS_CONTENT_TYPE",
+]

@@ -267,14 +267,44 @@ class Registry:
                     lines.append(f"# HELP {inst.name} {inst.help}")
                 lines.append(f"# TYPE {inst.name} {inst.kind}")
             for sample_name, labels, value in inst.samples():
-                if labels:
-                    lbl = ",".join(
-                        f'{k}="{_escape(str(v))}"' for k, v in labels.items()
-                    )
-                    lines.append(f"{sample_name}{{{lbl}}} {_num(value)}")
-                else:
-                    lines.append(f"{sample_name} {_num(value)}")
+                lines.append(_sample_line(sample_name, labels, value))
         return "\n".join(lines) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition.
+
+        Differences from the 0.0.4 format that real scrapers enforce:
+        counter *metadata* names the family without the ``_total``
+        suffix while every counter *sample* carries it (instruments
+        already named ``*_total`` are not double-suffixed), and the
+        exposition terminates with ``# EOF``.
+        """
+        lines: list[str] = []
+        seen_meta: set[str] = set()
+        for inst in self._instruments.values():
+            family = inst.name
+            if inst.kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
+            if family not in seen_meta:
+                seen_meta.add(family)
+                if inst.help:
+                    lines.append(f"# HELP {family} {inst.help}")
+                lines.append(f"# TYPE {family} {inst.kind}")
+            for sample_name, labels, value in inst.samples():
+                if inst.kind == "counter" and not sample_name.endswith(
+                    "_total"
+                ):
+                    sample_name += "_total"
+                lines.append(_sample_line(sample_name, labels, value))
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def _sample_line(sample_name: str, labels: dict, value) -> str:
+    if labels:
+        lbl = ",".join(f'{k}="{_escape(str(v))}"' for k, v in labels.items())
+        return f"{sample_name}{{{lbl}}} {_num(value)}"
+    return f"{sample_name} {_num(value)}"
 
 
 def _escape(s: str) -> str:

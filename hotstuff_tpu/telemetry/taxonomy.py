@@ -119,13 +119,16 @@ INGEST_EDGES: tuple = ("ingest.shed", "ingest.credit")
 #: identity line
 MISC_EDGES: tuple = ("timeout", "span", "meta")
 
-#: dynamic edge families: the chaos plane journals ``fault.<kind>`` and
-#: the adversary plane ``byz.<kind>`` with scenario-defined kinds; an
+#: dynamic edge families: the chaos plane journals ``fault.<kind>``,
+#: the adversary plane ``byz.<kind>``, and the health plane
+#: ``health.<kind>`` (telemetry/health.py detector incidents, open/close
+#: in the peer field) with scenario-/detector-defined kinds; an
 #: f-string edge is lint-legal iff its constant prefix is listed here
 FAULT_PREFIX = "fault."
 BYZ_PREFIX = "byz."
 INGEST_PREFIX = "ingest."
-JOURNAL_EDGE_PREFIXES: tuple = (FAULT_PREFIX, BYZ_PREFIX)
+HEALTH_PREFIX = "health."
+JOURNAL_EDGE_PREFIXES: tuple = (FAULT_PREFIX, BYZ_PREFIX, HEALTH_PREFIX)
 
 #: every registered static journal edge name (what ``journal.record``
 #: call sites are checked against)
@@ -158,6 +161,7 @@ __all__ = [
     "FAULT_PREFIX",
     "BYZ_PREFIX",
     "INGEST_PREFIX",
+    "HEALTH_PREFIX",
     "JOURNAL_EDGE_PREFIXES",
     "JOURNAL_EDGES",
     "is_registered_edge",

@@ -26,6 +26,10 @@ def main() -> int:
     ap.add_argument("--nodes", type=int, default=4)
     ap.add_argument("--rate", type=int, default=500)
     ap.add_argument("--duration", type=float, default=15.0)
+    ap.add_argument("--watch", action="store_true",
+                    help="health plane on in the sandbox nodes + live "
+                    "fleet dashboard over the instance map for the "
+                    "measurement window (remote --watch)")
     args = ap.parse_args()
 
     # one sandbox "host": the remote layout co-locates extra nodes on a
@@ -69,6 +73,7 @@ def main() -> int:
         nodes_list=[args.nodes],
         rate_list=[args.rate],
         duration=args.duration,
+        watch=args.watch,
         runs=1,
         faults=0,
         verifier="cpu",

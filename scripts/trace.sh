@@ -46,6 +46,14 @@
 #                                 # byz-collude FAILs full-history root
 #                                 # agreement while the trusted subset
 #                                 # PASSes, non-zero exit on any break
+#   HEALTH=1 scripts/trace.sh     # ONLY the live health-plane check
+#                                 # (scripts/health_check.py): fleet
+#                                 # watch attaches to a healthy 4-node
+#                                 # committee with quiet detectors,
+#                                 # leader-isolation trips leader_stall
+#                                 # in the live view AND the + HEALTH
+#                                 # SUMMARY, and the dispatch ratchet
+#                                 # holds with the plane enabled
 #   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
 #                                 # (scripts/analysis_check.py): every
 #                                 # hotstuff_tpu/analysis lint rule,
@@ -85,6 +93,11 @@ fi
 if [ "${STATE:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/state_check.py "$@"
+fi
+
+if [ "${HEALTH:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/health_check.py "$@"
 fi
 
 if [ "${LINT:-0}" = "1" ]; then

@@ -1,0 +1,933 @@
+"""Live fleet health-plane tests (ISSUE 13).
+
+Covers the tentpole pieces — the ``/delta`` wire format
+(flatten/DeltaStream/DeltaDecoder), every online anomaly detector as a
+pure function over fixture windows (fires / does not fire / boundary),
+the bounded campaign recorder, the per-node HealthMonitor incident
+lifecycle (open/close hysteresis, journal edges, log lines), and the
+scraper side (NodeFeed resync, FleetWatcher STALE handling, dashboard
+rendering) — plus two slow end-to-end runs: leader-stall under the
+canned ``leader-isolation`` chaos scenario and shed-storm under an
+open-loop producer past admission capacity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import os
+
+import pytest
+
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.telemetry.health import (
+    CAMPAIGN_SUFFIX,
+    CLEAR_AFTER,
+    DELTA_HISTORY,
+    HEALTH_EDGE_PREFIX,
+    HEALTH_KINDS,
+    CampaignRecorder,
+    DeltaDecoder,
+    DeltaStream,
+    HealthMonitor,
+    Incident,
+    Window,
+    commit_collapse,
+    flatten,
+    leader_stall,
+    rate,
+    root_divergence,
+    shed_storm,
+    straggler,
+    view_change_storm,
+)
+from hotstuff_tpu.telemetry.taxonomy import (
+    HEALTH_PREFIX,
+    is_registered_edge,
+)
+
+from .common import async_test, committee, fresh_base_port, keys
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    """Telemetry state is process-global: every test starts disabled
+    with an empty registry and leaves it that way."""
+    monkeypatch.delenv("HOTSTUFF_TELEMETRY", raising=False)
+    monkeypatch.delenv("HOTSTUFF_METRICS_PORT", raising=False)
+    monkeypatch.delenv("HOTSTUFF_HEALTH", raising=False)
+    monkeypatch.delenv("HOTSTUFF_JOURNAL", raising=False)
+    monkeypatch.delenv("HOTSTUFF_JOURNAL_DIR", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ---- taxonomy contract -----------------------------------------------------
+
+
+def test_health_edges_are_taxonomy_registered():
+    """Every incident kind journals a registered ``health.*`` edge —
+    the PR 12 lint gate must accept the whole dynamic family."""
+    assert HEALTH_EDGE_PREFIX == HEALTH_PREFIX
+    for kind in HEALTH_KINDS:
+        assert is_registered_edge(f"health.{kind}")
+
+
+# ---- delta-frame wire format ----------------------------------------------
+
+
+def test_flatten_nested_lists_and_dropped_leaves():
+    doc = {
+        "a": {"b": 1, "c": {"d": "x"}},
+        "lst": [10, {"k": True}],
+        "none": None,
+        "obj": object(),
+        "f": 2.5,
+    }
+    assert flatten(doc) == {
+        "a.b": 1,
+        "a.c.d": "x",
+        "lst.0": 10,
+        "lst.1.k": True,
+        "f": 2.5,
+    }
+
+
+def test_delta_stream_full_then_deltas():
+    s = DeltaStream()
+    doc = {"n": {"x": 1, "y": 2}}
+    first = s.frame(doc, since=-1)
+    assert first == {"seq": 1, "full": {"n.x": 1, "n.y": 2}}
+
+    # unchanged state: seq does not advance, the delta is empty
+    again = s.frame(doc, since=first["seq"])
+    assert again == {"seq": 1, "base": 1, "set": {}, "del": []}
+
+    # one key changed, one removed: the delta is O(changed)
+    delta = s.frame({"n": {"x": 5}}, since=1)
+    assert delta["seq"] == 2
+    assert delta["base"] == 1
+    assert delta["set"] == {"n.x": 5}
+    assert delta["del"] == ["n.y"]
+
+
+def test_delta_stream_unknown_since_serves_full():
+    s = DeltaStream()
+    s.frame({"a": 1}, since=-1)
+    # a since the server never issued (ahead of seq) falls back to full
+    frame = s.frame({"a": 2}, since=99)
+    assert "full" in frame and frame["full"] == {"a": 2}
+
+
+def test_delta_stream_history_overflow_serves_full():
+    s = DeltaStream(history=DELTA_HISTORY)
+    s.frame({"v": 0}, since=-1)
+    for i in range(1, DELTA_HISTORY + 2):
+        s.frame({"v": i}, since=-1)
+    # seq 1 has fallen off the history ring: full frame, not a bad delta
+    frame = s.frame({"v": 999}, since=1)
+    assert "full" in frame
+
+
+def test_delta_decoder_roundtrip_and_gap_resync():
+    s = DeltaStream()
+    d = DeltaDecoder()
+    state = d.apply(s.frame({"a": 1, "b": 2}, since=d.since))
+    assert state == {"a": 1, "b": 2}
+    state = d.apply(s.frame({"a": 1, "c": 3}, since=d.since))
+    assert state == {"a": 1, "c": 3}
+    assert d.resyncs == 0
+
+    # a delta against a base we do not hold: drop state, request full
+    out = d.apply({"seq": 9, "base": 7, "set": {"x": 1}, "del": []})
+    assert out is None
+    assert d.resyncs == 1
+    assert d.since == -1
+    assert d.state == {}
+    # the follow-up full frame recovers cleanly
+    assert d.apply(s.frame({"a": 1, "c": 3}, since=d.since)) == {
+        "a": 1,
+        "c": 3,
+    }
+
+
+# ---- windows ---------------------------------------------------------------
+
+
+def test_window_trims_by_span_and_capacity():
+    w = Window(span_s=5.0, capacity=4)
+    for t in range(10):
+        w.push(float(t), float(t))
+    # capacity 4 wins over the 5 s span here
+    assert len(w) == 4
+    assert w.samples()[0] == (6.0, 6.0)
+    w2 = Window(span_s=2.0, capacity=100)
+    for t in range(10):
+        w2.push(float(t), 0.0)
+    assert all(9.0 - t <= 2.0 for t, _ in w2.samples())
+
+
+def test_rate_needs_two_samples_spanning_time():
+    assert rate([]) is None
+    assert rate([(0.0, 1.0)]) is None
+    assert rate([(1.0, 0.0), (1.0, 5.0)]) is None
+    assert rate([(0.0, 0.0), (4.0, 8.0)]) == pytest.approx(2.0)
+
+
+# ---- detectors: leader stall ----------------------------------------------
+
+
+def test_leader_stall_cold_start_never_fires():
+    # window covers less than k x timeout: no verdict even with no
+    # progress at all
+    samples = [(0.0, 5.0), (1.0, 5.0), (2.0, 5.0)]
+    assert leader_stall(samples, now=2.5, timeout_s=1.0, k=3.0) is None
+
+
+def test_leader_stall_progressing_never_fires():
+    samples = [(float(t), float(t)) for t in range(10)]
+    assert leader_stall(samples, now=9.0, timeout_s=1.0, k=3.0) is None
+
+
+def test_leader_stall_fires_past_threshold_with_boundary():
+    samples = [(0.0, 5.0), (1.0, 6.0)] + [
+        (float(t), 6.0) for t in range(2, 10)
+    ]
+    # last advance at t=1, horizon 3 s: stalled 2.9 s at now=3.9 -> no
+    assert leader_stall(samples, now=3.9, timeout_s=1.0, k=3.0) is None
+    # exactly at the boundary it fires (stalled == horizon)
+    inc = leader_stall(samples, now=4.0, timeout_s=1.0, k=3.0, node="n2")
+    assert inc is not None
+    assert inc.kind == "leader_stall"
+    assert inc.severity == "crit"
+    assert inc.node == "n2"
+    assert inc.value == pytest.approx(3.0)
+
+
+def test_leader_stall_empty_window():
+    assert leader_stall([], now=100.0, timeout_s=1.0) is None
+
+
+# ---- detectors: view-change storm -----------------------------------------
+
+
+def test_view_storm_first_rate_seeds_baseline():
+    inc, ewma = view_change_storm([(0.0, 0.0), (10.0, 5.0)], None)
+    assert inc is None
+    assert ewma == pytest.approx(0.5)
+
+
+def test_view_storm_quiet_ticks_update_ewma():
+    inc, ewma = view_change_storm(
+        [(0.0, 0.0), (10.0, 10.0)], baseline_ewma=1.0, alpha=0.3
+    )
+    assert inc is None
+    # rate 1.0 == baseline: EWMA absorbs it unchanged
+    assert ewma == pytest.approx(1.0)
+
+
+def test_view_storm_fires_and_freezes_baseline():
+    # rate 5/s vs baseline 1/s (> 4x): fires, baseline NOT updated (a
+    # storm must not normalize itself)
+    inc, ewma = view_change_storm(
+        [(0.0, 0.0), (2.0, 10.0)], baseline_ewma=1.0
+    )
+    assert inc is not None
+    assert inc.kind == "view_storm"
+    assert inc.severity == "warn"
+    assert inc.value == pytest.approx(5.0)
+    assert ewma == pytest.approx(1.0)
+
+
+def test_view_storm_min_rate_floors_trigger():
+    # 0.4/s is >4x a 0.01 baseline but under the absolute floor
+    inc, _ = view_change_storm(
+        [(0.0, 0.0), (10.0, 4.0)], baseline_ewma=0.01, min_rate=0.5
+    )
+    assert inc is None
+
+
+# ---- detectors: commit collapse -------------------------------------------
+
+
+def test_commit_collapse_needs_four_samples():
+    assert commit_collapse([(0.0, 0.0), (1.0, 0.0), (2.0, 0.0)]) is None
+
+
+def test_commit_collapse_steady_rate_never_fires():
+    samples = [(float(t), 10.0 * t) for t in range(10)]
+    assert commit_collapse(samples) is None
+
+
+def test_commit_collapse_fires_on_collapse():
+    # 10/s for the first half, flat after the midpoint
+    samples = [(float(t), 10.0 * min(t, 5)) for t in range(11)]
+    inc = commit_collapse(samples, node="n0")
+    assert inc is not None
+    assert inc.kind == "commit_collapse"
+    assert inc.severity == "crit"
+    assert inc.node == "n0"
+
+
+def test_commit_collapse_quiet_baseline_never_fires():
+    # an idle committee (0.1/s) going fully idle is not a collapse
+    samples = [(float(t * 10), min(t, 5) * 1.0) for t in range(11)]
+    assert commit_collapse(samples, min_baseline_rate=1.0) is None
+
+
+# ---- detectors: straggler --------------------------------------------------
+
+
+def test_straggler_fires_on_round_lag():
+    rounds = {
+        "n0": (10.0, 100.0),
+        "n1": (10.0, 99.0),
+        "n2": (10.0, 80.0),
+    }
+    out = straggler(rounds, {}, now=10.0, lag_rounds=16.0)
+    assert [i.node for i in out] == ["n2"]
+    assert out[0].kind == "straggler"
+    assert out[0].value == pytest.approx(20.0)
+
+
+def test_straggler_stale_samples_excluded():
+    # n2's sample is 20 s old: the STALE column's problem, not a lag
+    rounds = {
+        "n0": (10.0, 100.0),
+        "n1": (10.0, 99.0),
+        "n2": (-10.0, 10.0),
+    }
+    assert straggler(rounds, {}, now=10.0) == []
+
+
+def test_straggler_clock_offset_keeps_skewed_node_fresh():
+    # n2's clock runs 20 s behind: its sample time looks ancient but
+    # the offset correction keeps it in the fresh set
+    rounds = {
+        "n0": (10.0, 100.0),
+        "n1": (10.0, 99.0),
+        "n2": (-10.0, 10.0),
+    }
+    out = straggler(rounds, {"n2": -20.0}, now=10.0)
+    assert [i.node for i in out] == ["n2"]
+
+
+def test_straggler_needs_two_fresh_nodes():
+    assert straggler({"n0": (10.0, 100.0)}, {}, now=10.0) == []
+
+
+# ---- detectors: shed storm -------------------------------------------------
+
+
+def test_shed_storm_fires_on_rate_and_total():
+    inc = shed_storm([(0.0, 0.0), (2.0, 100.0)], node="n3")
+    assert inc is not None
+    assert inc.kind == "shed_storm"
+    assert inc.node == "n3"
+    assert inc.value == pytest.approx(50.0)
+
+
+def test_shed_storm_min_shed_suppresses_edge_burst():
+    # 8 sheds over 0.1 s is a 80/s rate but under the absolute minimum
+    inc = shed_storm([(0.0, 0.0), (0.1, 8.0)], min_shed=10)
+    assert inc is None
+    assert shed_storm([(0.0, 0.0), (10.0, 50.0)], rate_threshold=20.0) is None
+
+
+# ---- detectors: root divergence -------------------------------------------
+
+
+def test_root_divergence_agreement_is_quiet():
+    roots = {"n0": (7, "aa"), "n1": (7, "aa"), "n2": (6, "bb")}
+    assert root_divergence(roots) == []
+
+
+def test_root_divergence_fires_once_per_version():
+    roots = {
+        "n0": (7, "a" * 32),
+        "n1": (7, "b" * 32),
+        "n2": (7, "a" * 32),
+    }
+    out = root_divergence(roots)
+    assert len(out) == 1
+    inc = out[0]
+    assert inc.kind == "root_divergence"
+    assert inc.severity == "crit"
+    assert inc.node == ""  # fleet-wide
+    assert "version 7" in inc.detail
+    assert "n0,n2" in inc.detail
+    assert inc.value == pytest.approx(7.0)
+
+
+def test_root_divergence_different_versions_not_compared():
+    # a lagging node at an older version is NOT divergence
+    roots = {"n0": (7, "aa"), "n1": (6, "bb")}
+    assert root_divergence(roots) == []
+
+
+# ---- campaign recorder -----------------------------------------------------
+
+
+def test_campaign_recorder_interval_gate_and_bound(tmp_path):
+    rec = CampaignRecorder("n0", interval_s=1.0, capacity=8)
+    assert rec.sample(0.0, {"round": 1})
+    assert not rec.sample(0.5, {"round": 2})  # gate closed
+    assert rec.sample(1.0, {"round": 3})
+    assert len(rec) == 2
+    for t in range(2, 50):
+        rec.sample(float(t), {"round": t})
+    assert len(rec) == 8  # ring bound holds
+
+
+def test_campaign_recorder_persist_roundtrip(tmp_path):
+    path = str(tmp_path / f"n0{CAMPAIGN_SUFFIX}")
+    rec = CampaignRecorder("n0", path=path, interval_s=1.0)
+    rec.sample(1.0, {"round": 4, "commits": 10.0})
+    rec.sample(2.0, {"round": 5, "commits": 12.0})
+    assert rec.persist() == path
+    doc = CampaignRecorder.load(path)
+    assert doc["node"] == "n0"
+    assert doc["interval_s"] == 1.0
+    assert [s["round"] for s in doc["samples"]] == [4, 5]
+    # the journal loader must never pick the campaign up: its glob is
+    # *.jsonl and the suffix is .json
+    assert not glob.glob(str(tmp_path / "*.jsonl"))
+    assert not path.endswith(".jsonl")
+
+
+def test_campaign_recorder_no_path_is_a_noop():
+    rec = CampaignRecorder("n0")
+    rec.sample(0.0, {"round": 1})
+    assert rec.persist() is None
+
+
+# ---- health monitor --------------------------------------------------------
+
+
+class FakeJournal:
+    def __init__(self):
+        self.records = []
+
+    def record(self, event, round_=0, digest=None, peer="", dur_ns=None):
+        self.records.append((event, round_, peer))
+
+
+class FakeTel:
+    """A snapshot-bearing telemetry stand-in the monitor samples."""
+
+    def __init__(self):
+        self.journal = FakeJournal()
+        self.doc = {
+            "trace": {"commits": 0, "tc_advances": 0, "last_commit_round": 0},
+            "ingest": {"shed_total": 0, "last_credit": 64},
+            "state": {"version": 0},
+        }
+
+    def snapshot(self):
+        return json.loads(json.dumps(self.doc))
+
+
+class FakeLogger:
+    def __init__(self):
+        self.lines = []
+
+    def info(self, msg, *args):
+        self.lines.append(msg % args)
+
+    warning = info
+
+
+def test_monitor_shed_storm_open_close_hysteresis():
+    tel = FakeTel()
+    logger = FakeLogger()
+    # a huge timeout keeps leader_stall's cold-start guard shut for the
+    # whole fixture run: this test isolates the shed path
+    mon = HealthMonitor(tel, "n0", timeout_s=100.0, logger=logger)
+
+    mon.tick(0.0)
+    tel.doc["ingest"]["shed_total"] = 60  # 60/s over 1 s
+    tel.doc["trace"]["last_commit_round"] = 9
+    fired = mon.tick(1.0)
+    assert [i.kind for i in fired] == ["shed_storm"]
+    assert [i.kind for i in mon.open_incidents()] == ["shed_storm"]
+    assert tel.journal.records == [("health.shed_storm", 9, "open")]
+    assert any(
+        '"kind": "shed_storm"' in ln and '"phase": "open"' in ln
+        for ln in logger.lines
+    )
+
+    # still firing: no duplicate open edge
+    tel.doc["ingest"]["shed_total"] = 120
+    mon.tick(2.0)
+    assert len(tel.journal.records) == 1
+
+    # shed flattens: the incident survives CLEAR_AFTER-1 quiet ticks,
+    # then closes exactly once
+    for t in range(3, 3 + CLEAR_AFTER + 2):
+        mon.tick(float(t + 60))  # jump past the window so rate drops
+    assert mon.open_incidents() == []
+    assert tel.journal.records[-1] == ("health.shed_storm", 9, "close")
+    assert (
+        sum(1 for e, _, p in tel.journal.records if p == "close") == 1
+    )
+
+
+def test_monitor_leader_stall_fires_on_frozen_commits():
+    tel = FakeTel()
+    tel.doc["trace"]["commits"] = 5
+    mon = HealthMonitor(tel, "n1", timeout_s=1.0, logger=FakeLogger())
+    for t in range(4):
+        mon.tick(float(t))
+    assert "leader_stall" in {i.kind for i in mon.open_incidents()}
+
+
+def test_monitor_campaign_samples_and_close_persists(tmp_path):
+    path = str(tmp_path / f"n0{CAMPAIGN_SUFFIX}")
+    tel = FakeTel()
+    mon = HealthMonitor(
+        tel, "n0", timeout_s=100.0, campaign_path=path, logger=FakeLogger()
+    )
+    tel.doc["trace"]["commits"] = 7
+    tel.doc["state"]["version"] = 3
+    for t in range(5):
+        mon.tick(float(t))
+    assert len(mon.recorder) == 5
+    mon.close()
+    doc = CampaignRecorder.load(path)
+    assert doc["samples"][-1]["commits"] == 7.0
+    assert doc["samples"][-1]["version"] == 3
+    assert set(doc["samples"][0]) >= {
+        "t", "round", "commits", "tcs", "shed", "credit", "version",
+        "incidents",
+    }
+
+
+def test_monitor_survives_empty_snapshot():
+    class EmptyTel:
+        journal = None
+
+        def snapshot(self):
+            return {}
+
+    mon = HealthMonitor(EmptyTel(), "n0", timeout_s=1.0, logger=FakeLogger())
+    for t in range(6):
+        assert isinstance(mon.tick(float(t)), list)
+
+
+# ---- scraper side: NodeFeed / FleetWatcher / render ------------------------
+
+
+class FakeNode:
+    """An in-memory /delta server: a DeltaStream over a mutable doc."""
+
+    def __init__(self, name):
+        self.name = name
+        self.stream = DeltaStream()
+        self.sections = {
+            "trace": {"commits": 0, "last_commit_round": 0},
+            "ingest": {"last_credit": 64, "shed_total": 0},
+            "state": {"version": 0, "root": "r0", "last_round": 0},
+            "metrics": {"hotstuff_core_round": 0},
+        }
+        self.down = False
+
+    def handle(self, url, timeout_s=None):
+        if self.down:
+            raise OSError("connection refused")
+        since = int(url.rsplit("since=", 1)[1])
+        return self.stream.frame({self.name: self.sections}, since)
+
+
+def _fleet(n=2):
+    nodes = {f"n{i}": FakeNode(f"n{i}") for i in range(n)}
+
+    def opener(url, timeout_s=None):
+        host = url.split("//", 1)[1].split(":", 1)[0]
+        return nodes[host].handle(url, timeout_s)
+
+    targets = [
+        {"index": i, "name": f"n{i}", "key": i, "host": f"n{i}", "port": 1}
+        for i in range(n)
+    ]
+    order = [f"n{i}" for i in range(n)]
+    return nodes, targets, order, opener
+
+
+def test_node_feed_polls_deltas_and_resyncs_on_gap():
+    node = FakeNode("n0")
+    from benchmark.watch import NodeFeed
+
+    # one injected delta whose base the decoder does not hold (a
+    # restarted/confused server): poll must absorb it as a resync, not
+    # a wrong merge
+    bogus = {"inject": None}
+
+    def opener(url, timeout_s=None):
+        frame = bogus.pop("inject", None)
+        if frame is not None:
+            return frame
+        return node.handle(url, timeout_s)
+
+    feed = NodeFeed("n0", "http://n0:1", opener=opener)
+    state = feed.poll()
+    assert state["n0.trace.commits"] == 0
+    node.sections["trace"]["commits"] = 5
+    state = feed.poll()
+    assert state["n0.trace.commits"] == 5
+    assert feed.decoder.resyncs == 0
+
+    bogus["inject"] = {"seq": 99, "base": 98, "set": {"x": 1}, "del": []}
+    state = feed.poll()
+    assert state is not None  # the same poll re-pulled a full frame
+    assert state["n0.trace.commits"] == 5
+    assert "x" not in state
+    assert feed.decoder.resyncs == 1
+    assert not feed.stale
+
+
+def test_node_feed_goes_stale_and_recovers():
+    from benchmark.watch import STALE_AFTER, NodeFeed
+
+    node = FakeNode("n0")
+    node.down = True
+    feed = NodeFeed("n0", "http://n0:1", opener=node.handle)
+    for _ in range(STALE_AFTER):
+        assert feed.poll() is None
+    assert feed.stale
+    node.down = False
+    assert feed.poll() is not None
+    assert not feed.stale
+
+
+def test_fleet_watcher_renders_rows_and_marks_stale():
+    from benchmark.watch import FleetWatcher, render
+
+    nodes, targets, order, opener = _fleet(2)
+    nodes["n0"].sections["metrics"]["hotstuff_core_round"] = 8
+    nodes["n1"].sections["metrics"]["hotstuff_core_round"] = 8
+    watcher = FleetWatcher(targets, order, timeout_s=1.0, opener=opener)
+    try:
+        view = watcher.tick(0.0)
+        assert view["head"] == 8.0
+        assert view["leader"] == order[8 % 2]
+        text = render(view)
+        assert "NODE" in text and "ROUND" in text
+        assert "STALE" not in text
+        assert "*" in text  # leader marker
+
+        # n1 dies: three missed polls flip its status column
+        nodes["n1"].down = True
+        from benchmark.watch import STALE_AFTER
+
+        for t in range(1, STALE_AFTER + 1):
+            view = watcher.tick(float(t))
+        rows = {v["name"]: v for v in view["nodes"]}
+        assert rows["n1"]["stale"] is True
+        assert rows["n0"]["stale"] is False
+        text = render(view)
+        assert "STALE" in text
+        # the dead node still shows its last known round
+        assert rows["n1"]["round"] == 8
+    finally:
+        watcher.close()
+
+
+def test_fleet_watcher_detects_root_divergence_live():
+    from benchmark.watch import FleetWatcher
+
+    nodes, targets, order, opener = _fleet(2)
+    for n in nodes.values():
+        n.sections["state"]["version"] = 4
+    nodes["n0"].sections["state"]["root"] = "a" * 32
+    nodes["n1"].sections["state"]["root"] = "b" * 32
+    watcher = FleetWatcher(targets, order, timeout_s=1.0, opener=opener)
+    try:
+        view = watcher.tick(0.0)
+        kinds = {i.kind for i in view["incidents"]}
+        assert "root_divergence" in kinds
+        assert ("root_divergence", "") in view["open"]
+        # still diverging: the incident stays open, no duplicate record
+        watcher.tick(1.0)
+        assert len(watcher.incidents) == 1
+    finally:
+        watcher.close()
+
+
+def test_fleet_watcher_leader_stall_attribution():
+    from benchmark.watch import FleetWatcher
+
+    nodes, targets, order, opener = _fleet(2)
+    for n in nodes.values():
+        n.sections["metrics"]["hotstuff_core_round"] = 4
+        n.sections["trace"]["commits"] = 10
+    watcher = FleetWatcher(
+        targets, order, timeout_s=0.5, stall_k=3.0, opener=opener
+    )
+    try:
+        leader = order[4 % 2]
+        for t in range(5):  # commits frozen for > 1.5 s
+            view = watcher.tick(float(t))
+        kinds = {(i.kind, i.node) for i in view["incidents"]}
+        assert ("leader_stall", leader) in kinds
+    finally:
+        watcher.close()
+
+
+def test_fleet_watcher_surfaces_node_reported_alerts():
+    """A node's own HealthMonitor exposes its open incidents in the
+    snapshot's ``health`` section; the watcher must lift them into the
+    live incident feed with the detector's severity."""
+    from benchmark.watch import FleetWatcher
+
+    nodes, targets, order, opener = _fleet(2)
+    nodes["n1"].sections["health"] = {"open": ["leader_stall"]}
+    watcher = FleetWatcher(targets, order, timeout_s=1.0, opener=opener)
+    try:
+        view = watcher.tick(0.0)
+        by_kind = {(i.kind, i.node): i for i in view["incidents"]}
+        assert ("leader_stall", "n1") in by_kind
+        assert by_kind[("leader_stall", "n1")].severity == "crit"
+        assert ("leader_stall", "n1") in view["open"]
+        # the node clears it: the open set empties next tick
+        nodes["n1"].sections["health"] = {"open": []}
+        view = watcher.tick(1.0)
+        assert ("leader_stall", "n1") not in view["open"]
+    finally:
+        watcher.close()
+
+
+def test_run_watch_once_renders_and_returns_view():
+    from benchmark.watch import FleetWatcher, run_watch
+
+    nodes, targets, order, opener = _fleet(2)
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 100.0
+
+        def time(self):
+            return self.t
+
+        def sleep(self, s):
+            self.t += s
+
+    out: list = []
+    watcher = FleetWatcher(targets, order, timeout_s=1.0, opener=opener)
+    view = run_watch(
+        watcher, once=True, out=out.append, clock=FakeClock()
+    )
+    assert view["nodes"]
+    assert out and "NODE" in out[0]
+    # a single tick has no rate window yet: the column shows "-"
+    assert " - " in out[0] or "-" in out[0]
+
+
+def test_node_view_extracts_metrics_with_fallbacks():
+    from benchmark.watch import node_view
+
+    flat = flatten(
+        {
+            "n0": {
+                "trace": {
+                    "commits": 12,
+                    "edges": {"propose_to_commit": {"p50_ms": 4.5}},
+                },
+                "ingest": {"last_credit": 32, "shed_total": 2},
+                "state": {"version": 3, "root": "abc", "last_round": 9},
+                "metrics": {
+                    "hotstuff_verify_route{route=device}": 7,
+                    "hotstuff_verify_route{route=cpu}": 1,
+                },
+            }
+        }
+    )
+    v = node_view("n0", flat)
+    assert v["round"] == 9  # falls back to state.last_round
+    assert v["commits"] == 12
+    assert v["credit"] == 32
+    assert v["p50_ms"] == 4.5  # falls back to the trace edge summary
+    assert v["route"] == (7, 0, 1)
+    assert v["version"] == 3 and v["root"] == "abc"
+
+
+# ---- end to end: leader-isolation trips leader-stall (slow tier) -----------
+
+
+@pytest.mark.slow
+def test_leader_stall_fires_under_leader_isolation(tmp_path, monkeypatch):
+    """The canned ``leader-isolation`` chaos scenario with the health
+    plane on: the isolated node's commit progress freezes for longer
+    than k x timeout, so a ``leader_stall`` incident must appear in the
+    ``+ HEALTH`` SUMMARY block, in the journal as ``health.*`` edges,
+    and as the Perfetto incidents track."""
+    from benchmark.chaos import ChaosBench
+    from benchmark.traces import TraceSet, load_journals, merge_campaigns
+    from benchmark.utils import PathMaker
+
+    monkeypatch.chdir(tmp_path)
+    bench = ChaosBench(
+        scenario="leader-isolation",
+        seed=7,
+        nodes=4,
+        rate=400,
+        duration=10.0,  # extended automatically past last heal
+        timeout_delay=1_000,
+        transport="asyncio",
+        journal=True,
+        health=True,
+    )
+    parser = bench.run()
+    assert parser.has_window(), "no commits at all"
+
+    # the SUMMARY surface
+    assert parser.health_nodes == 4, "health monitors never announced"
+    text = parser.result()
+    assert "+ HEALTH" in text
+    assert "leader_stall" in text, text
+    assert "SLO burn" in text
+
+    # the journal surface: health.* edges pair into incident spans and
+    # land on the dedicated Perfetto incidents track
+    journals = load_journals(PathMaker.journals_path())
+    assert journals, "journal mode produced no journals"
+    ts = TraceSet(journals)
+    stall_spans = [s for s in ts.health_spans if s[1] == "leader_stall"]
+    assert stall_spans, f"no leader_stall spans in {ts.health_spans}"
+    doc = ts.chrome_trace()
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "incidents" in names
+    assert any(
+        e.get("cat") == "health" for e in doc["traceEvents"]
+    ), "no incident slices emitted"
+
+    # the campaign surface: the run outlives PERSIST_EVERY ticks, so
+    # every node left a bounded ring beside its journal
+    campaigns = glob.glob(
+        os.path.join(PathMaker.journals_path(), f"*{CAMPAIGN_SUFFIX}")
+    )
+    assert campaigns, "no campaign rings persisted"
+    report = merge_campaigns(
+        PathMaker.journals_path(), str(tmp_path / "campaign.json")
+    )
+    assert report is not None
+    merged = json.loads(open(report).read())
+    assert merged["nodes"]
+    for node in merged["nodes"]:
+        assert merged["coverage"][node]["samples"] > 0
+
+
+# ---- end to end: shed-storm at saturation (slow tier) ----------------------
+
+
+@pytest.mark.slow
+@async_test
+async def test_shed_storm_fires_at_saturation(tmp_path, monkeypatch):
+    """An open-loop producer past admission capacity (the exact failure
+    the credit plane exists to absorb): typed BUSY sheds climb fast and
+    the node's own HealthMonitor must raise ``shed_storm`` — while the
+    proposer buffer still never silently drops."""
+    from hotstuff_tpu.consensus import Consensus, Parameters
+    from hotstuff_tpu.consensus.wire import (
+        MAX_PRODUCER_BATCH,
+        encode_producer_batch,
+    )
+    from hotstuff_tpu.crypto import Digest, SignatureService
+    from hotstuff_tpu.network.framing import read_frame, write_frame
+    from hotstuff_tpu.store import Store
+
+    # a buffer this small saturates in well under a second at the
+    # open-loop rate below; the low watermark makes sheds typed BUSY
+    monkeypatch.setenv("HOTSTUFF_MAX_PENDING", "200")
+    monkeypatch.setenv("HOTSTUFF_INGEST_WATERMARK", "0.5")
+    telemetry.enable()
+
+    base = fresh_base_port()
+    com = committee(base)
+    nodes = []
+    for i in range(4):
+        name, secret = keys()[i]
+        store = Store(str(tmp_path / f"db_{i}"))
+        commit_q: asyncio.Queue = asyncio.Queue()
+        stack = await Consensus.spawn(
+            name,
+            com,
+            Parameters(timeout_delay=2_000, sync_retry_delay=5_000),
+            SignatureService(secret),
+            store,
+            commit_q,
+            bind_host="127.0.0.1",
+            telemetry=telemetry.for_node(f"n{i}"),
+        )
+        nodes.append((stack, commit_q, store))
+
+    async def drain(q: asyncio.Queue):
+        while True:
+            await q.get()
+
+    drains = [asyncio.ensure_future(drain(q)) for _, q, _ in nodes]
+    loop = asyncio.get_running_loop()
+    tel0 = telemetry.for_node("n0")
+    # a huge timeout keeps leader_stall quiet; this test is about sheds
+    mon = HealthMonitor(tel0, "n0", timeout_s=60.0, logger=FakeLogger())
+    sink = None
+    writer = None
+    try:
+        mon.tick(loop.time())
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", base)
+
+        async def discard():
+            while True:
+                await read_frame(reader)
+
+        sink = asyncio.ensure_future(discard())
+
+        # ~2x+ admission capacity, credits deliberately ignored: 40
+        # batches x 128 unique payloads against a 200-slot buffer
+        seq = 0
+        for _ in range(40):
+            items = []
+            for _ in range(min(128, MAX_PRODUCER_BATCH)):
+                body = seq.to_bytes(8, "big") + b"x" * 56
+                items.append((Digest.of(body), body))
+                seq += 1
+            write_frame(writer, encode_producer_batch(items))
+            await writer.drain()
+            await asyncio.sleep(0.02)
+
+        fired: list = []
+        deadline = loop.time() + 10.0
+        while loop.time() < deadline:
+            await asyncio.sleep(0.5)
+            fired.extend(mon.tick(loop.time()))
+            if any(i.kind == "shed_storm" for i in fired):
+                break
+        kinds = {i.kind for i in fired}
+        snap = tel0.snapshot()
+        assert "shed_storm" in kinds, (
+            f"no shed_storm under open-loop saturation; fired={kinds}, "
+            f"ingest={snap.get('ingest')}"
+        )
+        assert snap["ingest"]["shed_total"] >= 10
+
+        # admission control absorbed the storm: nothing silently lost
+        stack0 = nodes[0][0]
+        assert stack0.proposer.drop_newest == 0
+        assert len(stack0.proposer.pending) <= stack0.proposer.max_pending
+    finally:
+        if sink is not None:
+            sink.cancel()
+        if writer is not None:
+            writer.close()
+        for t in drains:
+            t.cancel()
+        for stack, _, _ in nodes:
+            await stack.shutdown()
+        for _, _, store in nodes:
+            store.close()

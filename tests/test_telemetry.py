@@ -103,6 +103,30 @@ def test_prometheus_golden_output():
     assert text == expected
 
 
+def test_openmetrics_golden_output():
+    """OpenMetrics 1.0 exposition: counter FAMILY names drop _total in
+    metadata while counter SAMPLES carry it (no double suffix for
+    instruments already named *_total), and the body ends in # EOF."""
+    reg = Registry()
+    reg.counter("commits", "Blocks committed", {"node": "n0"}).inc(7)
+    reg.counter("requests_total", "Requests", {"node": "n0"}).inc(2)
+    reg.gauge("depth", "Queue depth", {"node": "n0"}, fn=lambda: 4)
+    text = reg.render_openmetrics()
+    expected = (
+        "# HELP hotstuff_commits Blocks committed\n"
+        "# TYPE hotstuff_commits counter\n"
+        'hotstuff_commits_total{node="n0"} 7\n'
+        "# HELP hotstuff_requests Requests\n"
+        "# TYPE hotstuff_requests counter\n"
+        'hotstuff_requests_total{node="n0"} 2\n'
+        "# HELP hotstuff_depth Queue depth\n"
+        "# TYPE hotstuff_depth gauge\n"
+        'hotstuff_depth{node="n0"} 4\n'
+        "# EOF\n"
+    )
+    assert text == expected
+
+
 def test_gauge_callback_failure_is_sentinel():
     reg = Registry()
     g = reg.gauge("bad", fn=lambda: 1 / 0)
@@ -253,13 +277,32 @@ async def test_metrics_endpoint():
         assert server.port > 0  # ephemeral port was bound and recorded
         status, ctype, body = await _http_get(server.port, "/metrics")
         assert status == 200
-        assert ctype.startswith("text/plain; version=0.0.4")
+        assert ctype.startswith("application/openmetrics-text; version=1.0.0")
         assert 'hotstuff_requests_total{node="srv"} 3' in body
+        assert body.rstrip().endswith("# EOF")
 
         status, ctype, body = await _http_get(server.port, "/snapshot")
         assert status == 200
         assert ctype == "application/json"
         assert json.loads(body)["srv"]["node"] == "srv"
+
+        # delta stream: a full frame first, then O(changed) increments
+        status, ctype, body = await _http_get(server.port, "/delta")
+        assert status == 200
+        assert ctype == "application/json"
+        frame = json.loads(body)
+        assert "full" in frame
+        assert frame["full"]["srv.metrics.hotstuff_requests_total"] == 3
+        seq = frame["seq"]
+        _, _, body = await _http_get(server.port, f"/delta?since={seq}")
+        again = json.loads(body)
+        assert again["seq"] == seq  # nothing changed -> same frame id
+        tel.counter("requests_total", "Requests").inc()
+        _, _, body = await _http_get(server.port, f"/delta?since={seq}")
+        delta = json.loads(body)
+        assert delta.get("base") == seq
+        assert delta["set"]["srv.metrics.hotstuff_requests_total"] == 4
+        assert "srv.node" not in delta["set"]  # unchanged keys not resent
 
         status, _, _ = await _http_get(server.port, "/nope")
         assert status == 404
