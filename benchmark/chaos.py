@@ -29,11 +29,14 @@ from __future__ import annotations
 import json
 import math
 import os
+import shutil
 import signal
+import sys
 import time
 
+from hotstuff_tpu.consensus import Committee
 from hotstuff_tpu.faults.scenarios import build, last_heal
-from hotstuff_tpu.node.config import Secret, read_committee
+from hotstuff_tpu.node.config import Secret, read_committee, write_committee
 
 from .invariants import check_run
 from .local import LocalBench
@@ -42,6 +45,13 @@ from .utils import PathMaker, Print
 #: seconds between config time and scenario t=0 (covers committee +
 #: client boot on a CPU-verifier committee)
 BOOT_MARGIN_S = 8.0
+
+#: seconds between a reconfig submission and the joiner's boot: the
+#: joiner's state-sync bootstrap is ONE-SHOT, so the certified schedule
+#: links must already be committed (and in served manifests) when it
+#: collects them — by this long after submission the op's block has
+#: been 2-chain committed many times over on a local committee
+JOIN_DELAY_S = 4.0
 
 
 class ChaosBench(LocalBench):
@@ -87,11 +97,38 @@ class ChaosBench(LocalBench):
         if not math.isinf(heal):
             resume = self.spec.get("liveness", {}).get("resume_within_s", 20.0)
             self.duration = max(self.duration, heal + resume + 4.0)
+        # live-reconfiguration events: joiner node indexes (>= nodes) get
+        # fresh keys at config time, and the run must outlive the full
+        # handoff (submit -> commit -> activation -> joiner votes ->
+        # retiree grace window)
+        self._join_indexes = sorted(
+            {
+                int(j)
+                for ev in self.spec.get("reconfig", ())
+                for j in ev.get("join", ())
+            }
+        )
+        recfg_at = [
+            float(ev.get("at", 0.0)) for ev in self.spec.get("reconfig", ())
+        ]
+        if recfg_at:
+            resume = self.spec.get("liveness", {}).get("resume_within_s", 20.0)
+            self.duration = max(
+                self.duration, max(recfg_at) + JOIN_DELAY_S + resume + 8.0
+            )
         # node index -> short authority id, resolved from the key files
         # at config time (feeds violation attribution in the checker)
         self._authorities: dict[int, str] = {}
 
     # ---- config ------------------------------------------------------------
+
+    def _cleanup_files(self) -> None:
+        super()._cleanup_files()
+        # joiner indexes live past self.nodes, so the base cleanup loop
+        # never reaches their stores — a stale joiner db would make the
+        # "fresh member state-syncs in" part of the scenario a lie
+        for j in self._join_indexes:
+            shutil.rmtree(PathMaker.db_path(j), ignore_errors=True)
 
     def _config(self) -> None:
         super()._config()
@@ -115,6 +152,15 @@ class ChaosBench(LocalBench):
                 )
             nodes_map[f"{addr[0]}:{addr[1]}"] = i
             self._authorities[i] = name.encode_base64()[:8]
+        # Joiners are keyed NOW (the reconfig op must name their public
+        # keys) but booted only after the op commits; their addresses go
+        # into the map so fault rules targeting the joiner index resolve
+        # inside its fault plane too.
+        for j in self._join_indexes:
+            secret = Secret.new(self.scheme)
+            secret.write(PathMaker.key_file(j))
+            nodes_map[f"127.0.0.1:{self.base_port + j}"] = j
+            self._authorities[j] = secret.name.encode_base64()[:8]
         spec["nodes"] = nodes_map
         path = PathMaker.fault_spec_file()
         with open(path, "w") as f:
@@ -129,24 +175,43 @@ class ChaosBench(LocalBench):
             f"spec -> {path} (epoch in {BOOT_MARGIN_S:.0f}s)"
         )
 
-    # ---- crash/restart schedule --------------------------------------------
+    # ---- crash/restart + reconfiguration schedule --------------------------
 
     def _measurement_window(self, started: bool) -> None:
         assert self._epoch is not None
         deadline = time.time() + self.duration + 4
-        events: list[tuple[float, str, int]] = []
+        # (when, seq, action, payload): seq breaks wall-clock ties so the
+        # sort never has to compare payloads (reconfig payloads are dicts)
+        events: list[tuple[float, int, str, object]] = []
+        seq = 0
         for crash in self.spec.get("crashes", ()):
             node = int(crash["node"])
-            events.append((self._epoch + float(crash["at"]), "kill", node))
+            events.append(
+                (self._epoch + float(crash["at"]), seq, "kill", node)
+            )
+            seq += 1
             restart = crash.get("restart_at")
             if restart is not None:
                 events.append(
-                    (self._epoch + float(restart), "restart", node)
+                    (self._epoch + float(restart), seq, "restart", node)
                 )
-        for when, action, node in sorted(events):
+                seq += 1
+        for ev in self.spec.get("reconfig", ()):
+            at = float(ev.get("at", 0.0))
+            events.append((self._epoch + at, seq, "reconfig", ev))
+            seq += 1
+            # the joiner boots only after the op has committed: its
+            # state-sync bootstrap is one-shot, so the served manifests
+            # must already carry the certified schedule links
+            for j in ev.get("join", ()):
+                events.append(
+                    (self._epoch + at + JOIN_DELAY_S, seq, "join", int(j))
+                )
+                seq += 1
+        for when, _seq, action, payload in sorted(events):
             if when > deadline:
                 Print.warn(
-                    f"chaos: {action} of node {node} falls past the "
+                    f"chaos: {action} ({payload}) falls past the "
                     "measurement window — skipped"
                 )
                 continue
@@ -155,17 +220,89 @@ class ChaosBench(LocalBench):
                 time.sleep(delay)
             t_rel = time.time() - self._epoch
             if action == "kill":
-                proc = self._node_procs.get(node)
+                proc = self._node_procs.get(payload)
                 if proc is not None and proc.poll() is None:
                     proc.send_signal(signal.SIGKILL)  # a crash, not a stop
                     proc.wait()
-                Print.info(f"chaos: crashed node {node} (t={t_rel:.1f}s)")
-            else:
-                self._spawn_node(node, append=True)
-                Print.info(f"chaos: restarted node {node} (t={t_rel:.1f}s)")
+                Print.info(f"chaos: crashed node {payload} (t={t_rel:.1f}s)")
+            elif action == "restart":
+                self._spawn_node(payload, append=True)
+                Print.info(
+                    f"chaos: restarted node {payload} (t={t_rel:.1f}s)"
+                )
+            elif action == "reconfig":
+                self._submit_reconfig(payload)
+                Print.info(
+                    f"chaos: submitted reconfig "
+                    f"(join {list(payload.get('join', ()))}, "
+                    f"retire {list(payload.get('retire', ()))}, "
+                    f"t={t_rel:.1f}s)"
+                )
+            else:  # join
+                self._spawn_joiner(payload)
+                Print.info(
+                    f"chaos: booted joiner node {payload} (t={t_rel:.1f}s)"
+                )
         remaining = deadline - time.time()
         if remaining > 0:
             time.sleep(remaining)
+
+    def _submit_reconfig(self, event: dict) -> None:
+        """Build the next epoch's committee file (current members minus
+        retirees plus the pre-keyed joiners) and submit the sponsored op
+        through the ``reconfig`` CLI — the same path an operator uses."""
+        retire = {int(i) for i in event.get("retire", ())}
+        join = sorted({int(j) for j in event.get("join", ())})
+        members = [
+            i for i in sorted(set(range(self.nodes)) | set(join))
+            if i not in retire
+        ]
+        keys = [Secret.read(PathMaker.key_file(i)) for i in members]
+        new_committee = Committee.new(
+            [
+                (secret.name, 1, ("127.0.0.1", self.base_port + i))
+                for i, secret in zip(members, keys)
+            ],
+            scheme=self.scheme,
+            pops={s.name: s.pop for s in keys if s.pop is not None},
+        )
+        path = os.path.join(PathMaker.base_path(), ".committee-next.json")
+        write_committee(new_committee, path)
+        sponsor = int(event.get("sponsor", 0))
+        cmd = [
+            sys.executable,
+            "-m",
+            "hotstuff_tpu.node",
+            "-vv",
+            "reconfig",
+            "--keys",
+            PathMaker.key_file(sponsor),
+            "--committee",
+            PathMaker.committee_file(),
+            "--new-committee",
+            path,
+            "--margin",
+            str(int(event.get("margin", 8))),
+        ]
+        # the log name must dodge both harness globs: node-*.log feeds
+        # the invariant checkers, client*.log the throughput parser
+        self._spawn(
+            cmd, os.path.join(PathMaker.logs_path(), "reconfig-cli.log")
+        )
+
+    def _spawn_joiner(self, j: int) -> None:
+        """Boot joiner ``j`` with a fresh store.  Its key is not in the
+        genesis committee file, so the node comes up in join mode:
+        ``HOTSTUFF_RECONFIG_LISTEN`` supplies the listen address the
+        schedule will later confirm, and the one-shot state-sync
+        bootstrap pulls the certified schedule links + snapshot."""
+        self.extra_env["HOTSTUFF_RECONFIG_LISTEN"] = (
+            f"127.0.0.1:{self.base_port + j}"
+        )
+        try:
+            self._spawn_node(j)
+        finally:
+            self.extra_env.pop("HOTSTUFF_RECONFIG_LISTEN", None)
 
     # ---- verdict -----------------------------------------------------------
 

@@ -29,7 +29,7 @@ import math
 import os
 import re
 
-from .logs import RE_COMMITTED, RE_STATE_ROOT, _ts
+from .logs import RE_COMMITTED, RE_EPOCH, RE_STATE_ROOT, _ts
 
 # commit observation: (wall-clock seconds, round, block digest)
 Commit = tuple[float, int, str]
@@ -40,8 +40,11 @@ StateRoot = tuple[int, str, int]
 # Adversary-plane activity lines (core/proposer/adversary log contract,
 # mirroring the RE_COMMITTED approach: the node's log IS its history).
 RE_BYZ_ATTACK = re.compile(
-    r"byz (equivocate|forge-qc|withhold|double-vote|flood|shadow-commit)"
+    r"byz (equivocate|forge-qc|withhold|double-vote|flood|shadow-commit"
+    r"|reconfig-forge|reconfig-shadow)"
 )
+# The epoch-activation observation regex (``Epoch <e> activated at
+# round <r>``) is shared with the SUMMARY parser: see logs.RE_EPOCH.
 # Honest-side defense lines: rejected certificates / evicted signatures
 # (core._handle_timeout, aggregator.QCMaker) and equivocation evidence
 # (a second paid digest cell — aggregator._admit_cell).
@@ -123,6 +126,187 @@ def check_state_root_agreement(
     if not observed:
         return None, [], details
     return (not violations), violations, details
+
+
+def epochs_from_logs(logs_dir: str) -> dict[str, list[tuple[int, int]]]:
+    """Per-node epoch-activation observations from a logs directory:
+    one ``(epoch, activation_round)`` per logged boundary crossing.
+    Nodes that boot (or state-sync) straight into an epoch never log a
+    crossing for it — agreement is checked over the nodes that DID."""
+    out: dict[str, list[tuple[int, int]]] = {}
+    for path in sorted(glob.glob(os.path.join(logs_dir, "node-*.log"))):
+        name = os.path.basename(path)[: -len(".log")]
+        with open(path) as f:
+            content = f.read()
+        out[name] = [
+            (int(epoch), int(rnd))
+            for _ts_, epoch, rnd in RE_EPOCH.findall(content)
+        ]
+    return out
+
+
+def check_epoch_agreement(
+    epochs_by_node: dict[str, list[tuple[int, int]]],
+) -> tuple[bool | None, list[str], dict]:
+    """Every node that activates a given epoch must activate it at the
+    SAME round — the activation point is ``commit_round + margin`` of a
+    2-chain-committed reconfiguration, so divergence means a node
+    applied (or *reported*, under byz reconfig-shadow) a different epoch
+    history.  Re-activating the same epoch across restarts is fine, but
+    only at the same round.  Returns (ok, violations, details); ok is
+    ``None`` when no node logged any activation (static-committee run).
+    """
+    violations: list[str] = []
+    chosen: dict[int, tuple[int, str]] = {}  # epoch -> (round, first node)
+    observed = 0
+    for node in sorted(epochs_by_node):
+        seen_here: dict[int, int] = {}
+        for epoch, rnd in epochs_by_node[node]:
+            observed += 1
+            prev = seen_here.get(epoch)
+            if prev is not None and prev != rnd:
+                violations.append(
+                    f"{node} activated epoch {epoch} at two rounds: "
+                    f"{prev} vs {rnd}"
+                )
+            seen_here[epoch] = rnd
+            got = chosen.get(epoch)
+            if got is None:
+                chosen[epoch] = (rnd, node)
+            elif got[0] != rnd:
+                violations.append(
+                    f"epoch-activation divergence at epoch {epoch}: "
+                    f"{got[1]} -> round {got[0]}, {node} -> round {rnd}"
+                )
+    details = {
+        "epochs_activated": len(chosen),
+        "max_epoch": max(chosen) if chosen else 0,
+        "nodes_reporting": sum(1 for e in epochs_by_node.values() if e),
+    }
+    if not observed:
+        return None, [], details
+    return (not violations), violations, details
+
+
+def check_handoff_gap(
+    commits_by_node: dict[str, list[Commit]],
+    epochs_by_node: dict[str, list[tuple[int, int]]],
+    bound: int,
+    untrusted: set[str] | frozenset[str] = frozenset(),
+) -> tuple[bool | None, list[str], dict]:
+    """Commits must never stall more than ``bound`` rounds across an
+    epoch boundary: for each activation round A (the MODAL value per
+    epoch, so a byz shadow reporter cannot move the boundary), the gap
+    between the last committed round before A and the first at/after A
+    is at most ``bound`` — and a boundary with no commit beyond it at
+    all is a stalled handoff.  ``untrusted`` nodes' observations are
+    ignored.  Returns (ok, violations, details); ok is ``None`` without
+    any observed boundary."""
+    from collections import Counter
+
+    activations: dict[int, Counter] = {}
+    for node, obs in epochs_by_node.items():
+        if node in untrusted:
+            continue
+        for epoch, rnd in obs:
+            activations.setdefault(epoch, Counter())[rnd] += 1
+    if not activations:
+        return None, [], {}
+    rounds = sorted(
+        {
+            rnd
+            for node, commits in commits_by_node.items()
+            if node not in untrusted
+            for (_t, rnd, _d) in commits
+        }
+    )
+    violations: list[str] = []
+    boundaries: list[tuple[int, int, int | None]] = []
+    for epoch in sorted(activations):
+        boundary = activations[epoch].most_common(1)[0][0]
+        before = [r for r in rounds if r < boundary]
+        after = [r for r in rounds if r >= boundary]
+        if not after:
+            boundaries.append((epoch, boundary, None))
+            violations.append(
+                f"no commit at or after epoch {epoch}'s activation "
+                f"round {boundary} — the handoff stalled"
+            )
+            continue
+        # a boundary inside the pre-genesis gap (no commit before it)
+        # measures from round 0: the committee had never committed yet
+        gap = after[0] - (before[-1] if before else 0)
+        boundaries.append((epoch, boundary, gap))
+        if gap > bound:
+            violations.append(
+                f"commit gap {gap} across epoch {epoch}'s boundary "
+                f"(round {boundary}) exceeds the handoff bound {bound}"
+            )
+    details = {
+        "boundaries": boundaries,
+        "max_gap": max(
+            (g for _e, _b, g in boundaries if g is not None), default=None
+        ),
+        "bound": bound,
+    }
+    return (not violations), violations, details
+
+
+def reconfig_render(
+    epoch_ok: bool | None,
+    epoch_viol: list[str],
+    epoch_details: dict,
+    hand_ok: bool | None,
+    hand_viol: list[str],
+    hand_details: dict,
+    trusted_epoch: tuple[bool | None, list[str]] | None = None,
+) -> str:
+    """Render the ``+ RECONFIG`` SUMMARY section: the epoch-agreement
+    verdict, the measured handoff gaps per boundary, and (under
+    ``quorum_mode: trusted-subset``) the agreement verdict once the
+    adversarial epoch histories are discarded."""
+    lines = [" + RECONFIG:\n"]
+    if epoch_ok is None:
+        lines.append(" Epoch agreement: n/a (no epoch activations logged)\n")
+    else:
+        ed = epoch_details
+        lines.append(
+            f" Epoch agreement: {'PASS' if epoch_ok else 'FAIL'}"
+            f" ({ed.get('epochs_activated', 0)} epoch boundaries,"
+            f" {ed.get('nodes_reporting', 0)} nodes,"
+            f" max epoch {ed.get('max_epoch', 0)})\n"
+        )
+        shown = epoch_viol[:8]
+        for v in shown:
+            lines.append(f"   ! {v}\n")
+        if len(epoch_viol) > len(shown):
+            lines.append(
+                f"   ! ... and {len(epoch_viol) - len(shown)} more "
+                "epoch-agreement violations\n"
+            )
+    if hand_ok is not None:
+        gaps = ", ".join(
+            f"epoch {e} @ round {b}: gap {'stalled' if g is None else g}"
+            for e, b, g in hand_details.get("boundaries", ())
+        )
+        lines.append(
+            f" Handoff gap (bound {hand_details.get('bound')}): "
+            f"{'PASS' if hand_ok else 'FAIL'}"
+            + (f" ({gaps})" if gaps else "")
+            + "\n"
+        )
+        for v in hand_viol:
+            lines.append(f"   ! {v}\n")
+    if trusted_epoch is not None:
+        t_ok, t_viol = trusted_epoch
+        verdict = "n/a" if t_ok is None else ("PASS" if t_ok else "FAIL")
+        lines.append(
+            f" Trusted-subset epoch agreement (adversaries excluded): "
+            f"{verdict}\n"
+        )
+        for v in t_viol[:8]:
+            lines.append(f"   ! {v}\n")
+    return "".join(lines)
 
 
 def byz_activity_from_logs(logs_dir: str) -> dict[str, dict[str, int]]:
@@ -497,6 +681,36 @@ def check_run(
         )
         all_ok = safety_ok and live_ok
     all_ok = all_ok and state_ok is not False
+    # live-reconfiguration invariants: every node that crossed an epoch
+    # boundary crossed it at the same round, and commits never stalled
+    # more than the declared handoff gap across any boundary
+    epochs = epochs_from_logs(logs_dir)
+    epoch_ok, epoch_viol, epoch_details = check_epoch_agreement(epochs)
+    if adversaries:
+        epoch_viol = attribute_violations(epoch_viol, adversaries)
+    hand_bound = spec.get("handoff_gap_rounds")
+    hand_ok: bool | None = None
+    hand_viol: list[str] = []
+    hand_details: dict = {}
+    if hand_bound is not None:
+        # boundaries are measured over honest observations only — a byz
+        # shadow reporter must not be able to move the measured boundary
+        hand_ok, hand_viol, hand_details = check_handoff_gap(
+            commits, epochs, int(hand_bound), untrusted=set(adversaries)
+        )
+    trusted_epoch = None
+    if adversaries and spec.get("quorum_mode") == "trusted-subset":
+        te_ok, te_viol, _te_details = check_epoch_agreement(
+            {n: e for n, e in epochs.items() if n not in adversaries}
+        )
+        trusted_epoch = (te_ok, te_viol)
+    if spec.get("reconfig") or epoch_ok is not None:
+        block += reconfig_render(
+            epoch_ok, epoch_viol, epoch_details,
+            hand_ok, hand_viol, hand_details,
+            trusted_epoch=trusted_epoch,
+        )
+    all_ok = all_ok and epoch_ok is not False and hand_ok is not False
     if adversaries:
         trusted_result = None
         trusted_state_result = None
@@ -526,11 +740,15 @@ __all__ = [
     "byz_activity_from_logs",
     "byz_block",
     "chaos_block",
+    "check_epoch_agreement",
+    "check_handoff_gap",
     "check_liveness",
     "check_run",
     "check_safety",
     "check_state_root_agreement",
     "commits_from_logs",
+    "epochs_from_logs",
+    "reconfig_render",
     "state_roots_from_logs",
     "trusted_subset_recheck",
 ]

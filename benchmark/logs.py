@@ -49,6 +49,11 @@ RE_COMMITTED = re.compile(_TS + r".*Committed block (\d+) -> (\S+)")
 RE_STATE_ROOT = re.compile(
     _TS + r".*State root (\d+) -> (\S+) \(round (\d+)\)"
 )
+# live-reconfiguration boundary crossing (core contract:
+# ``Epoch <epoch> activated at round <round>``) — feeds the SUMMARY's
+# epoch-transition lines here and the epoch-agreement invariant
+# (benchmark/invariants.py)
+RE_EPOCH = re.compile(_TS + r".*Epoch (\d+) activated at round (\d+)")
 RE_TIMEOUT = re.compile(_TS + r".*Timeout reached for round (\d+)")
 RE_TIMEOUT_DELAY = re.compile(r"Timeout delay set to (\d+) ms")
 RE_CLIENT_RATE = re.compile(_TS + r".*Transactions rate: (\d+) tx/s")
@@ -97,6 +102,9 @@ class LogParser:
         self.block_round: dict[str, int] = {}
         self.timeouts = 0
         self.timeout_delay: int | None = None
+        # live-reconfiguration boundary crossings: epoch -> the set of
+        # activation rounds nodes reported (honest runs report ONE)
+        self.epoch_activations: dict[int, set[int]] = {}
 
         for content in node_logs:
             for ts, rnd, payloads, block in RE_CREATED.findall(content):
@@ -114,6 +122,10 @@ class LogParser:
                     self.commits[block] = t
                 self.block_round.setdefault(block, int(rnd))
             self.timeouts += len(RE_TIMEOUT.findall(content))
+            for _ts_, epoch, rnd in RE_EPOCH.findall(content):
+                self.epoch_activations.setdefault(int(epoch), set()).add(
+                    int(rnd)
+                )
             m = RE_TIMEOUT_DELAY.search(content)
             if m:
                 self.timeout_delay = int(m.group(1))
@@ -346,6 +358,26 @@ class LogParser:
         gaps = [b - a for a, b in zip(rounds, rounds[1:])]
         return mean(gaps), max(gaps)
 
+    def epoch_boundary_gap(self) -> int | None:
+        """Max commit-round gap across any observed epoch boundary: for
+        each activation round A, first committed round >= A minus last
+        committed round < A.  None without an observed boundary (or any
+        straddling commits) — the handoff-bound proof line for
+        reconfiguration runs."""
+        if not self.epoch_activations:
+            return None
+        rounds = sorted(
+            {self.block_round[b] for b in self.commits if b in self.block_round}
+        )
+        gaps = []
+        for acts in self.epoch_activations.values():
+            for boundary in acts:
+                before = [r for r in rounds if r < boundary]
+                after = [r for r in rounds if r >= boundary]
+                if before and after:
+                    gaps.append(after[0] - before[-1])
+        return max(gaps) if gaps else None
+
     def result(
         self,
         faults: int = 0,
@@ -412,6 +444,7 @@ class LogParser:
             + f" Committed blocks: {len(self.commits)}\n"
             f" View-change timeouts: {self.timeouts}\n"
             + self._round_gap_txt()
+            + self._epoch_txt()
             + f" Client rate warnings: {self.rate_warnings}\n"
             + self._verify_stats_txt()
             + self._telemetry_breakdown_txt()
@@ -429,6 +462,27 @@ class LogParser:
             f" Commit round gap: mean {gap_mean:.2f}, max {gap_max}"
             " (1.00 = no rounds lost)\n"
         )
+
+    def _epoch_txt(self) -> str:
+        """Epoch-transition lines (only for runs that crossed a live
+        reconfiguration boundary): which epochs activated where, and the
+        worst commit-round gap across any boundary — the handoff cost
+        the reconfig chaos scenarios bound."""
+        if not self.epoch_activations:
+            return ""
+        transitions = ", ".join(
+            f"epoch {e} at round"
+            f" {'/'.join(str(r) for r in sorted(rounds))}"
+            for e, rounds in sorted(self.epoch_activations.items())
+        )
+        out = (
+            f" Epoch transitions: {len(self.epoch_activations)}"
+            f" ({transitions})\n"
+        )
+        gap = self.epoch_boundary_gap()
+        if gap is not None:
+            out += f" Max commit gap across a boundary: {gap} round(s)\n"
+        return out
 
     def _verify_stats_txt(self) -> str:
         """Routing-split lines (only for runs with async verify services

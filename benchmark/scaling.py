@@ -142,6 +142,14 @@ def run_scaling(
                         (s.get("ingest") or {}).get("drop_newest", 0)
                         for s in stats
                     ),
+                    # live-reconfiguration column (ISSUE 14): the newest
+                    # epoch the committee activated during the window
+                    # (1 = static committee, the sweep's normal state)
+                    "epoch": (
+                        max(parser.epoch_activations)
+                        if parser.epoch_activations
+                        else 1
+                    ),
                 }
             )
     finally:
@@ -157,7 +165,7 @@ def format_report(
         "COMMITTEE-SCALING DECOMPOSITION (in-process, one core, "
         f"{rate}/s input, {duration:.0f}s, verifier={verifier})",
         "",
-        f"{'nodes':>6} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
+        f"{'nodes':>6} {'epoch':>5} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
         f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p/m':>13} "
         f"{'qc B':>6} {'agg':>5} {'shed':>6} {'dropN':>5} "
         f"{'pred 1-core/node':>17}",
@@ -189,7 +197,8 @@ def format_report(
         drops = r.get("ingest_drops", 0)
         drops_txt = f"{drops}" if drops else "-"
         lines.append(
-            f"{r['nodes']:>6} {r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
+            f"{r['nodes']:>6} {r.get('epoch', 1):>5} "
+            f"{r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
             f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>13} "
             f"{qc_txt:>6} {agg_txt:>5} {shed_txt:>6} {drops_txt:>5} "
@@ -208,6 +217,8 @@ def format_report(
     lines += [
         "- tps/lat: the starved single-core measurement (NOT protocol "
         "capability beyond ~8 nodes);",
+        "- epoch: the newest committee epoch activated in the window "
+        "(1 = no live reconfiguration, the sweep's normal state);",
         "- lag ms: mean event-loop scheduling lag — starvation onset is "
         "visible as lag >> 1 ms;",
         "- c us: measured per-(node, payload) protocol cost = "

@@ -47,6 +47,7 @@ from hotstuff_tpu.telemetry.taxonomy import (
     FAULT_PREFIX,
     HEALTH_PREFIX,
     INGEST_PREFIX,
+    RECONFIG_PREFIX,
     SPAN_ANNOTATION_STAGES,
 )
 
@@ -250,6 +251,10 @@ class TraceSet:
         # "shed" carries the shed payload count in the value, "credit"
         # the granted credit window (sampled every 64th decision).
         self.ingest_events: list[tuple[int, str, str, int]] = []
+        # reconfiguration-plane records (ISSUE 14): (w_corr, node, step,
+        # round) per journaled epoch-change step (submit/commit/
+        # activate/retire/link)
+        self.reconfig_events: list[tuple[int, str, str, int]] = []
         # health-plane incident windows (ISSUE 13): (node, kind,
         # w_open_corr, w_close_corr|None).  Each node's in-process
         # monitor journals open/close per detector, phase in the peer
@@ -336,6 +341,18 @@ class TraceSet:
                         )
                     )
                     continue
+                if e.startswith(RECONFIG_PREFIX):
+                    # reconfiguration-plane records must never reach
+                    # _block either ("d" is None)
+                    self.reconfig_events.append(
+                        (
+                            self._corr(node, r["w"]),
+                            node,
+                            e[len(RECONFIG_PREFIX):],
+                            int(r.get("r", 0) or 0),
+                        )
+                    )
+                    continue
                 if e in CONTROL_EDGES:
                     continue
                 if e == "recv.producer":
@@ -414,6 +431,7 @@ class TraceSet:
         self.byz_spans.sort(key=lambda s: s[2])
         self.byz_events.sort()
         self.ingest_events.sort()
+        self.reconfig_events.sort()
         # health incidents pair per (node, detector kind) — each node's
         # monitor journals only its own firings
         health_open: dict[tuple[str, str], int] = {}
@@ -601,6 +619,18 @@ class TraceSet:
                 )
                 + "\n"
             )
+        if self.reconfig_events:
+            steps = Counter(s for _w, _n, s, _r in self.reconfig_events)
+            shown = ", ".join(
+                f"{step} x{c}" if c > 1 else step
+                for step, c in sorted(steps.items())
+            )
+            nodes = sorted({n for _w, n, _s, _r in self.reconfig_events})
+            lines.append(
+                f" Reconfiguration plane journaled:"
+                f" {len(self.reconfig_events)} edge(s) on"
+                f" {', '.join(nodes)} ({shown})\n"
+            )
         if self.health_spans:
             kinds = Counter(k for _n, k, _o, _c in self.health_spans)
             shown = ", ".join(
@@ -666,6 +696,7 @@ class TraceSet:
         anchors.extend(w for _, _, _, w in self.byz_spans if w is not None)
         anchors.extend(w for w, _, _, _ in self.byz_events)
         anchors.extend(w for w, _, _, _ in self.ingest_events)
+        anchors.extend(w for w, _, _, _ in self.reconfig_events)
         anchors.extend(w for _, _, w, _ in self.health_spans)
         anchors.extend(w for _, _, _, w in self.health_spans if w is not None)
         for rows in self.verify_spans.values():
@@ -950,6 +981,48 @@ class TraceSet:
                             "node": node,
                             "closed": w_close is not None,
                         },
+                    }
+                )
+        if self.reconfig_events:
+            # dedicated reconfiguration track (one pid past the
+            # incidents plane): per-node lanes with one instant marker
+            # per journaled epoch-change step, so submit -> commit ->
+            # activate -> retire reads directly against the rounds the
+            # handoff spans
+            reconfig_pid = len(self.nodes) + 4
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": reconfig_pid,
+                    "tid": 0,
+                    "args": {"name": "reconfiguration"},
+                }
+            )
+            lanes = sorted({n for _w, n, _s, _r in self.reconfig_events})
+            tid_of = {n: i for i, n in enumerate(lanes)}
+            for n, tid in tid_of.items():
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": reconfig_pid,
+                        "tid": tid,
+                        "args": {"name": f"reconfig {n}"},
+                    }
+                )
+            for w, node, step, rnd in self.reconfig_events:
+                events.append(
+                    {
+                        "name": f"reconfig {step}"
+                        + (f" r{rnd}" if rnd else ""),
+                        "cat": "reconfig",
+                        "ph": "i",
+                        "s": "t",
+                        "pid": reconfig_pid,
+                        "tid": tid_of[node],
+                        "ts": us(w),
+                        "args": {"step": step, "round": rnd, "node": node},
                     }
                 )
         for node, rows in sorted(self.verify_spans.items()):

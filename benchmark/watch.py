@@ -39,6 +39,7 @@ from hotstuff_tpu.telemetry.health import (
     DeltaDecoder,
     Incident,
     Window,
+    epoch_skew,
     leader_stall,
     root_divergence,
     straggler,
@@ -58,6 +59,7 @@ _COLUMNS = (
     ("NODE", 8),
     ("ST", 5),
     ("ROUND", 7),
+    ("EPOCH", 5),
     ("CMT/S", 7),
     ("LAG", 5),
     ("LDR", 3),
@@ -157,6 +159,7 @@ def node_view(name: str, flat: dict) -> dict:
     return {
         "name": name,
         "round": g("metrics.hotstuff_core_round") or g("state.last_round", 0),
+        "epoch": g("metrics.hotstuff_core_epoch", 0),
         "commits": g("trace.commits", 0),
         "credit": g("ingest.last_credit", 0),
         "shed": g("ingest.shed_total", 0),
@@ -226,6 +229,7 @@ class FleetWatcher:
         views = []
         rounds_by_node: dict = {}
         roots_by_node: dict = {}
+        epochs_by_node: dict = {}
         for feed, flat in states:
             if flat is None:
                 prev = self._last_sample.get(feed.name)
@@ -243,6 +247,8 @@ class FleetWatcher:
                     int(view["version"] or 0),
                     str(view["root"]),
                 )
+            if view.get("epoch"):
+                epochs_by_node[feed.name] = int(view["epoch"])
             views.append(view)
 
         head = max(
@@ -254,7 +260,8 @@ class FleetWatcher:
             else ""
         )
         fired = self._detect(
-            now, leader, rounds_by_node, roots_by_node, views
+            now, leader, rounds_by_node, roots_by_node, views,
+            epochs_by_node,
         )
         self._record(now, fired)
         p50s = [
@@ -277,10 +284,12 @@ class FleetWatcher:
         "leader_stall": "crit",
         "commit_collapse": "crit",
         "root_divergence": "crit",
+        "epoch_skew": "crit",
     }
 
     def _detect(
-        self, now, leader, rounds_by_node, roots_by_node, views
+        self, now, leader, rounds_by_node, roots_by_node, views,
+        epochs_by_node=None,
     ) -> list:
         fired = []
         # incidents the nodes' own monitors hold open (scraped from the
@@ -312,6 +321,10 @@ class FleetWatcher:
             straggler(rounds_by_node, self.offsets, now)
         )
         fired.extend(root_divergence(roots_by_node))
+        # live-reconfiguration agreement (ISSUE 14): every node's active
+        # epoch gauge should match once a boundary has passed — a node
+        # stuck behind missed a certified schedule splice
+        fired.extend(epoch_skew(epochs_by_node or {}))
         return fired
 
     def _record(self, now, fired) -> None:
@@ -337,6 +350,7 @@ def render(view: dict) -> str:
             v.get("name", "?"),
             "STALE" if stale else "ok",
             f"{round_:.0f}",
+            str(int(v.get("epoch") or 0) or "-"),
             _fmt_rate(v),
             f"{lag:.0f}",
             "*" if v.get("name") == view["leader"] else "",

@@ -160,6 +160,11 @@ class Committee:
     authorities: dict[PublicKey, Authority] = field(default_factory=dict)
     epoch: int = 1
     scheme: str = "ed25519"
+    #: membership-change counter (CommitteeSchedule interface): a bare
+    #: Committee never mutates, so this is the constant 0 — consumers
+    #: that cache derived views (wire-scheme narrowing, peer sets) key
+    #: their cache on it and revalidate when it moves.
+    generation: int = 0
 
     @classmethod
     def new(
@@ -330,8 +335,38 @@ class CommitteeSchedule:
         if len(set(froms)) != len(froms):
             raise InvalidCommittee("duplicate from_round in schedule")
         self.entries: list[tuple[int, Committee]] = entries
+        #: bumped on every successful ``splice`` — consumers caching
+        #: schedule-derived views (wire-scheme narrowing, peer sets)
+        #: key their cache on it
+        self.generation: int = 0
 
     # ---- the epoch seam ----------------------------------------------------
+
+    def splice(self, from_round: int, committee: Committee) -> bool:
+        """Append a committed epoch change: rounds >= ``from_round`` run
+        under ``committee``.  The ONE mutation a schedule supports — the
+        commit path applies it atomically (a single list append; every
+        actor shares this object, so leader election, stake checks and
+        certificate routing all roll forward together while older
+        entries keep verifying boundary certificates).
+
+        Returns False for an exact replay (same activation round and
+        epoch — crash-recovery re-applies committed reconfig ops
+        idempotently); raises ``InvalidCommittee`` for a genuinely
+        conflicting splice (non-monotonic activation or epoch)."""
+        last_from, last_com = self.entries[-1]
+        for f, c in self.entries:
+            if f == from_round and c.epoch == committee.epoch:
+                return False  # idempotent re-apply
+        if from_round <= last_from or committee.epoch <= last_com.epoch:
+            raise InvalidCommittee(
+                f"splice (round {from_round}, epoch {committee.epoch}) "
+                f"does not extend the schedule (newest: round "
+                f"{last_from}, epoch {last_com.epoch})"
+            )
+        self.entries.append((from_round, committee))
+        self.generation += 1
+        return True
 
     def for_round(self, round_: int) -> Committee:
         current = self.entries[0][1]
@@ -378,6 +413,25 @@ class CommitteeSchedule:
             if name in committee.authorities:
                 return committee.stake(name)
         return 0
+
+    # Round-less threshold/size views (duck-type compatibility with a
+    # bare Committee): delegated to the NEWEST epoch.  Protocol call
+    # sites must use ``for_round(r)`` — these exist for diagnostics and
+    # boot-time sizing only.
+    def size(self) -> int:
+        return self.entries[-1][1].size()
+
+    def total_votes(self) -> int:
+        return self.entries[-1][1].total_votes()
+
+    def quorum_threshold(self) -> int:
+        return self.entries[-1][1].quorum_threshold()
+
+    def validity_threshold(self) -> int:
+        return self.entries[-1][1].validity_threshold()
+
+    def sorted_keys(self) -> list[PublicKey]:
+        return self.entries[-1][1].sorted_keys()
 
     @property
     def authorities(self) -> dict[PublicKey, Authority]:

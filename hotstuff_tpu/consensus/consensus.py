@@ -38,6 +38,7 @@ from .wire import (
     TAG_PRODUCER,
     TAG_PRODUCER_V2,
     TAG_PROPOSE,
+    TAG_RECONFIG,
     TAG_STATE_CHUNK,
     TAG_STATE_MANIFEST,
     TAG_STATE_READ,
@@ -133,7 +134,7 @@ class ConsensusReceiverHandler:
     TAG_NAMES = (
         "propose", "vote", "timeout", "tc", "sync_request", "producer",
         "producer_v2", "state_request", "state_manifest", "state_chunk",
-        "state_read",
+        "state_read", "reconfig",
     )
 
     def __init__(
@@ -148,10 +149,21 @@ class ConsensusReceiverHandler:
         tx_state_requests: asyncio.Queue | None = None,
         tx_state_sync: asyncio.Queue | None = None,
         state=None,
+        committee=None,
     ):
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
         self.tx_producer = tx_producer
+        # Epoch schedule (docs/RECONFIG.md): a committed reconfiguration
+        # can widen the set of signature schemes on the wire, so the
+        # decode-time scheme narrowing is re-derived whenever the
+        # schedule's splice generation moves.
+        self._committee = committee
+        self._scheme_gen = (
+            getattr(committee, "generation", None)
+            if committee is not None
+            else None
+        )
         # State-sync plumbing (consensus/statesync.py): peer snapshot
         # requests go to the server actor; manifest/chunk replies go to
         # the boot-time sync client.  ``state`` is the node's
@@ -194,6 +206,12 @@ class ConsensusReceiverHandler:
             )
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
+        com = self._committee
+        if com is not None:
+            gen = getattr(com, "generation", None)
+            if gen != self._scheme_gen:
+                self._scheme_gen = gen
+                self.scheme = com.wire_scheme()
         try:
             tag, payload = decode_message(message, scheme=self.scheme)
         except SerializationError as e:
@@ -243,6 +261,13 @@ class ConsensusReceiverHandler:
                     payload.from_round,
                     None,
                     str(payload.origin)[:8],
+                )
+            elif tag == TAG_RECONFIG:
+                j.record(
+                    "recv.reconfig",
+                    0,
+                    None,
+                    str(payload.sponsor)[:8],
                 )
         if tag == TAG_SYNC_REQUEST:
             await self.tx_helper.put(payload)
@@ -500,9 +525,31 @@ class Consensus:
         tx_helper: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         self.tx_producer = tx_producer
 
+        import os
+
         address = committee.address(name)
+        joining = False
         if address is None:
-            raise ValueError("Our public key is not in the committee")
+            # Join mode (docs/RECONFIG.md): a node whose key is not yet
+            # in any scheduled committee may boot against a peer's
+            # committee file, state-sync the certified schedule in, and
+            # start voting once a committed reconfiguration admits it.
+            listen = os.environ.get("HOTSTUFF_RECONFIG_LISTEN")
+            if not listen:
+                raise ValueError(
+                    "Our public key is not in the committee (set "
+                    "HOTSTUFF_RECONFIG_LISTEN=host:port to join via a "
+                    "certified reconfiguration)"
+                )
+            host, _, port = listen.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+            joining = True
+            log.info(
+                "Join mode: key not in the committee yet; listening on "
+                "%s:%d and awaiting a certified schedule",
+                address[0],
+                address[1],
+            )
         # Bind on all interfaces, listen on our committee port
         # (consensus.rs:61-73 rewrites the IP to 0.0.0.0).
         # transport="native": the C++ epoll reactor (network/native.py)
@@ -511,8 +558,6 @@ class Consensus:
         # propagation delay on every node->node sender — the committee
         # experiences the reference's 5-region topology on localhost.
         # asyncio transport only (the native reactor does its own I/O).
-        import os
-
         link_delay = None
         wan_spec = os.environ.get("HOTSTUFF_WAN_SPEC")
         if wan_spec and transport != "native":
@@ -606,6 +651,7 @@ class Consensus:
                 tx_state_requests=tx_state_requests,
                 tx_state_sync=tx_state_sync,
                 state=state_machine,
+                committee=committee,
             ),
             fault_plane=fault_plane,
         )
@@ -672,6 +718,8 @@ class Consensus:
                     ("byz_double_votes", "Conflicting votes cast"),
                     ("byz_floods", "Garbage bursts sent"),
                     ("byz_shadow_commits", "Shadow-branch commits logged"),
+                    ("byz_forged_reconfigs", "Forged reconfig ops proposed"),
+                    ("byz_shadow_epochs", "Skewed epoch activations logged"),
                 ):
                     telemetry.gauge(
                         count_name,
@@ -748,11 +796,12 @@ class Consensus:
             high_qc=lambda c=self.core: c.high_qc,
             network=make_sender(),
             telemetry=telemetry,
+            store=store,
         )
         sync_mode = os.environ.get("HOTSTUFF_STATE_SYNC", "auto").lower()
         if sync_mode not in ("0", "off", "never"):
             recovering = (await store.read(CONSENSUS_STATE_KEY)) is not None
-            if (recovering or sync_mode == "always") and (
+            if (recovering or joining or sync_mode == "always") and (
                 committee.broadcast_addresses(name)
             ):
                 self.core.state_sync = StateSyncClient(
@@ -762,7 +811,13 @@ class Consensus:
                     verifier,
                     rx_replies=tx_state_sync,
                     network=make_sender(),
+                    # a joiner adopts whatever certified snapshot is on
+                    # offer — its alternative is walking history it may
+                    # not be able to fetch at all
+                    min_lag=0 if joining else None,
                     telemetry=telemetry,
+                    store=store,
+                    synchronizer=self.synchronizer,
                 )
         self._tasks.append(self.state_server.spawn())
         self._tasks.append(self.core.spawn())

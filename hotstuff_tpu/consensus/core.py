@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from ..crypto import Digest, PublicKey, SignatureService
 from ..crypto.async_service import AsyncVerifyService
@@ -33,17 +34,22 @@ from ..network import SimpleSender
 from ..store import Store
 from ..utils.codec import Decoder, Encoder
 from .aggregator import ROUND_LOOKAHEAD, Aggregator
-from .config import Committee
+from .config import Committee, InvalidCommittee
 from .errors import ConsensusError, SerializationError, WrongLeader
 from .leader import LeaderElector
 from .messages import MAX_BLOCK_PAYLOADS, QC, TC, Block, Round, Timeout, Vote
+from .reconfig import ReconfigOp, validate_reconfig
 from .synchronizer import Synchronizer
 from .timer import Timer
 from .wire import (
+    MAX_SCHEDULE_LINKS,
     TAG_PROPOSE,
+    TAG_RECONFIG,
     TAG_TC,
     TAG_TIMEOUT,
     TAG_VOTE,
+    decode_schedule_links,
+    encode_schedule_links,
     encode_tc,
     encode_timeout,
     encode_vote,
@@ -53,6 +59,10 @@ log = logging.getLogger(__name__)
 
 CONSENSUS_STATE_KEY = b"consensus_state"
 LATEST_ROUND_KEY = b"latest_round"
+#: certified schedule links: one (committed reconfig block, certifying
+#: QC) pair per applied epoch change — replayed into the schedule at
+#: boot and served to joiners via the state-sync manifest
+SCHEDULE_LINKS_KEY = b"schedule_links"
 
 # Core event-queue kinds.  The reference selects over three channels
 # (core.rs:466-477); this build merges them into ONE queue of tagged
@@ -212,11 +222,12 @@ class ProposerMessage:
 
     __slots__ = (
         "kind", "round", "qc", "tc", "rounds", "allow_empty", "payloads",
-        "committed_round",
+        "committed_round", "op",
     )
 
     MAKE = "make"
     CLEANUP = "cleanup"
+    RECONFIG = "reconfig"
 
     def __init__(
         self,
@@ -228,6 +239,7 @@ class ProposerMessage:
         allow_empty=False,
         payloads=frozenset(),
         committed_round=0,
+        op=None,
     ):
         self.kind = kind
         self.round = round_
@@ -235,6 +247,8 @@ class ProposerMessage:
         self.tc = tc
         self.rounds = list(rounds)
         self.allow_empty = allow_empty
+        # a validated ReconfigOp awaiting our next leader slot (RECONFIG)
+        self.op = op
         # committed payload digests the proposer must drop from its
         # buffer, and the round the chain is committed through — any of
         # our in-flight proposals at <= committed_round whose payloads
@@ -259,6 +273,10 @@ class ProposerMessage:
             payloads=payloads,
             committed_round=committed_round,
         )
+
+    @classmethod
+    def reconfig(cls, op: ReconfigOp) -> "ProposerMessage":
+        return cls(cls.RECONFIG, op=op)
 
 
 class Core:
@@ -327,6 +345,22 @@ class Core:
         # (measured: a WAN f=3 committee wedged to zero commits because
         # boot-time idle rounds pushed the timer to 16 s+).
         self._saw_proposal = False
+        # Reconfiguration (docs/RECONFIG.md): the epoch the node is
+        # operating under.  None until run() sets it AFTER crash
+        # recovery and the state-sync bootstrap — initializing earlier
+        # would make a restarted node re-log old epoch activations at
+        # wrong rounds, breaking the epoch-agreement invariant.
+        self._active_epoch: int | None = None
+        # Retirement: once an activated epoch excludes this node, it
+        # keeps serving (Helper / state-sync / boundary certificates)
+        # for a grace window of rounds, then flips ``retired`` — the
+        # run loop drains events without processing and node/main.py
+        # shuts the process down cleanly.
+        self._retire_after: Round | None = None
+        self._grace_rounds = int(
+            os.environ.get("HOTSTUFF_RECONFIG_GRACE_ROUNDS", "16")
+        )
+        self.retired = False
         # Byzantine adversary plane (faults/adversary.py): None on
         # honest nodes; on attacking nodes the vote/timeout/commit
         # seams below consult it for the active policy windows.
@@ -365,6 +399,11 @@ class Core:
         if telemetry is not None:
             telemetry.gauge(
                 "core_round", "Current consensus round", fn=lambda: self.round
+            )
+            telemetry.gauge(
+                "core_epoch",
+                "Active committee epoch at the current round",
+                fn=lambda: self.committee.for_round(self.round).epoch,
             )
             telemetry.gauge(
                 "core_event_queue_depth",
@@ -469,7 +508,11 @@ class Core:
         )
         return vote
 
-    async def _commit(self, block: Block) -> None:
+    async def _commit(self, block: Block, cert_qc: QC) -> None:
+        """Commit ``block`` and its uncommitted ancestors.  ``cert_qc``
+        is the QC certifying ``block`` itself (the 2-chain rule's b1.qc)
+        — committed reconfig blocks persist it as the certified schedule
+        link a joiner verifies the epoch change with."""
         if self.last_committed_round >= block.round:
             return
 
@@ -496,8 +539,13 @@ class Core:
         self.last_committed_round = block.round
         self.state_changed = True
 
+        # certifying QC per chain position: to_commit[0] (the head) is
+        # certified by the caller's cert_qc; every deeper ancestor by
+        # its child's embedded qc (child.qc.hash == parent.digest())
+        cert_qcs = [cert_qc] + [b.qc for b in to_commit[:-1]]
+
         committed_payloads: set = set()
-        for b in reversed(to_commit):
+        for b, cqc in zip(reversed(to_commit), reversed(cert_qcs)):
             await self.tx_commit.put(b)
             committed_payloads.update(b.payloads)
             if self._trace is not None:
@@ -547,6 +595,8 @@ class Core:
                         Digest(root),
                         b.round,
                     )
+            if b.reconfig is not None:
+                await self._apply_reconfig(b, cqc)
         # Tell the proposer what committed: (a) it prunes those digests
         # from its buffer — with single-homed clients (node/client.py)
         # queues are disjoint so this is defense-in-depth against
@@ -570,6 +620,134 @@ class Core:
         if qc.round > self.high_qc.round:
             self.high_qc = qc
             self.state_changed = True
+
+    # ---- reconfiguration (docs/RECONFIG.md) --------------------------------
+
+    async def _apply_reconfig(self, block: Block, cert_qc: QC) -> None:
+        """A committed block carries an epoch change: splice the new
+        committee into the shared schedule at ``block.round + margin``
+        — deterministic across nodes, so every honest node activates
+        the same epoch at the same round — and persist the certified
+        link for crash recovery and joiners."""
+        op = block.reconfig
+        if not hasattr(self.committee, "splice"):
+            # a bare (non-schedule) committee cannot rotate — tests
+            # spawning Core directly on a plain Committee stay valid
+            self.log.warning(
+                "Reconfig committed at round %d but the committee is "
+                "not a schedule; ignoring", block.round,
+            )
+            return
+        activation = block.round + op.margin
+        try:
+            spliced = self.committee.splice(activation, op.new_committee)
+        except InvalidCommittee as e:
+            # defense in depth: Block.verify already ran the full gate,
+            # so only a replayed/conflicting splice can land here
+            self.log.warning(
+                "Reconfig committed at round %d not applied: %s",
+                block.round, e,
+            )
+            return
+        if not spliced:
+            return  # exact replay (crash-recovery re-commit)
+        # NOTE: this log entry is used by the reconfiguration harness.
+        self.log.info(
+            "Reconfig committed at round %d: epoch %d activates at "
+            "round %d (margin %d)",
+            block.round, op.new_committee.epoch, activation, op.margin,
+        )
+        if self._journal is not None:
+            self._journal.record("reconfig.commit", block.round, block.digest())
+            self._journal.flush()
+        # pre-warm native verifier key tables for the incoming epoch so
+        # the first boundary certificate pays no key-parsing latency
+        pre = getattr(self.verifier, "precompute", None)
+        if pre is not None:
+            try:
+                pre([k.to_bytes() for k in op.new_committee.sorted_keys()])
+            except Exception as e:  # noqa: BLE001 — warm-up only
+                self.log.debug("verifier precompute failed: %s", e)
+        await self._persist_schedule_link(block, cert_qc)
+
+    async def _persist_schedule_link(
+        self, block: Block, cert_qc: QC
+    ) -> None:
+        raw = await self.store.read(SCHEDULE_LINKS_KEY)
+        links = decode_schedule_links(raw) if raw else []
+        enc = Encoder()
+        cert_qc.encode(enc)
+        links.append((block.serialize(), enc.finish()))
+        if len(links) > MAX_SCHEDULE_LINKS:
+            # beyond the wire cap a joiner can no longer verify from
+            # genesis — drop the oldest link and say so (joiners must
+            # then boot from a committee file of a later epoch)
+            self.log.warning(
+                "Schedule link list exceeds %d; dropping the oldest "
+                "(joiners need a post-genesis committee file)",
+                MAX_SCHEDULE_LINKS,
+            )
+            links = links[-MAX_SCHEDULE_LINKS:]
+        await self.store.write(SCHEDULE_LINKS_KEY, encode_schedule_links(links))
+
+    def _maybe_activate_epoch(self) -> None:
+        """Epoch-boundary detection at the CURRENT round, run on every
+        round advance.  Crossing a boundary also snaps the view-change
+        backoff: the backed-off timer measured the OLD committee's
+        liveness trouble, and carrying it into a fresh validator set
+        costs several idle multi-second views right when the handoff
+        gap is being measured (the exponent was previously never reset
+        on activation — epoch-boundary bugfix)."""
+        if self._active_epoch is None:
+            return
+        epoch = self.committee.for_round(self.round).epoch
+        if epoch == self._active_epoch:
+            return
+        self._consecutive_tcs = 0
+        if self._timeout_exponent:
+            self._timeout_exponent = 0
+            self.timer.set_duration_ms(self._timeout_base_ms)
+            self.timer.reset()
+        self._activate_epoch(epoch)
+
+    def _activate_epoch(self, epoch: int) -> None:
+        self._active_epoch = epoch
+        # Report the SCHEDULE's activation round, not wherever this node
+        # happens to be: a joiner (or a state-synced straggler) crosses
+        # the boundary mid-catch-up at some later round, and the
+        # epoch-agreement invariant compares the activation POINT — the
+        # deterministic commit_round + margin every honest node shares.
+        reported_round = self.round
+        for from_round, com in getattr(self.committee, "entries", ()):
+            if com.epoch == epoch:
+                reported_round = from_round
+                break
+        adversary = self.adversary
+        if adversary is not None and adversary.active("reconfig"):
+            # reconfig policy (shadow half): claim the activation at a
+            # skewed round — a divergent epoch history the
+            # epoch-agreement invariant must catch and attribute
+            reported_round = reported_round + 1 + (epoch % 3)
+            adversary.count("byz_shadow_epochs")
+            adversary.record("reconfig-shadow", self.round)
+            self.log.info(
+                "byz reconfig-shadow epoch %d round %d -> %d",
+                epoch, self.round, reported_round,
+            )
+        # NOTE: this log entry is used by the epoch-agreement invariant.
+        self.log.info("Epoch %d activated at round %d", epoch, reported_round)
+        if self._journal is not None:
+            self._journal.record("reconfig.activate", self.round)
+            self._journal.flush()
+        if (
+            self._retire_after is None
+            and self.committee.for_round(self.round).stake(self.name) <= 0
+        ):
+            self._retire_after = self.round + self._grace_rounds
+            self.log.info(
+                "Retiring: epoch %d excludes this node; serving a grace "
+                "window through round %d", epoch, self._retire_after,
+            )
 
     # ---- round advancement and proposals -----------------------------------
 
@@ -609,6 +787,7 @@ class Core:
         self.timer.reset()
         self.round = round_ + 1
         self._saw_proposal = False
+        self._maybe_activate_epoch()
         self.state_changed = True
         if self._journal is not None:
             self._journal.record("round.enter", self.round)
@@ -721,7 +900,7 @@ class Core:
         elif (
             timeout.round > self.round
             and self.aggregator.timeout_weight(timeout.round)
-            >= self.committee.validity_threshold()
+            >= self.committee.for_round(timeout.round).validity_threshold()
         ):
             # Round synchronization (timeout-join): f+1 stake — at least
             # one honest authority — is provably timing out a round
@@ -741,11 +920,19 @@ class Core:
             )
             self.round = timeout.round
             self._saw_proposal = False
+            self._maybe_activate_epoch()
             self.state_changed = True
             self.aggregator.cleanup(self.round)
             await self._local_timeout_round()
 
     async def _local_timeout_round(self) -> None:
+        if self.committee.for_round(self.round).stake(self.name) <= 0:
+            # not a member of the round's epoch (a joiner before its
+            # activation round, a retiree after): our timeout carries
+            # no stake and honest receivers would reject it — keep
+            # observing, just re-arm the timer
+            self.timer.reset()
+            return
         self.log.warning("Timeout reached for round %d", self.round)
         if self._trace is not None:
             self._trace.mark_timeout()
@@ -841,7 +1028,7 @@ class Core:
 
         # 2-chain commit rule.
         if b0.round + 1 == b1.round:
-            await self._commit(b0)
+            await self._commit(b0, b1.qc)
 
         # Prevents bad leaders from proposing blocks far in the future.
         if block.round != self.round:
@@ -858,6 +1045,11 @@ class Core:
                 "byz withhold vote round %d -> %s",
                 block.round, block.digest(),
             )
+            return
+
+        if self.committee.for_round(block.round).stake(self.name) <= 0:
+            # not a member of this block's epoch: observe the chain
+            # (commits above still ran), never vote
             return
 
         vote = await self._make_vote(block)
@@ -966,6 +1158,23 @@ class Core:
         self._advance_round(tc.round, via_tc=True)
         if self.name == self.leader_elector.get_leader(self.round):
             await self._generate_proposal(tc)
+
+    async def _handle_reconfig(self, op: ReconfigOp) -> None:
+        """An operator-submitted epoch change (wire.encode_reconfig).
+        The full verification gate runs at admission — margin bounds,
+        epoch succession, carried-over stake, sponsor membership and
+        signature (byz-reconfig's forged ops die HERE on honest nodes)
+        — then the op waits in the proposer for our next leader slot."""
+        validate_reconfig(op, self.committee, self.round, verifier=self.verifier)
+        self.log.info(
+            "Reconfig op admitted: epoch %d (%d members, margin %d)",
+            op.new_committee.epoch,
+            len(op.new_committee.authorities),
+            op.margin,
+        )
+        if self._journal is not None:
+            self._journal.record("reconfig.submit", self.round)
+        await self.tx_proposer.put(ProposerMessage.reconfig(op))
 
     # ---- the select loop -----------------------------------------------------
 
@@ -1178,6 +1387,8 @@ class Core:
             await self._handle_timeout(payload, sig_verified=sig_verified)
         elif tag == TAG_TC:
             await self._handle_tc(payload, sigs_verified=sig_verified)
+        elif tag == TAG_RECONFIG:
+            await self._handle_reconfig(payload)
         else:
             self.log.error("Unexpected protocol message tag %s in core", tag)
 
@@ -1220,6 +1431,29 @@ class Core:
                 self.last_committed_round = adopted
                 self.state_changed = True
 
+        # Epoch tracking starts at the CURRENT round's committee — only
+        # now, after recovery and any state-sync schedule splices, so a
+        # restart inside a later epoch does not replay old activations.
+        com_now = self.committee.for_round(self.round)
+        self._active_epoch = com_now.epoch
+        if com_now.stake(self.name) <= 0:
+            # restarted AFTER a boundary that excluded us (the live
+            # crossing in _activate_epoch never fired): retire unless a
+            # later scheduled epoch re-admits us (then we are a joiner)
+            epochs = self.committee.committees()
+            rejoins = any(
+                c.stake(self.name) > 0 and c.epoch > com_now.epoch
+                for c in epochs
+            )
+            was_member = any(c.stake(self.name) > 0 for c in epochs)
+            if was_member and not rejoins and self._retire_after is None:
+                self._retire_after = self.round + self._grace_rounds
+                self.log.info(
+                    "Retiring: epoch %d excludes this node; serving a "
+                    "grace window through round %d",
+                    com_now.epoch, self._retire_after,
+                )
+
         # Bootstrap: propose if we lead the (possibly recovered) round.
         self.timer.reset()
         if self.name == self.leader_elector.get_leader(self.round):
@@ -1229,6 +1463,18 @@ class Core:
         try:
             while True:
                 event = await self.rx_events.get()
+                if self.retired:
+                    # retired member: drain events without processing so
+                    # the receiver never backpressures, while the Helper
+                    # and state-sync server keep serving boundary
+                    # certificates (node/main.py watches ``retired`` and
+                    # shuts the process down after a linger window)
+                    while True:
+                        try:
+                            self.rx_loopback.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                    continue
                 # Burst drain: everything already queued is handled in
                 # this wake-up.  Network messages are collected FIRST so
                 # the whole wave's signature checks discharge as ONE
@@ -1299,6 +1545,20 @@ class Core:
                         self.log.warning("%s", e)
                 if timer_fired:
                     self._timer_ack.set()
+                if (
+                    self._retire_after is not None
+                    and not self.retired
+                    and self.round >= self._retire_after
+                ):
+                    self.retired = True
+                    # NOTE: this log entry is used by the reconfig harness.
+                    self.log.info(
+                        "Retired at round %d (grace window complete)",
+                        self.round,
+                    )
+                    if self._journal is not None:
+                        self._journal.record("reconfig.retire", self.round)
+                        self._journal.flush()
                 if self.state_changed:
                     await self.persist_state()
                     self.state_changed = False

@@ -72,3 +72,12 @@ class WrongLeader(ConsensusError):
 class InvalidPayload(ConsensusError):
     def __init__(self):
         super().__init__("Invalid payload")
+
+
+class InvalidReconfig(ConsensusError):
+    """A reconfiguration op that must die at verification: bad epoch
+    succession, out-of-bounds margin, insufficient carried-over stake,
+    unauthorized sponsor, or a bad sponsor signature."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"Invalid reconfiguration op: {reason}")

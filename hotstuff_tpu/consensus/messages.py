@@ -34,6 +34,7 @@ from .errors import (
     TCRequiresQuorum,
     UnknownAuthority,
 )
+from .reconfig import ReconfigOp, validate_reconfig
 
 Round = int
 
@@ -716,6 +717,12 @@ class Block:
     round: Round = 0
     payloads: tuple[Digest, ...] = ()
     signature: Signature = field(default_factory=Signature)
+    # Typed epoch-change payload (consensus/reconfig.py): at most one
+    # per block; covered by the block digest (votes certify the op),
+    # validated in ``verify`` so a forged epoch change never earns an
+    # honest vote, and applied by the commit path via
+    # ``CommitteeSchedule.splice``.
+    reconfig: ReconfigOp | None = None
     # memoized digest — blocks are immutable after construction and the
     # digest is recomputed on the hot path (signature check, store key,
     # commit walk, log lines): ~20 us of SHA-512 + joins per call
@@ -742,12 +749,20 @@ class Block:
     def digest(self) -> Digest:
         d = self._digest
         if d is None:
+            # The reconfig op digest is appended only when present, so
+            # every reconfig-free block keeps the pre-reconfiguration
+            # preimage byte-for-byte.
             d = Digest(
                 sha512_trunc(
                     self.author.to_bytes()
                     + _round_le(self.round)
                     + b"".join(p.to_bytes() for p in self.payloads)
                     + self.qc.hash.to_bytes()
+                    + (
+                        self.reconfig.digest()
+                        if self.reconfig is not None
+                        else b""
+                    )
                 )
             )
             self._digest = d
@@ -793,6 +808,12 @@ class Block:
             raise UnknownAuthority(self.author)
         if len(self.payloads) > MAX_BLOCK_PAYLOADS:
             raise MalformedBlock(self.digest())
+        if self.reconfig is not None:
+            # Raises InvalidReconfig: a block carrying a forged or
+            # unauthorized epoch change never earns an honest vote.
+            validate_reconfig(
+                self.reconfig, committee, self.round, verifier=verifier
+            )
         if not sigs_verified and not verifier.verify_one(
             self.digest(), self.author, self.signature
         ):
@@ -814,6 +835,9 @@ class Block:
         enc.u32(len(self.payloads))
         for p in self.payloads:
             enc.raw(p.to_bytes())
+        enc.flag(self.reconfig is not None)
+        if self.reconfig is not None:
+            self.reconfig.encode(enc)
         encode_sig(enc, self.signature)
 
     @classmethod
@@ -839,9 +863,16 @@ class Block:
             Digest(raw[i : i + Digest.SIZE])
             for i in range(0, Digest.SIZE * n, Digest.SIZE)
         )
+        reconfig = ReconfigOp.decode(dec) if dec.flag() else None
         sig = decode_sig(dec)
         block = cls(
-            qc=qc, tc=tc, author=author, round=rnd, payloads=payloads, signature=sig
+            qc=qc,
+            tc=tc,
+            author=author,
+            round=rnd,
+            payloads=payloads,
+            signature=sig,
+            reconfig=reconfig,
         )
         block._wire = dec.since(start)
         return block

@@ -41,6 +41,7 @@ from ..network import ReliableSender
 from .config import Committee
 from .core import ProposerMessage
 from .messages import MAX_BLOCK_PAYLOADS, QC, TC, Block, Round
+from .reconfig import ReconfigOp, newest_epoch
 from .wire import encode_propose
 
 log = logging.getLogger(__name__)
@@ -123,6 +124,10 @@ class Proposer:
         # payload set alone cannot show that.
         self.committed_seen: OrderedDict[Digest, None] = OrderedDict()
         self.deferred: ProposerMessage | None = None
+        # A core-validated reconfiguration op awaiting our next leader
+        # slot (docs/RECONFIG.md); dropped once its epoch is scheduled
+        # (another leader's block carried it first).
+        self.pending_reconfig: ReconfigOp | None = None
         # Highest round a block was actually created for: re-issued Makes
         # for the same round are dropped, so (a) the core may safely
         # re-send a Make when allow_empty conditions change, and (b) this
@@ -184,7 +189,26 @@ class Proposer:
     ) -> None:
         if round_ <= self.last_made_round:
             return  # already proposed for this round (equivocation guard)
-        if not self.pending and not allow_empty:
+        op = self.pending_reconfig
+        if op is not None and newest_epoch(self.committee) >= op.new_committee.epoch:
+            # the epoch change is already scheduled (committed via
+            # another leader's block, or a competing op won): drop ours
+            self.pending_reconfig = None
+            op = None
+        if (
+            op is None
+            and self.adversary is not None
+            and self.adversary.active("reconfig")
+        ):
+            # reconfig policy (forge half): attach a forged epoch change
+            # — well-formed wire, hostile committee / bad sponsor — that
+            # MUST die in every honest voter's Block.verify
+            op = self.adversary.forged_reconfig(self.committee, round_)
+            if op is not None:
+                self.adversary.count("byz_forged_reconfigs")
+                self.adversary.record("reconfig-forge", round_)
+                self.log.info("byz reconfig-forge round %d", round_)
+        if not self.pending and not allow_empty and op is None:
             # Defer: fire the moment the next payload arrives instead of
             # wedging the round until the view-change timer (see module
             # docstring).  A newer Make supersedes this one.
@@ -216,12 +240,20 @@ class Proposer:
             while len(self.inflight) > MAX_INFLIGHT:
                 self._requeue_oldest_inflight()
 
+        if op is not None and op is self.pending_reconfig:
+            self.pending_reconfig = None  # it rides in this block
         block = Block(
-            qc=qc, tc=tc, author=self.name, round=round_, payloads=payloads
+            qc=qc, tc=tc, author=self.name, round=round_, payloads=payloads,
+            reconfig=op,
         )
         block.signature = await self.signature_service.request_signature(
             block.digest()
         )
+        if op is not None:
+            self.log.info(
+                "Proposing reconfig in block %d: epoch %d (margin %d)",
+                round_, op.new_committee.epoch, op.margin,
+            )
         # NOTE: this log entry is used to compute performance — the harness
         # maps each payload -> block digest from it (benchmark/logs.py
         # contract).
@@ -402,6 +434,20 @@ class Proposer:
                             message.tc,
                             message.allow_empty,
                         )
+                    elif message.kind == ProposerMessage.RECONFIG:
+                        self.pending_reconfig = message.op
+                        self.log.info(
+                            "Reconfig op buffered for the next leader "
+                            "slot: epoch %d",
+                            message.op.new_committee.epoch,
+                        )
+                        if self.deferred is not None:
+                            # an empty-buffer make was parked waiting
+                            # for payloads — the op is reason enough to
+                            # propose now
+                            make = self.deferred
+                            self.deferred = None
+                            await self._make_block(make.round, make.qc, make.tc)
                     else:
                         # Cleanup(rounds): the chain advanced through these
                         # rounds — a deferred make for an older round is

@@ -42,8 +42,10 @@ import time
 from ..crypto import PublicKey
 from ..network import SimpleSender
 from ..store.state import SnapshotManifest, StateMachine
+from ..utils.codec import CodecError
 from .config import Committee
-from .errors import ConsensusError
+from .errors import ConsensusError, InvalidReconfig
+from .reconfig import splice_schedule_links
 from .wire import (
     STATE_REQ_CHUNK,
     STATE_REQ_DELTA,
@@ -51,6 +53,8 @@ from .wire import (
     TAG_STATE_CHUNK,
     TAG_STATE_MANIFEST,
     StateRequest,
+    decode_schedule_links,
+    encode_schedule_links,
     encode_state_chunk,
     encode_state_manifest,
     encode_state_request,
@@ -80,6 +84,7 @@ class StateSyncServer:
         high_qc,
         network: SimpleSender | None = None,
         telemetry=None,
+        store=None,
     ):
         self.name = name
         self.committee = committee
@@ -87,8 +92,26 @@ class StateSyncServer:
         self.rx_requests = rx_requests
         self.high_qc = high_qc  # () -> the core's current high QC
         self.network = network if network is not None else SimpleSender()
+        # consensus store (optional): source of the certified schedule
+        # links served in the manifest so a joiner can verify epoch
+        # changes it never witnessed (docs/RECONFIG.md)
+        self.store = store
         self._journal = telemetry.journal if telemetry is not None else None
         self._task: asyncio.Task | None = None
+
+    async def _schedule_links(self) -> tuple:
+        if self.store is None:
+            return ()
+        from .core import SCHEDULE_LINKS_KEY
+
+        raw = await self.store.read(SCHEDULE_LINKS_KEY)
+        if not raw:
+            return ()
+        try:
+            return tuple(decode_schedule_links(raw))
+        except CodecError as e:
+            log.warning("Corrupt schedule links in store: %s", e)
+            return ()
 
     async def run(self) -> None:
         while True:
@@ -119,6 +142,7 @@ class StateSyncServer:
                     from_round,
                     self.high_qc(),
                     self.name,
+                    links=await self._schedule_links(),
                 )
                 self.state.snapshots_served += 1
                 if self._journal is not None:
@@ -157,6 +181,8 @@ class StateSyncClient:
         manifest_wait_s: float | None = None,
         chunk_wait_s: float = SYNC_CHUNK_WAIT_S,
         telemetry=None,
+        store=None,
+        synchronizer=None,
     ):
         self.name = name
         self.committee = committee
@@ -164,6 +190,12 @@ class StateSyncClient:
         self.verifier = verifier
         self.rx_replies = rx_replies
         self.network = network if network is not None else SimpleSender()
+        # optional reconfiguration wiring (docs/RECONFIG.md): ``store``
+        # persists verified schedule links so a restart re-derives the
+        # epoch schedule without re-syncing; ``synchronizer`` gets its
+        # join barrier raised to the adopted snapshot round
+        self.store = store
+        self.synchronizer = synchronizer
         if min_lag is None:
             min_lag = int(
                 os.environ.get("HOTSTUFF_STATE_SYNC_LAG", SYNC_MIN_LAG_ROUNDS)
@@ -191,6 +223,52 @@ class StateSyncClient:
                 )
             except asyncio.TimeoutError:
                 return None
+
+    async def _apply_schedule_links(self, links) -> bool:
+        """Verified-successor acceptance (docs/RECONFIG.md): walk the
+        certified ``(reconfig block, certifying QC)`` chain served in a
+        manifest and splice every epoch change we have not seen yet into
+        the local schedule.  Each link is self-certifying — the op is
+        re-validated against the schedule *as extended so far* and the
+        QC must certify exactly that block under the committee in effect
+        at its round — so a joiner that booted with only the genesis
+        committee file ends up with the same schedule a live witness
+        holds, or rejects the manifest outright.  Returns False when any
+        link fails verification (the offer is then discarded whole)."""
+        if not links:
+            return True
+        if not hasattr(self.committee, "splice"):
+            self.log.warning(
+                "Ignoring %d schedule links: static committee", len(links)
+            )
+            return True
+        try:
+            splice_schedule_links(
+                links,
+                self.committee,
+                self.verifier,
+                qc_cache=self._qc_cache,
+                journal=self._journal,
+                log=self.log,
+            )
+        except InvalidReconfig as e:
+            self.log.warning("Rejecting schedule links: %s", e)
+            return False
+        if self.store is not None:
+            from .core import SCHEDULE_LINKS_KEY
+
+            raw = await self.store.read(SCHEDULE_LINKS_KEY)
+            have_n = 0
+            if raw:
+                try:
+                    have_n = len(decode_schedule_links(raw))
+                except CodecError:
+                    have_n = 0
+            if len(links) > have_n:
+                await self.store.write(
+                    SCHEDULE_LINKS_KEY, encode_schedule_links(list(links))
+                )
+        return True
 
     def _acceptable(self, m, from_round: int, floor: int) -> bool:
         if m.from_round != from_round or m.version <= self.state.version:
@@ -241,6 +319,11 @@ class StateSyncClient:
                     None,
                     str(payload.origin)[:8],
                 )
+            # schedule links first: _acceptable resolves the origin and
+            # verifies the anchoring QC against the (possibly extended)
+            # schedule, so a joiner must splice before judging the offer
+            if not await self._apply_schedule_links(payload.links):
+                continue
             if self._acceptable(payload, from_round, floor) and (
                 best is None or payload.version > best.version
             ):
@@ -301,6 +384,13 @@ class StateSyncClient:
             best.chunk_count,
         )
         self.state.adopt(manifest, entries)
+        if self.synchronizer is not None:
+            # ancestry at or below the snapshot is covered by the adopted
+            # state; never walk it (critical on a join: the pre-snapshot
+            # chain may predate this node's first reachable epoch)
+            self.synchronizer.join_floor = max(
+                self.synchronizer.join_floor, best.last_round
+            )
         elapsed = time.monotonic() - started
         if self._journal is not None:
             self._journal.record("sync.adopt", best.last_round)

@@ -59,7 +59,14 @@ class Synchronizer:
 
         self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
         self._pending: set[Digest] = set()  # child digests being synced
-        self._requests: dict[Digest, float] = {}  # parent digest -> first-ask time
+        # parent digest -> (first-ask time, child round, parent round):
+        # the rounds make the retry broadcast epoch-targeted
+        self._requests: dict[Digest, tuple[float, int, int]] = {}
+        # Epoch-aware join barrier: set (by the state-sync client) to
+        # the snapshot adoption round on a certified-schedule join —
+        # ancestry below it is covered by the snapshot and must never
+        # be fetched, whatever floor a caller passes.
+        self.join_floor = 0
         self._waiters: set[asyncio.Task] = set()
         # give-up bookkeeping: which waiters/children each parent pins
         self._by_parent: dict[Digest, list[asyncio.Task]] = {}
@@ -80,17 +87,30 @@ class Synchronizer:
         while True:
             await asyncio.sleep(TIMER_ACCURACY_S)
             now = time.monotonic()
-            for digest, asked_at in list(self._requests.items()):
+            for digest, (asked_at, child_round, parent_round) in list(
+                self._requests.items()
+            ):
                 if asked_at + self.sync_giveup < now:
                     self._expire(digest)
                 elif asked_at + self.sync_retry_delay < now:
                     self.log.debug("Requesting sync for block %s (retry)", digest)
-                    addresses = [
-                        addr
-                        for _, addr in self.committee.broadcast_addresses(self.name)
-                    ]
+                    addresses = self._sync_targets(child_round, parent_round)
                     message = encode_sync_request(digest, self.name)
                     await self.network.broadcast(addresses, message)
+
+    def _sync_targets(self, child_round: int, parent_round: int) -> list:
+        """Retry-broadcast targets for a missing parent: the members of
+        the child's epoch plus the parent's epoch (they differ exactly
+        at a reconfiguration boundary — the retiring members are the
+        ones guaranteed to hold the old-epoch block).  The all-epoch
+        union would instead spam every past epoch's membership on each
+        retry tick."""
+        seen: dict = {}
+        for r in (child_round, max(1, parent_round)):
+            com = self.committee.for_round(r)
+            for nm, addr in com.broadcast_addresses(self.name):
+                seen.setdefault(nm, addr)
+        return list(seen.values())
 
     def _expire(self, parent: Digest) -> None:
         """Abandon a parent that never arrived: unpin everything it
@@ -150,7 +170,9 @@ class Synchronizer:
 
         if parent not in self._requests:
             self.log.debug("Requesting sync for block %s", parent)
-            self._requests[parent] = time.monotonic()
+            self._requests[parent] = (
+                time.monotonic(), block.round, block.qc.round
+            )
             if self._journal is not None:
                 self._journal.record(
                     "sync.req", block.round, parent, str(block.author)[:8]
@@ -186,7 +208,7 @@ class Synchronizer:
                 return Block.deserialize(data)
             except Exception as e:
                 raise SerializationError(f"corrupt block in store: {e}") from e
-        if block.qc.round <= floor:
+        if block.qc.round <= max(floor, self.join_floor):
             return Block.genesis()
         await self._request_parent(block)
         return None

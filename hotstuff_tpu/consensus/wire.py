@@ -21,6 +21,7 @@ from .messages import (
     decode_pk,
     encode_pk,
 )
+from .reconfig import ReconfigOp
 
 TAG_PROPOSE = 0
 TAG_VOTE = 1
@@ -33,6 +34,7 @@ TAG_STATE_REQUEST = 7
 TAG_STATE_MANIFEST = 8
 TAG_STATE_CHUNK = 9
 TAG_STATE_READ = 10
+TAG_RECONFIG = 11
 
 ACK = b"Ack"
 
@@ -211,12 +213,64 @@ def decode_ingest_ack(data: bytes) -> IngestAck | None:
         raise SerializationError(str(e)) from e
 
 
+# ---- reconfiguration submission (docs/RECONFIG.md) -------------------------
+
+
+def encode_reconfig(op: ReconfigOp) -> bytes:
+    """Operator-facing submission frame: a sponsored ReconfigOp sent to
+    any current member's consensus port.  The receiving node validates
+    it (sponsor membership + signature, epoch succession, margin and
+    continuity bounds) and buffers it for its next leader slot — the op
+    only takes effect once 2-chain committed inside a block."""
+    enc = Encoder().u8(TAG_RECONFIG)
+    op.encode(enc)
+    return enc.finish()
+
+
 # ---- state-sync frames (docs/STATE.md) -------------------------------------
 
 #: versioned like the producer v2 frame: the byte is explicit so a v2
 #: snapshot layout can change the body without new tags; any other
-#: value is a CodecError
-STATE_FRAME_VERSION = 1
+#: value is a CodecError.  v2: the manifest carries the certified
+#: committee-schedule links (one committed reconfig block + its QC per
+#: epoch change) so a joiner can verify the schedule it never saw.
+STATE_FRAME_VERSION = 2
+#: decode-time cap on schedule links in one manifest (one per epoch
+#: change since genesis — 32 epoch changes is far beyond any run)
+MAX_SCHEDULE_LINKS = 32
+#: decode-time cap on one serialized link element (a reconfig block or
+#: its certifying QC; a 128-member committee plus a full certificate
+#: stays well under this)
+MAX_SCHEDULE_LINK_BYTES = 131_072
+def encode_schedule_links(links) -> bytes:
+    """Store form of the certified schedule-link list (core persists one
+    ``(reconfig block bytes, certifying QC bytes)`` pair per committed
+    epoch change; the state-sync server serves them in the manifest)."""
+    enc = Encoder().u16(len(links))
+    for block_bytes, qc_bytes in links:
+        enc.var_bytes(block_bytes)
+        enc.var_bytes(qc_bytes)
+    return enc.finish()
+
+
+def decode_schedule_links(data: bytes) -> list:
+    dec = Decoder(data)
+    n = dec.u16()
+    if n > MAX_SCHEDULE_LINKS:
+        raise CodecError(
+            f"schedule link count {n} exceeds cap {MAX_SCHEDULE_LINKS}"
+        )
+    out = [
+        (
+            dec.var_bytes(MAX_SCHEDULE_LINK_BYTES),
+            dec.var_bytes(MAX_SCHEDULE_LINK_BYTES),
+        )
+        for _ in range(n)
+    ]
+    dec.finish()
+    return out
+
+
 #: request kinds: full-snapshot manifest, one chunk, or a delta
 #: manifest restricted to entries newer than ``from_round`` (what a
 #: crash-recovered node with surviving state asks for)
@@ -255,10 +309,10 @@ class StateManifestMsg:
     member at a different version."""
 
     __slots__ = ("version", "root", "last_round", "applied_payloads",
-                 "chunk_count", "from_round", "qc", "origin")
+                 "chunk_count", "from_round", "qc", "origin", "links")
 
     def __init__(self, version, root, last_round, applied_payloads,
-                 chunk_count, from_round, qc, origin):
+                 chunk_count, from_round, qc, origin, links=()):
         self.version = version
         self.root = root
         self.last_round = last_round
@@ -267,6 +321,11 @@ class StateManifestMsg:
         self.from_round = from_round
         self.qc = qc
         self.origin = origin
+        # certified schedule links: (reconfig block bytes, certifying QC
+        # bytes) per committed epoch change, oldest first — the joiner
+        # verifies each link against the previous epoch's committee
+        # before splicing (statesync.py)
+        self.links = links
 
 
 class StateChunkMsg:
@@ -291,7 +350,13 @@ def encode_state_request(kind: int, origin: PublicKey, index: int = 0,
 
 def encode_state_manifest(version: int, root: bytes, last_round: int,
                           applied_payloads: int, chunk_count: int,
-                          from_round: int, qc, origin: PublicKey) -> bytes:
+                          from_round: int, qc, origin: PublicKey,
+                          links=()) -> bytes:
+    if len(links) > MAX_SCHEDULE_LINKS:
+        raise ValueError(
+            f"manifest carries {len(links)} schedule links "
+            f"(cap {MAX_SCHEDULE_LINKS})"
+        )
     enc = (
         Encoder().u8(TAG_STATE_MANIFEST).u8(STATE_FRAME_VERSION)
         .u64(version).raw(root).u64(last_round).u64(applied_payloads)
@@ -299,6 +364,10 @@ def encode_state_manifest(version: int, root: bytes, last_round: int,
     )
     qc.encode(enc)
     encode_pk(enc, origin)
+    enc.u16(len(links))
+    for block_bytes, qc_bytes in links:
+        enc.var_bytes(block_bytes)
+        enc.var_bytes(qc_bytes)
     return enc.finish()
 
 
@@ -396,7 +465,8 @@ def decode_message(data: bytes, scheme: str | None = None):
     TC -> TC, SyncRequest -> (Digest, PublicKey), Producer ->
     (Digest, body), ProducerV2 -> tuple of (Digest, body) pairs,
     StateRequest -> StateRequest, StateManifest -> StateManifestMsg,
-    StateChunk -> StateChunkMsg, StateRead -> (space, key).
+    StateChunk -> StateChunkMsg, StateRead -> (space, key),
+    Reconfig -> ReconfigOp.
 
     ``scheme`` (the committee's signature scheme) narrows accepted
     key/signature wire sizes to that scheme's; None accepts the union.
@@ -457,6 +527,19 @@ def decode_message(data: bytes, scheme: str | None = None):
                 dec.u64(), dec.raw(32), dec.u64(), dec.u64(),
                 dec.u32(), dec.u64(), QC.decode(dec), decode_pk(dec),
             )
+            n_links = dec.u16()
+            if n_links > MAX_SCHEDULE_LINKS:
+                raise CodecError(
+                    f"manifest link count {n_links} exceeds cap "
+                    f"{MAX_SCHEDULE_LINKS}"
+                )
+            out.links = tuple(
+                (
+                    dec.var_bytes(MAX_SCHEDULE_LINK_BYTES),
+                    dec.var_bytes(MAX_SCHEDULE_LINK_BYTES),
+                )
+                for _ in range(n_links)
+            )
         elif tag == TAG_STATE_CHUNK:
             _decode_state_version(dec)
             version, index, from_round = dec.u64(), dec.u32(), dec.u64()
@@ -477,6 +560,8 @@ def decode_message(data: bytes, scheme: str | None = None):
             if space not in (STATE_READ_LEDGER, STATE_READ_USER):
                 raise CodecError(f"invalid state read space {space}")
             out = (space, dec.var_bytes(MAX_STATE_KEY))
+        elif tag == TAG_RECONFIG:
+            out = ReconfigOp.decode(dec)
         else:
             raise CodecError(f"unknown message tag {tag}")
         dec.finish()

@@ -28,6 +28,12 @@ Policies
                designated shadow committer reports the shadow chain in
                its commit log — producing a REAL divergent history the
                safety checker must catch and attribute
+  reconfig     attack the reconfiguration plane from both ends: as
+               leader, attach a FORGED epoch change (attacker-only
+               committee, garbage sponsor signature) that must die in
+               every honest voter's Block.verify; and report epoch
+               activations at skewed rounds — a divergent epoch
+               history the epoch-agreement invariant must catch
 
 Determinism contract (same bar as the fault plane): every random
 choice is drawn from a per-node ``random.Random`` seeded from
@@ -52,6 +58,7 @@ the chaos runner points it at the same ``.faults.json``)::
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import logging
 import random
@@ -68,6 +75,7 @@ POLICIES = (
     "double-vote",
     "flood",
     "collude",
+    "reconfig",
 )
 
 #: flood policy burst cadence (seconds between bursts)
@@ -159,6 +167,8 @@ class AdversaryPlane:
             "byz_double_votes": 0,
             "byz_floods": 0,
             "byz_shadow_commits": 0,
+            "byz_forged_reconfigs": 0,
+            "byz_shadow_epochs": 0,
         }
         #: colluding node indexes, sorted (collude rules only)
         self.colluders = sorted(
@@ -315,6 +325,42 @@ class AdversaryPlane:
                 bytes(self.rng.getrandbits(8) for _ in range(48))
             ),
             signers=bitmap,
+        )
+
+    def forged_reconfig(self, committee, round_: int):
+        """A well-formed (wire-decodable) reconfiguration op whose
+        committee is entirely attacker keys and whose sponsor signature
+        is seeded garbage — it passes decode and rides in this leader's
+        block, and MUST die in every honest voter's ``Block.verify``
+        (the continuity rule: attacker-only members carry zero stake
+        from the current epoch).  One seeded draw gates each leader
+        slot; 64 further draws build the garbage signature."""
+        if self.rng.random() >= 0.5:
+            return None
+        from ..consensus.config import Authority, Committee
+        from ..consensus.reconfig import ReconfigOp, newest_epoch
+        from ..crypto import Signature, generate_keypair
+
+        seed32 = hashlib.sha512(
+            f"byz-reconfig|{self.seed}".encode()
+        ).digest()[:32]
+        cur = committee.for_round(round_)
+        authorities = {}
+        for i in range(max(1, len(cur.authorities))):
+            pk, _ = generate_keypair(seed32, i)
+            authorities[pk] = Authority(1, ("203.0.113.1", 7000 + i))
+        hostile = Committee(
+            authorities=authorities,
+            epoch=newest_epoch(committee) + 1,
+            scheme="ed25519",
+        )
+        return ReconfigOp(
+            new_committee=hostile,
+            margin=4,
+            sponsor=next(iter(authorities)),
+            signature=Signature(
+                bytes(self.rng.getrandbits(8) for _ in range(64))
+            ),
         )
 
     # ------------------------------------------------------------------

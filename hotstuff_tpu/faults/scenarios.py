@@ -155,6 +155,101 @@ def byz_collude(nodes: int = 4, seed: int = 0, at: float = 2.0) -> dict:
     }
 
 
+def reconfig_rotate(nodes: int = 4, seed: int = 0, at: float = 6.0) -> dict:
+    """Live committee rotation (docs/RECONFIG.md): at t=``at`` the
+    runner submits a sponsored reconfiguration that adds a freshly
+    keyed member (node ``nodes``) and drops node 0.  The op is 2-chain
+    committed, every node splices the new epoch at commit+margin, the
+    joiner state-syncs the certified schedule in and votes in its first
+    active round, and node 0 retires after its grace window.  Commits
+    must never stall more than the declared handoff gap across the
+    boundary."""
+    return {
+        "name": "reconfig-rotate",
+        "seed": seed,
+        "rules": [],
+        "reconfig": [
+            {"at": at, "join": [nodes], "retire": [0], "sponsor": 1},
+        ],
+        "handoff_gap_rounds": 64,
+        "liveness": {"resume_within_s": 25.0, "max_round_gap": 200},
+    }
+
+
+def reconfig_join_under_partition(
+    nodes: int = 4, seed: int = 0, at: float = 6.0
+) -> dict:
+    """Rotation with the joiner's first seconds spent behind a severed
+    link to one serving peer: the certified-schedule fetch must fall
+    back to the remaining members (manifest collection is a broadcast,
+    not a single-peer trust decision)."""
+    return {
+        "name": "reconfig-join-under-partition",
+        "seed": seed,
+        "rules": [
+            # the joiner (index ``nodes``) cannot reach node 1 while it
+            # bootstraps; nodes 2/3 still serve manifests and chunks
+            {"label": "join-cut", "from": [nodes], "to": [1], "drop": 1.0,
+             "at": at, "until": at + 12.0},
+            {"label": "join-cut-rev", "from": [1], "to": [nodes],
+             "drop": 1.0, "at": at, "until": at + 12.0},
+        ],
+        "reconfig": [
+            {"at": at, "join": [nodes], "retire": [0], "sponsor": 1},
+        ],
+        "handoff_gap_rounds": 96,
+        "liveness": {"resume_within_s": 30.0, "max_round_gap": 250},
+    }
+
+
+def reconfig_retire_crash(nodes: int = 4, seed: int = 0,
+                          at: float = 6.0) -> dict:
+    """Rotation with a SIGKILL+rejoin of a SURVIVING member straddling
+    the epoch boundary: node 2 dies right after the op is submitted and
+    restarts after the new epoch has activated, so its recovery path
+    must replay the persisted schedule links (or re-fetch them via
+    state-sync) before it can verify new-epoch certificates."""
+    return {
+        "name": "reconfig-retire-crash",
+        "seed": seed,
+        "rules": [],
+        "reconfig": [
+            {"at": at, "join": [nodes], "retire": [0], "sponsor": 1},
+        ],
+        "crashes": [
+            {"node": 2, "at": at + 2.0, "restart_at": at + 12.0},
+        ],
+        "handoff_gap_rounds": 96,
+        "liveness": {"resume_within_s": 30.0, "max_round_gap": 250},
+    }
+
+
+def byz_reconfig(nodes: int = 4, seed: int = 0, at: float = 2.0) -> dict:
+    """Node 0 plays reconfiguration games: when leading it proposes
+    forged reconfig ops (attacker-only committees under garbage sponsor
+    signatures — honest verification must kill every one at admission
+    or block verify), and when a REAL rotation commits it logs a skewed
+    activation round.  The runner also drives one genuine rotation so
+    the shadow claims conflict with honest epoch agreement: full-history
+    checking must FAIL epoch agreement with the skew attributed to node
+    0, and the ``trusted-subset`` regime (excluding the adversary) must
+    PASS."""
+    return {
+        "name": "byz-reconfig",
+        "seed": seed,
+        "rules": [],
+        "adversary": [
+            {"policy": "reconfig", "node": 0, "at": at, "until": None}
+        ],
+        "reconfig": [
+            {"at": 6.0, "join": [nodes], "retire": [], "sponsor": 1},
+        ],
+        "quorum_mode": "trusted-subset",
+        "handoff_gap_rounds": 96,
+        "liveness": {"resume_within_s": 25.0, "max_round_gap": 200},
+    }
+
+
 SCENARIOS = {
     "split-brain": split_brain,
     "leader-isolation": leader_isolation,
@@ -164,6 +259,10 @@ SCENARIOS = {
     "byz-forge-qc": byz_forge_qc,
     "byz-withhold": byz_withhold,
     "byz-collude": byz_collude,
+    "reconfig-rotate": reconfig_rotate,
+    "reconfig-join-under-partition": reconfig_join_under_partition,
+    "reconfig-retire-crash": reconfig_retire_crash,
+    "byz-reconfig": byz_reconfig,
 }
 
 
@@ -212,4 +311,6 @@ def last_heal(spec: dict) -> float:
 
 __all__ = ["SCENARIOS", "build", "last_heal", "split_brain",
            "leader_isolation", "flapping_link", "rolling_crash_restart",
-           "byz_equivocate", "byz_forge_qc", "byz_withhold", "byz_collude"]
+           "byz_equivocate", "byz_forge_qc", "byz_withhold", "byz_collude",
+           "reconfig_rotate", "reconfig_join_under_partition",
+           "reconfig_retire_crash", "byz_reconfig"]

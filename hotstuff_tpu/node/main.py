@@ -286,7 +286,66 @@ async def _run_node(args) -> None:
         transport=args.transport,
     )
     _freeze_boot_objects()
-    await node.analyze_block()
+    # serve() instead of analyze_block(): a node voted out by a
+    # committed reconfiguration exits cleanly after its grace window
+    await node.serve()
+
+
+async def _submit_reconfig(args) -> int:
+    """Craft, sign, and broadcast a reconfiguration op (docs/RECONFIG.md):
+    the NEW epoch's committee (``--new-committee`` file) plus an
+    activation margin Δ, sponsored by the member whose key file is
+    given.  Every current member receives the op; whichever becomes
+    leader first proposes it on-chain."""
+    import dataclasses
+    import os
+
+    from ..consensus.reconfig import ReconfigOp, newest_epoch
+    from ..consensus.wire import encode_reconfig
+    from ..crypto import Digest
+    from ..crypto.scheme import make_signing_service
+    from ..network import SimpleSender
+
+    current = read_committee(args.committee)
+    new_com = read_committee(args.new_committee)
+    if hasattr(new_com, "entries"):  # a schedule file: its newest epoch
+        new_com = new_com.committees()[-1]
+    secret = Secret.read(args.keys)
+    epoch = (
+        args.epoch
+        if args.epoch is not None
+        else max(new_com.epoch, newest_epoch(current) + 1)
+    )
+    if epoch != new_com.epoch:
+        new_com = dataclasses.replace(new_com, epoch=epoch)
+    margin = (
+        args.margin
+        if args.margin is not None
+        else int(os.environ.get("HOTSTUFF_RECONFIG_MARGIN", "8"))
+    )
+    op = ReconfigOp(new_committee=new_com, margin=margin, sponsor=secret.name)
+    service = make_signing_service(secret.scheme, secret.secret)
+    op.signature = await service.request_signature(Digest(op.digest()))
+    frame = encode_reconfig(op)
+    sender = SimpleSender()
+    targets = [
+        current.address(nm)
+        for nm in current.authorities
+        if current.address(nm) is not None
+    ]
+    log.info(
+        "Submitting %r (margin %d) to %d current members",
+        op,
+        margin,
+        len(targets),
+    )
+    for address in targets:
+        await sender.send(address, frame)
+    # fire-and-forget senders queue frames; give the connections a
+    # moment to flush before tearing the process down
+    await asyncio.sleep(float(args.linger))
+    sender.close()
+    return 0
 
 
 def _raise_fd_limit(target: int) -> None:
@@ -403,7 +462,7 @@ async def _run_many(args) -> None:
     if len(nodes) >= 64:
         probe = asyncio.ensure_future(_fd_probe())
     try:
-        await asyncio.gather(*(n.analyze_block() for n in nodes))
+        await asyncio.gather(*(n.serve() for n in nodes))
     finally:
         if probe is not None:
             probe.cancel()
@@ -457,7 +516,7 @@ async def _deploy_testbed(
         booted.append(node)
     log.info("Deployed %d-node local testbed on base port %d", nodes, base_port)
     _freeze_boot_objects()
-    await asyncio.gather(*(n.analyze_block() for n in booted))
+    await asyncio.gather(*(n.serve() for n in booted))
 
 
 def main(argv=None) -> int:
@@ -647,6 +706,51 @@ def main(argv=None) -> int:
         "--fresh-state", action="store_true", help=fresh_state_help
     )
 
+    p_rec = sub.add_parser(
+        "reconfig",
+        help="submit a signed committee reconfiguration to the live "
+        "committee (docs/RECONFIG.md)",
+    )
+    p_rec.add_argument(
+        "--keys",
+        required=True,
+        help="key file of the sponsoring CURRENT member",
+    )
+    p_rec.add_argument(
+        "--committee",
+        required=True,
+        help="the current committee (or schedule) file — submission "
+        "targets and epoch numbering",
+    )
+    p_rec.add_argument(
+        "--new-committee",
+        required=True,
+        help="committee file holding the NEXT epoch's full membership",
+    )
+    p_rec.add_argument(
+        "--margin",
+        type=int,
+        default=None,
+        metavar="N",
+        help="activation margin Δ in rounds after the commit (default "
+        "8, or the HOTSTUFF_RECONFIG_MARGIN env knob)",
+    )
+    p_rec.add_argument(
+        "--epoch",
+        type=int,
+        default=None,
+        metavar="N",
+        help="override the new committee's epoch number (default: "
+        "newest known epoch + 1)",
+    )
+    p_rec.add_argument(
+        "--linger",
+        type=float,
+        default=1.0,
+        metavar="S",
+        help="seconds to keep the submission connections open (flush)",
+    )
+
     p_dep = sub.add_parser("deploy", help="deploy a local testbed")
     p_dep.add_argument("--nodes", type=int, required=True)
     p_dep.add_argument("--base-port", type=int, default=25_200)
@@ -704,6 +808,8 @@ def main(argv=None) -> int:
         read_committee(args.committee)
         asyncio.run(_run_many(args))
         return 0
+    if args.command == "reconfig":
+        return asyncio.run(_submit_reconfig(args))
     if args.command == "deploy":
         _apply_fault_plane(args)
         _apply_adversary(args)

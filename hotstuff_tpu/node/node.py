@@ -245,6 +245,14 @@ class Node:
     ) -> "Node":
         self = cls()
         committee = read_committee(committee_file)
+        # Live reconfiguration (docs/RECONFIG.md) needs a spliceable
+        # schedule: a bare committee file is promoted to a
+        # single-entry schedule so a committed epoch change can extend
+        # it at runtime.  for_round keeps every consumer oblivious.
+        if not hasattr(committee, "splice"):
+            from ..consensus.config import CommitteeSchedule
+
+            committee = CommitteeSchedule([(1, committee)])
         secret = Secret.read(key_file)
         schemes = {c.scheme for c in committee.committees()}
         if len(schemes) == 1:
@@ -286,7 +294,14 @@ class Node:
         # old boot-time blanket wipe unnecessary on the happy path.
         # HOTSTUFF_FRESH_STATE=1 (--fresh-state) stays as the escape
         # hatch to force a clean slate regardless of provenance.
-        chash = committee_hash(committee)
+        #
+        # The hash anchors on the GENESIS-era committee only: under live
+        # reconfiguration the on-disk file stays the genesis artifact
+        # while the store's schedule legitimately evolves past it — the
+        # evolution itself is re-proven at boot from the certified
+        # schedule links persisted at each commit (verified-successor
+        # acceptance, below), not trusted from the provenance tag.
+        chash = committee_hash(committee.committees()[0])
         # lint: allow(no-blocking-in-async) -- one-time boot path: the
         # node serves no traffic until new() returns, so a synchronous
         # engine read cannot stall a live round
@@ -318,6 +333,37 @@ class Node:
             verifier = make_dual_verifier(
                 lambda s: make_verifier(verifier_backend, s)
             )
+        # Verified-successor acceptance: replay the certified schedule
+        # links a previous process lifetime persisted (core commit path,
+        # SCHEDULE_LINKS_KEY) so a restart resumes with the same epoch
+        # schedule it shut down with — each link is re-verified against
+        # the schedule as extended so far, never trusted from disk.
+        from ..consensus.core import SCHEDULE_LINKS_KEY
+        from ..consensus.reconfig import splice_schedule_links
+        from ..consensus.wire import decode_schedule_links
+
+        # lint: allow(no-blocking-in-async) -- same one-time boot path
+        raw_links = self.store.engine.get(SCHEDULE_LINKS_KEY)
+        if raw_links:
+            from ..consensus.errors import InvalidReconfig
+            from ..utils.codec import CodecError
+
+            try:
+                n = splice_schedule_links(
+                    decode_schedule_links(raw_links),
+                    committee,
+                    verifier,
+                    log=log,
+                )
+                if n:
+                    log.info(
+                        "Replayed %d certified schedule links from the "
+                        "store (newest epoch %d)",
+                        n,
+                        max(c.epoch for c in committee.committees()),
+                    )
+            except (CodecError, InvalidReconfig) as e:
+                log.warning("Ignoring persisted schedule links: %s", e)
         if hasattr(verifier, "precompute"):
             # warm the TPU backend's committee point cache (epoch setup)
             verifier.precompute(
@@ -464,6 +510,31 @@ class Node:
         while True:
             _block = await self.commit.get()
             # Here the application would execute the committed payload.
+
+    async def serve(self) -> None:
+        """Drain commits until the core retires — a committed epoch
+        change excluded this node and its grace window elapsed
+        (docs/RECONFIG.md) — then linger briefly so straggling peers can
+        still fetch boundary certificates and snapshots, and shut down
+        cleanly.  Nodes that are never voted out serve forever."""
+        drain = asyncio.ensure_future(self.analyze_block())
+        try:
+            core = self.consensus.core
+            while not getattr(core, "retired", False):
+                await asyncio.sleep(0.5)
+            linger = float(
+                os.environ.get("HOTSTUFF_RECONFIG_LINGER_S", "5") or 5
+            )
+            log.info(
+                "Core retired; lingering %.1f s for boundary sync "
+                "before shutdown",
+                linger,
+            )
+            await asyncio.sleep(linger)
+        finally:
+            drain.cancel()
+        await self.shutdown()
+        log.info("Node retired cleanly")
 
     async def shutdown(self) -> None:
         for attr in ("_stats_task", "_snapshot_task", "_health_task"):

@@ -61,6 +61,7 @@ HEALTH_KINDS: tuple = (
     "straggler",
     "shed_storm",
     "root_divergence",
+    "epoch_skew",
 )
 
 # ---- delta-frame wire format ----------------------------------------------
@@ -428,6 +429,40 @@ def root_divergence(roots_by_node: dict) -> list:
     return out
 
 
+def epoch_skew(epochs_by_node: dict) -> list:
+    """Committee-epoch disagreement across the live fleet (ISSUE 14):
+    every node's ``core_epoch`` gauge should match once a
+    reconfiguration boundary has passed — a node stuck on an older
+    epoch missed (or refused) a certified schedule splice and will stop
+    verifying new-epoch certificates.
+
+    ``epochs_by_node``: node -> reported active epoch.  Fires one
+    fleet-wide crit incident naming the head epoch and every laggard.
+    A skew is legitimate only for the instants nodes cross the boundary
+    a round apart, so callers tolerate one-tick flaps; a *persisting*
+    incident is the real signal.
+    """
+    fresh = {
+        name: int(e) for name, e in epochs_by_node.items() if e is not None
+    }
+    if len(fresh) < 2:
+        return []
+    head = max(fresh.values())
+    laggards = {n: e for n, e in sorted(fresh.items()) if e < head}
+    if not laggards:
+        return []
+    detail = ", ".join(f"{n}@{e}" for n, e in laggards.items())
+    return [
+        Incident(
+            "epoch_skew",
+            "",
+            "crit",
+            f"fleet head epoch {head}, trailing: {detail}",
+            float(head),
+        )
+    ]
+
+
 # ---- campaign recorder -----------------------------------------------------
 
 CAMPAIGN_SUFFIX = "-campaign.json"
@@ -662,6 +697,7 @@ __all__ = [
     "straggler",
     "shed_storm",
     "root_divergence",
+    "epoch_skew",
     "CampaignRecorder",
     "HealthMonitor",
 ]

@@ -105,6 +105,7 @@ CONTROL_EDGES: tuple = (
     "recv.sync_req",
     "recv.state_req",
     "state.apply",
+    "recv.reconfig",
 )
 
 #: producer-channel edges: leader-side payload wait attribution
@@ -120,15 +121,24 @@ INGEST_EDGES: tuple = ("ingest.shed", "ingest.credit")
 MISC_EDGES: tuple = ("timeout", "span", "meta")
 
 #: dynamic edge families: the chaos plane journals ``fault.<kind>``,
-#: the adversary plane ``byz.<kind>``, and the health plane
+#: the adversary plane ``byz.<kind>``, the health plane
 #: ``health.<kind>`` (telemetry/health.py detector incidents, open/close
-#: in the peer field) with scenario-/detector-defined kinds; an
-#: f-string edge is lint-legal iff its constant prefix is listed here
+#: in the peer field; the fleet-level ``health.epoch_skew`` rides the
+#: same family) with scenario-/detector-defined kinds, and the live
+#: reconfiguration plane ``reconfig.<step>`` (submit/commit/activate/
+#: retire/link — consensus/core.py, reconfig.py); an f-string edge is
+#: lint-legal iff its constant prefix is listed here
 FAULT_PREFIX = "fault."
 BYZ_PREFIX = "byz."
 INGEST_PREFIX = "ingest."
 HEALTH_PREFIX = "health."
-JOURNAL_EDGE_PREFIXES: tuple = (FAULT_PREFIX, BYZ_PREFIX, HEALTH_PREFIX)
+RECONFIG_PREFIX = "reconfig."
+JOURNAL_EDGE_PREFIXES: tuple = (
+    FAULT_PREFIX,
+    BYZ_PREFIX,
+    HEALTH_PREFIX,
+    RECONFIG_PREFIX,
+)
 
 #: every registered static journal edge name (what ``journal.record``
 #: call sites are checked against)
@@ -162,6 +172,7 @@ __all__ = [
     "BYZ_PREFIX",
     "INGEST_PREFIX",
     "HEALTH_PREFIX",
+    "RECONFIG_PREFIX",
     "JOURNAL_EDGE_PREFIXES",
     "JOURNAL_EDGES",
     "is_registered_edge",

@@ -25,6 +25,10 @@ class Encoder:
         self._parts.append(v.to_bytes(1, "little"))
         return self
 
+    def u16(self, v: int) -> "Encoder":
+        self._parts.append(v.to_bytes(2, "little"))
+        return self
+
     def u32(self, v: int) -> "Encoder":
         self._parts.append(v.to_bytes(4, "little"))
         return self
@@ -91,6 +95,9 @@ class Decoder:
 
     def u8(self) -> int:
         return self._take(1)[0]
+
+    def u16(self) -> int:
+        return int.from_bytes(self._take(2), "little")
 
     def u32(self) -> int:
         return int.from_bytes(self._take(4), "little")

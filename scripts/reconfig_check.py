@@ -1,0 +1,213 @@
+#!/usr/bin/env python3
+"""Live committee-reconfiguration check (docs/RECONFIG.md).
+
+Runs three canned scenarios through the production chaos runner
+(``python -m benchmark chaos``) and asserts the epoch-change contracts
+each one exists to prove:
+
+- ``reconfig-rotate`` — a live 4-node committee 2-chain commits a
+  sponsored epoch change that rotates in a freshly keyed 5th member
+  and retires member 0: run PASSes (exit 0), every node activates
+  epoch 2 at the SAME round (epoch agreement PASS), commits never
+  stall past the declared handoff bound, the joiner boots in join
+  mode, verifies the certified schedule link, and commits in its
+  first active epoch, and the retiree serves its grace window before
+  a clean ``Retired`` shutdown.
+- ``reconfig-retire-crash`` — the same rotation with a SIGKILL+rejoin
+  of a SURVIVING member straddling the boundary: everything above
+  must still hold (the restarted node replays its persisted schedule
+  links and re-activates at the same round).
+- ``byz-reconfig`` — an adversary forging unsponsored epoch changes
+  and shadow-reporting a skewed activation history: full-history
+  epoch agreement must FAIL with the divergence attributed to the
+  adversary, while the trusted-subset re-check over honest nodes
+  still PASSes and the forged ops die at validation on every honest
+  node.
+
+Exit non-zero when ANY contract breaks — including byz-reconfig's
+epoch histories "agreeing", which would mean the invariant stopped
+reading what nodes actually report.
+
+Usage:
+    python scripts/reconfig_check.py [--seed N] [--rate R] [--duration S]
+    RECONFIG=1 scripts/trace.sh           # same, via the trace wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RE_ACTIVATED = re.compile(r"Epoch (\d+) activated at round (\d+)")
+RE_LINK = re.compile(r"Verified schedule link: epoch (\d+)")
+RE_COMMITTED = re.compile(r"Committed block (\d+)")
+RE_RETIRED = re.compile(r"Retired at round (\d+) \(grace window complete\)")
+RE_FORGE = re.compile(r"byz reconfig-forge round (\d+)")
+
+
+def run_scenario(name: str, seed: int, rate: int, duration: int,
+                 extra_env: dict | None = None) -> tuple[int, str]:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "benchmark", "chaos",
+            "--scenario", name, "--seed", str(seed),
+            "--rate", str(rate), "--duration", str(duration),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=duration + 240,
+    )
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def node_logs() -> dict[str, str]:
+    out = {}
+    for path in sorted(glob.glob(os.path.join(REPO, "logs", "node-*.log"))):
+        with open(path, errors="replace") as f:
+            out[os.path.basename(path)] = f.read()
+    return out
+
+
+def check(label: str, ok: bool, detail: str = "") -> bool:
+    print(f"  [{'ok' if ok else 'FAIL'}] {label}"
+          + (f" — {detail}" if detail and not ok else ""))
+    return ok
+
+
+def check_rotation(name: str, rc: int, out: str) -> bool:
+    """The shared rotation contract: run PASSes, epoch agreement and
+    the handoff bound hold, the joiner joins, the retiree retires."""
+    failed = False
+    failed |= not check("run PASSes (exit 0)", rc == 0, f"exit {rc}")
+    failed |= not check("+ RECONFIG block rendered", "+ RECONFIG:" in out)
+    failed |= not check(
+        "epoch agreement verdict is PASS", "Epoch agreement: PASS" in out
+    )
+    m = re.search(r"Handoff gap \(bound (\d+)\): (PASS|FAIL)", out)
+    failed |= not check(
+        "handoff gap within the declared bound",
+        m is not None and m.group(2) == "PASS",
+        "no handoff-gap line" if m is None else f"verdict {m.group(2)}",
+    )
+    logs = node_logs()
+    joiner = logs.get("node-4.log", "")
+    failed |= not check(
+        "joiner booted in join mode",
+        "Join mode: key not in the committee yet" in joiner,
+    )
+    failed |= not check(
+        "joiner verified the certified schedule link",
+        bool(RE_LINK.search(joiner)),
+    )
+    # the joiner must participate, not just observe: it commits inside
+    # its first active epoch (all its commits are post-join by boot)
+    failed |= not check(
+        "joiner commits in its first active epoch",
+        bool(RE_ACTIVATED.search(joiner)) and bool(RE_COMMITTED.search(joiner)),
+        "no activation or no commit in node-4.log",
+    )
+    retiree = logs.get("node-0.log", "")
+    failed |= not check(
+        "retiree completed its grace window",
+        bool(RE_RETIRED.search(retiree)),
+        "no 'Retired at round' line in node-0.log",
+    )
+    # every node that reports the boundary reports the SAME round (the
+    # SUMMARY verdict already asserts this; re-derive from raw logs so
+    # the check does not trust its own renderer)
+    rounds = {
+        m.group(2)
+        for text in logs.values()
+        for m in RE_ACTIVATED.finditer(text)
+    }
+    failed |= not check(
+        "raw logs agree on one activation round",
+        len(rounds) == 1,
+        f"activation rounds {sorted(rounds)}",
+    )
+    return failed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=int, default=400)
+    ap.add_argument("--duration", type=int, default=30,
+                    help="per-run seconds (the runner extends past the "
+                    "reconfig event's settle window automatically)")
+    args = ap.parse_args(argv)
+
+    failed = False
+
+    print(f"=== reconfig-rotate (seed {args.seed}) ===")
+    rc, out = run_scenario("reconfig-rotate", args.seed, args.rate,
+                           args.duration)
+    failed |= check_rotation("reconfig-rotate", rc, out)
+
+    print(f"=== reconfig-retire-crash (seed {args.seed}) ===")
+    rc, out = run_scenario("reconfig-retire-crash", args.seed, args.rate,
+                           args.duration)
+    failed |= check_rotation("reconfig-retire-crash", rc, out)
+    failed |= not check(
+        "crashed member recovered (liveness PASS after heal)",
+        bool(re.search(r"Liveness \(recovery after heal.*: PASS", out)),
+    )
+
+    print(f"=== byz-reconfig (seed {args.seed}) ===")
+    rc, out = run_scenario("byz-reconfig", args.seed, args.rate,
+                           args.duration)
+    failed |= not check("run FAILs (non-zero exit)", rc != 0, f"exit {rc}")
+    failed |= not check(
+        "full-history epoch agreement is FAIL",
+        "Epoch agreement: FAIL" in out,
+    )
+    failed |= not check(
+        "divergence attributed to the adversary",
+        bool(re.search(r"epoch-activation divergence.*\[adversary:", out)),
+    )
+    failed |= not check(
+        "trusted-subset epoch agreement still PASSes",
+        "Trusted-subset epoch agreement (adversaries excluded): PASS" in out,
+    )
+    failed |= not check(
+        "safety held under forged epoch changes",
+        "Safety (no conflicting commits): PASS" in out,
+    )
+    logs = node_logs()
+    forged = sum(len(RE_FORGE.findall(t)) for t in logs.values())
+    failed |= not check(
+        "adversary actually forged reconfig ops",
+        forged > 0,
+        "no 'byz reconfig-forge' line in any node log",
+    )
+    # forged ops died at validation: no honest node ever activated an
+    # epoch past the one real rotation
+    epochs = {
+        int(m.group(1))
+        for text in logs.values()
+        for m in RE_ACTIVATED.finditer(text)
+    }
+    failed |= not check(
+        "forged ops never activated an epoch",
+        epochs <= {2},
+        f"epochs activated: {sorted(epochs)}",
+    )
+
+    print("reconfig matrix:", "FAIL" if failed else "ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,15 @@
 #                                 # in the live view AND the + HEALTH
 #                                 # SUMMARY, and the dispatch ratchet
 #                                 # holds with the plane enabled
+#   RECONFIG=1 scripts/trace.sh   # ONLY the live-reconfiguration check
+#                                 # (scripts/reconfig_check.py): rotate
+#                                 # joins node 4 / retires node 0 with
+#                                 # epoch agreement + bounded handoff
+#                                 # gap, the rotation survives a
+#                                 # SIGKILL+rejoin across the boundary,
+#                                 # and byz-reconfig FAILs full-history
+#                                 # epoch agreement (trusted subset
+#                                 # PASSes); non-zero exit on any break
 #   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
 #                                 # (scripts/analysis_check.py): every
 #                                 # hotstuff_tpu/analysis lint rule,
@@ -98,6 +107,11 @@ fi
 if [ "${HEALTH:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/health_check.py "$@"
+fi
+
+if [ "${RECONFIG:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/reconfig_check.py "$@"
 fi
 
 if [ "${LINT:-0}" = "1" ]; then

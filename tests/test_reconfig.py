@@ -1,0 +1,524 @@
+"""Consensus-driven live reconfiguration (docs/RECONFIG.md): the typed
+epoch-change op's codec and validation gate, schedule splicing, the
+certified schedule-link walk joiners and restarts replay, the
+epoch-boundary view-change backoff reset, and the reconfiguration
+invariants the chaos harness applies to run logs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from hotstuff_tpu.consensus import (
+    QC,
+    Committee,
+    CommitteeSchedule,
+    Core,
+    Synchronizer,
+    Vote,
+)
+from hotstuff_tpu.consensus.config import Authority, InvalidCommittee
+from hotstuff_tpu.consensus.core import make_event_channels
+from hotstuff_tpu.consensus.errors import InvalidReconfig
+from hotstuff_tpu.consensus.leader import LeaderElector
+from hotstuff_tpu.consensus.messages import Block
+from hotstuff_tpu.consensus.reconfig import (
+    MAX_RECONFIG_MEMBERS,
+    RECONFIG_MAX_MARGIN,
+    RECONFIG_MIN_MARGIN,
+    ReconfigOp,
+    newest_epoch,
+    splice_schedule_links,
+    validate_reconfig,
+)
+from hotstuff_tpu.consensus.wire import (
+    MAX_SCHEDULE_LINKS,
+    decode_schedule_links,
+    encode_schedule_links,
+)
+from hotstuff_tpu.crypto import (
+    Digest,
+    Signature,
+    SignatureService,
+    generate_keypair,
+)
+from hotstuff_tpu.crypto.service import CpuVerifier
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.codec import CodecError, Encoder
+
+from .common import SEED, async_test, fresh_base_port
+
+MARGIN = 4
+
+
+def five_keys():
+    pairs = [generate_keypair(SEED, i) for i in range(5)]
+    pairs.sort(key=lambda kp: kp[0])
+    return pairs
+
+
+def epoch1_committee(base: int, ks):
+    return Committee.new(
+        [(ks[i][0], 1, ("127.0.0.1", base + i)) for i in range(4)], epoch=1
+    )
+
+
+def epoch2_committee(base: int, ks):
+    """Member 3 rotates out, member 4 in."""
+    return Committee.new(
+        [(ks[i][0], 1, ("127.0.0.1", base + i)) for i in (0, 1, 2, 4)],
+        epoch=2,
+    )
+
+
+def sponsored_op(new_committee, sponsor_pair, margin: int = MARGIN):
+    pk, sk = sponsor_pair
+    op = ReconfigOp(new_committee=new_committee, margin=margin, sponsor=pk)
+    op.signature = Signature.new(Digest(op.digest()), sk)
+    return op
+
+
+def reconfig_block(op, author_pair, round_: int) -> Block:
+    pk, sk = author_pair
+    block = Block(qc=QC.genesis(), author=pk, round=round_, reconfig=op)
+    block.signature = Signature.new(block.digest(), sk)
+    return block
+
+
+def qc_over(block: Block, ks) -> QC:
+    """3-of-4 epoch-1 quorum over ``block``."""
+    vote_digest = Vote.for_block(block, ks[0][0]).digest()
+    return QC(
+        hash=block.digest(),
+        round=block.round,
+        votes=[(pk, Signature.new(vote_digest, sk)) for pk, sk in ks[:3]],
+    )
+
+
+# ---- op codec ---------------------------------------------------------------
+
+
+def test_op_serialize_roundtrip():
+    ks = five_keys()
+    op = sponsored_op(epoch2_committee(9_300, ks), ks[0])
+    again = ReconfigOp.deserialize(op.serialize())
+    assert again.margin == op.margin
+    assert again.sponsor == op.sponsor
+    assert again.signature == op.signature
+    assert again.new_committee.epoch == 2
+    assert again.new_committee.scheme == "ed25519"
+    assert again.new_committee.sorted_keys() == op.new_committee.sorted_keys()
+    for name in op.new_committee.authorities:
+        assert again.new_committee.address(name) == op.new_committee.address(
+            name
+        )
+        assert again.new_committee.stake(name) == 1
+    # the digest covers the body only, so the round-trip preserves it
+    # and the sponsor signature still verifies
+    assert again.digest() == op.digest()
+    assert CpuVerifier().verify_one(
+        Digest(again.digest()), again.sponsor, again.signature
+    )
+
+
+def test_op_decode_rejects_unknown_version():
+    ks = five_keys()
+    data = bytearray(sponsored_op(epoch2_committee(9_310, ks), ks[0]).serialize())
+    data[0] = 0xFE
+    with pytest.raises(CodecError, match="unknown reconfig op version"):
+        ReconfigOp.deserialize(bytes(data))
+
+
+def test_op_decode_caps_member_count():
+    """A forged count field dies at the cap BEFORE any member reads."""
+    ks = five_keys()
+    op = sponsored_op(epoch2_committee(9_320, ks), ks[0])
+    enc = Encoder()
+    enc.u8(1)
+    enc.u64(2)
+    enc.var_bytes(b"ed25519")
+    enc.u16(MAX_RECONFIG_MEMBERS + 1)
+    with pytest.raises(CodecError, match="exceeds cap"):
+        ReconfigOp.deserialize(enc.finish() + op.serialize())
+
+
+def test_schedule_links_codec_roundtrip_and_cap():
+    links = [(b"block-%d" % i, b"qc-%d" % i) for i in range(3)]
+    assert decode_schedule_links(encode_schedule_links(links)) == links
+    assert decode_schedule_links(encode_schedule_links([])) == []
+    bomb = [(b"b", b"q")] * (MAX_SCHEDULE_LINKS + 1)
+    with pytest.raises(CodecError, match="exceeds cap"):
+        decode_schedule_links(encode_schedule_links(bomb))
+
+
+# ---- the validation gate ----------------------------------------------------
+
+
+def test_validate_accepts_a_well_formed_op():
+    ks = five_keys()
+    schedule = CommitteeSchedule([(1, epoch1_committee(9_330, ks))])
+    op = sponsored_op(epoch2_committee(9_330, ks), ks[0])
+    validate_reconfig(op, schedule, 5, verifier=CpuVerifier())
+    assert newest_epoch(schedule) == 1
+
+
+def test_validate_rejects_margin_out_of_bounds():
+    ks = five_keys()
+    schedule = CommitteeSchedule([(1, epoch1_committee(9_340, ks))])
+    for margin in (0, RECONFIG_MIN_MARGIN - 1, RECONFIG_MAX_MARGIN + 1):
+        op = sponsored_op(epoch2_committee(9_340, ks), ks[0], margin=margin)
+        with pytest.raises(InvalidReconfig, match="activation margin"):
+            validate_reconfig(op, schedule, 5)
+
+
+def test_validate_rejects_malformed_committees():
+    ks = five_keys()
+    current = epoch1_committee(9_350, ks)
+    schedule = CommitteeSchedule([(1, current)])
+
+    empty = Committee(authorities={}, epoch=2, scheme="ed25519")
+    with pytest.raises(InvalidReconfig, match="empty"):
+        validate_reconfig(sponsored_op(empty, ks[0]), schedule, 5)
+
+    zero_stake = Committee(
+        authorities={
+            pk: Authority(1 if i else 0, ("127.0.0.1", 9_350 + i))
+            for i, (pk, _) in enumerate(ks[:4])
+        },
+        epoch=2,
+        scheme="ed25519",
+    )
+    with pytest.raises(InvalidReconfig, match="zero-stake"):
+        validate_reconfig(sponsored_op(zero_stake, ks[0]), schedule, 5)
+
+    skipped = Committee(
+        authorities=dict(current.authorities), epoch=3, scheme="ed25519"
+    )
+    with pytest.raises(InvalidReconfig, match="does not succeed"):
+        validate_reconfig(sponsored_op(skipped, ks[0]), schedule, 5)
+
+
+def test_validate_rejects_attacker_only_committee():
+    """A structurally valid committee of all-fresh keys fails the
+    carried-over-stake continuity rule."""
+    ks = five_keys()
+    schedule = CommitteeSchedule([(1, epoch1_committee(9_360, ks))])
+    strangers = [generate_keypair(b"\x42" * 32, i) for i in range(4)]
+    foreign = Committee.new(
+        [(pk, 1, ("10.0.0.1", 9_000 + i)) for i, (pk, _) in enumerate(strangers)],
+        epoch=2,
+    )
+    with pytest.raises(InvalidReconfig, match="carried-over stake"):
+        validate_reconfig(sponsored_op(foreign, ks[0]), schedule, 5)
+
+
+def test_validate_rejects_bad_sponsor():
+    ks = five_keys()
+    schedule = CommitteeSchedule([(1, epoch1_committee(9_370, ks))])
+    new = epoch2_committee(9_370, ks)
+
+    # a non-member sponsor is refused before any signature check
+    stranger = generate_keypair(b"\x43" * 32, 0)
+    with pytest.raises(InvalidReconfig, match="sponsor"):
+        validate_reconfig(sponsored_op(new, stranger), schedule, 5)
+
+    # a member sponsor with a forged signature dies at the verifier
+    op = sponsored_op(new, ks[0])
+    op.signature = Signature.new(Digest(op.digest()), ks[1][1])  # wrong key
+    with pytest.raises(InvalidReconfig, match="bad sponsor signature"):
+        validate_reconfig(op, schedule, 5, verifier=CpuVerifier())
+    # ... but passes the structural gate when no verifier is supplied
+    validate_reconfig(op, schedule, 5)
+
+
+def test_block_verify_gates_the_embedded_op():
+    """A block carrying an epoch change is verified as a unit: the op is
+    covered by the block digest and re-validated inside Block.verify, so
+    a forged reconfiguration never earns an honest vote."""
+    ks = five_keys()
+    schedule = CommitteeSchedule([(1, epoch1_committee(9_380, ks))])
+    verifier = CpuVerifier()
+
+    op = sponsored_op(epoch2_committee(9_380, ks), ks[0])
+    block = reconfig_block(op, ks[1], round_=3)
+    block.verify(schedule, verifier)
+    # the op digest is part of the block digest
+    plain = Block(qc=QC.genesis(), author=ks[1][0], round=3)
+    assert block.digest() != plain.digest()
+    # wire round-trip preserves the op and still verifies
+    again = Block.deserialize(block.serialize())
+    assert again.reconfig is not None
+    assert again.reconfig.digest() == op.digest()
+    again.verify(schedule, verifier)
+
+    forged = sponsored_op(epoch2_committee(9_380, ks), ks[0])
+    forged.signature = Signature.new(Digest(forged.digest()), ks[1][1])
+    bad = reconfig_block(forged, ks[1], round_=3)
+    with pytest.raises(InvalidReconfig):
+        bad.verify(schedule, verifier)
+
+
+# ---- splicing and the certified-link walk ----------------------------------
+
+
+def test_splice_is_idempotent_and_monotonic():
+    ks = five_keys()
+    epoch1 = epoch1_committee(9_390, ks)
+    epoch2 = epoch2_committee(9_390, ks)
+    schedule = CommitteeSchedule([(1, epoch1)])
+    gen = schedule.generation
+
+    assert schedule.splice(10, epoch2) is True
+    assert schedule.generation == gen + 1
+    assert schedule.for_round(9) is epoch1
+    assert schedule.for_round(10) is epoch2
+    # exact replay (crash-recovery re-commit): no-op, no generation bump
+    assert schedule.splice(10, epoch2) is False
+    assert schedule.generation == gen + 1
+    # genuinely conflicting splices are refused
+    with pytest.raises(InvalidCommittee):
+        schedule.splice(8, Committee(
+            authorities=dict(epoch2.authorities), epoch=3, scheme="ed25519"
+        ))
+    with pytest.raises(InvalidCommittee):
+        schedule.splice(20, epoch1)  # non-monotonic epoch
+
+
+def test_splice_schedule_links_walk():
+    """The verified-successor walk: a joiner holding only the genesis
+    committee replays a certified (block, QC) chain into the same
+    schedule a live witness holds — and rejects tampered links."""
+    ks = five_keys()
+    base = 9_400
+    verifier = CpuVerifier()
+    epoch2 = epoch2_committee(base, ks)
+    op = sponsored_op(epoch2, ks[0])
+    block = reconfig_block(op, ks[1], round_=6)
+    qc = qc_over(block, ks)
+    enc = Encoder()
+    qc.encode(enc)
+    links = [(block.serialize(), enc.finish())]
+
+    joiner = CommitteeSchedule([(1, epoch1_committee(base, ks))])
+    assert splice_schedule_links(links, joiner, verifier) == 1
+    assert joiner.for_round(6 + MARGIN).epoch == 2
+    assert joiner.for_round(6 + MARGIN - 1).epoch == 1
+    # replay: already-spliced epochs are skipped, not re-validated
+    assert splice_schedule_links(links, joiner, verifier) == 0
+
+    # a QC that does not certify the link's block is rejected
+    other = reconfig_block(op, ks[2], round_=6)
+    enc = Encoder()
+    qc_over(other, ks).encode(enc)
+    fresh = CommitteeSchedule([(1, epoch1_committee(base, ks))])
+    with pytest.raises(InvalidReconfig, match="does not certify"):
+        splice_schedule_links([(block.serialize(), enc.finish())], fresh, verifier)
+
+    # a sub-quorum certificate is rejected too
+    weak = QC(hash=qc.hash, round=qc.round, votes=qc.votes[:2])
+    enc = Encoder()
+    weak.encode(enc)
+    fresh = CommitteeSchedule([(1, epoch1_committee(base, ks))])
+    with pytest.raises(InvalidReconfig, match="failed to verify"):
+        splice_schedule_links([(block.serialize(), enc.finish())], fresh, verifier)
+
+    # corrupt bytes are a clean typed error, never a crash
+    fresh = CommitteeSchedule([(1, epoch1_committee(base, ks))])
+    with pytest.raises(InvalidReconfig, match="corrupt"):
+        splice_schedule_links([(b"\x00\x01", b"\x02")], fresh, verifier)
+
+    # a static committee cannot accept links at all
+    with pytest.raises(InvalidReconfig, match="static committee"):
+        splice_schedule_links(links, epoch1_committee(base, ks), verifier)
+
+
+# ---- the epoch-boundary backoff reset (bugfix) ------------------------------
+
+
+def make_core(tmp_path, schedule, name, secret, timeout_ms=10_000):
+    store = Store(str(tmp_path / "db"))
+    rx_events, rx_message, loopback = make_event_channels(2_000)
+    sync = Synchronizer(name, schedule, store, loopback, 10_000)
+    core = Core(
+        name,
+        schedule,
+        SignatureService(secret),
+        CpuVerifier(),
+        store,
+        LeaderElector(schedule),
+        sync,
+        timeout_ms,
+        rx_events=rx_events,
+        rx_loopback=loopback,
+        tx_proposer=asyncio.Queue(),
+        tx_commit=asyncio.Queue(),
+    )
+    return SimpleNamespace(core=core, store=store, sync=sync)
+
+
+@async_test
+async def test_backoff_exponent_resets_on_epoch_activation(tmp_path):
+    """Bugfix coverage: a backed-off view-change timer carried across an
+    epoch boundary measured the OLD committee's liveness trouble — the
+    boundary must snap it back to base, exactly like a QC advance."""
+    ks = five_keys()
+    base = fresh_base_port()
+    schedule = CommitteeSchedule(
+        [(1, epoch1_committee(base, ks)), (10, epoch2_committee(base, ks))]
+    )
+    h = make_core(tmp_path, schedule, ks[0][0], ks[0][1])
+    try:
+        core = h.core
+        core.round = 9
+        core._active_epoch = 1
+        core._timeout_exponent = 3
+        core._consecutive_tcs = 3
+        core.timer.set_duration_ms(80_000)
+
+        core._maybe_activate_epoch()  # same epoch: backoff untouched
+        assert core._timeout_exponent == 3
+        assert core._active_epoch == 1
+
+        core.round = 10
+        core._maybe_activate_epoch()
+        assert core._active_epoch == 2
+        assert core._timeout_exponent == 0
+        assert core._consecutive_tcs == 0
+        assert core.timer.duration == pytest.approx(10_000 / 1000.0)
+        # still a member of epoch 2: no retirement scheduled
+        assert core._retire_after is None
+    finally:
+        h.core.shutdown()
+        h.sync.shutdown()
+        h.store.close()
+
+
+@async_test
+async def test_excluded_member_schedules_retirement(tmp_path):
+    """Crossing into an epoch that drops this node arms the grace-window
+    retirement instead of an abrupt exit."""
+    ks = five_keys()
+    base = fresh_base_port()
+    schedule = CommitteeSchedule(
+        [(1, epoch1_committee(base, ks)), (10, epoch2_committee(base, ks))]
+    )
+    # member 3 is rotated out at round 10
+    h = make_core(tmp_path, schedule, ks[3][0], ks[3][1])
+    try:
+        core = h.core
+        core._active_epoch = 1
+        core.round = 10
+        core._maybe_activate_epoch()
+        assert core._retire_after == 10 + core._grace_rounds
+        assert core.retired is False
+    finally:
+        h.core.shutdown()
+        h.sync.shutdown()
+        h.store.close()
+
+
+# ---- run-log invariants (benchmark/invariants.py, telemetry/health.py) ------
+
+
+def test_epoch_agreement_invariant():
+    from benchmark.invariants import check_epoch_agreement
+
+    ok, viol, details = check_epoch_agreement({})
+    assert ok is None and not viol
+
+    ok, viol, details = check_epoch_agreement(
+        {"node-0": [(2, 20)], "node-1": [(2, 20)], "node-2": [(2, 20)]}
+    )
+    assert ok is True and not viol
+    assert details["max_epoch"] == 2
+
+    ok, viol, _ = check_epoch_agreement(
+        {"node-0": [(2, 20)], "node-1": [(2, 23)]}
+    )
+    assert ok is False
+    assert any("epoch 2" in v for v in viol)
+
+    # a node re-activating the same epoch at a different round (restart
+    # replaying a divergent history) is a violation too
+    ok, viol, _ = check_epoch_agreement({"node-0": [(2, 20), (2, 21)]})
+    assert ok is False
+
+
+def test_handoff_gap_invariant():
+    from benchmark.invariants import check_handoff_gap
+
+    commits = {
+        "node-0": [(0.0, r, "d") for r in (17, 18, 19, 23, 24)],
+        "node-1": [(0.0, r, "d") for r in (18, 19, 23)],
+    }
+    epochs = {"node-0": [(2, 20)], "node-1": [(2, 20)]}
+
+    ok, viol, details = check_handoff_gap(commits, epochs, bound=8)
+    assert ok is True and not viol
+    assert details["max_gap"] == 4  # 23 - 19 across the boundary at 20
+
+    ok, viol, _ = check_handoff_gap(commits, epochs, bound=3)
+    assert ok is False
+
+    # a shadow reporter cannot move the modal boundary, and untrusted
+    # observations are dropped entirely
+    skewed = dict(epochs)
+    skewed["node-2"] = [(2, 27)]
+    ok, _, details = check_handoff_gap(
+        commits, skewed, bound=8, untrusted={"node-2"}
+    )
+    assert ok is True and details["max_gap"] == 4
+
+    # no commit at/after the boundary = a stalled handoff
+    stalled = {"node-0": [(0.0, r, "d") for r in (17, 18, 19)]}
+    ok, viol, _ = check_handoff_gap(stalled, epochs, bound=8)
+    assert ok is False
+    assert any("stall" in v for v in viol)
+
+    ok, _, _ = check_handoff_gap(commits, {}, bound=8)
+    assert ok is None
+
+
+def test_epoch_skew_health_detector():
+    from hotstuff_tpu.telemetry.health import epoch_skew
+
+    assert epoch_skew({}) == []
+    assert epoch_skew({"node-0": 2}) == []
+    assert epoch_skew({"node-0": 2, "node-1": 2}) == []
+
+    fired = epoch_skew({"node-0": 2, "node-1": 1, "node-2": None})
+    assert len(fired) == 1
+    incident = fired[0]
+    assert incident.kind == "epoch_skew"
+    assert incident.severity == "crit"
+    assert "node-1@1" in incident.detail
+
+
+def test_summary_epoch_lines():
+    """The SUMMARY surfaces epoch transitions and the boundary commit
+    gap (benchmark/logs.py plumbing, driven without real log files)."""
+    from benchmark.logs import LogParser, RE_EPOCH
+
+    line = (
+        "(2026-08-05T12:00:01.123Z) [2026-08-05 12:00:01,123] INFO "
+        "Epoch 2 activated at round 20"
+    )
+    assert RE_EPOCH.findall(line) == [("2026-08-05T12:00:01.123", "2", "20")]
+
+    parser = LogParser.__new__(LogParser)
+    parser.epoch_activations = {2: {20}}
+    parser.commits = {f"b{r}": float(r) for r in (17, 18, 19, 23)}
+    parser.block_round = {f"b{r}": r for r in (17, 18, 19, 23)}
+    gap = parser.epoch_boundary_gap()
+    assert gap == 4
+    txt = parser._epoch_txt()
+    assert "Epoch transitions: 1" in txt
+    assert "epoch 2 at round 20" in txt
+    assert "Max commit gap across a boundary: 4" in txt
+
+    parser.epoch_activations = {}
+    assert parser.epoch_boundary_gap() is None
+    assert parser._epoch_txt() == ""

@@ -694,3 +694,171 @@ def test_ingest_ack_round_trip_and_corpus():
             decode_ingest_ack(bytes(buf))
         except SerializationError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# reconfiguration corpus (ISSUE 14): the epoch-change submission frame
+# (TAG_RECONFIG) arrives on the unauthenticated consensus port, and the
+# state-sync manifest v2 carries attacker-relayable certified schedule
+# links — both get the same decode-time-cap treatment the wire-decoder-
+# bounds lint demands: forged counts and sizes die on the count, never
+# as an allocation or a crash.
+
+
+def _reconfig_frame():
+    from hotstuff_tpu.consensus.config import Committee
+    from hotstuff_tpu.consensus.reconfig import ReconfigOp
+    from hotstuff_tpu.consensus.wire import encode_reconfig
+
+    pairs = keys()
+    new = Committee.new(
+        [
+            (pk, 1, ("127.0.0.1", 24_000 + i))
+            for i, (pk, _) in enumerate(pairs)
+        ],
+        epoch=2,
+    )
+    sponsor_pk, sponsor_sk = pairs[0]
+    op = ReconfigOp(new_committee=new, margin=8, sponsor=sponsor_pk)
+    op.signature = Signature.new(Digest(op.digest()), sponsor_sk)
+    return encode_reconfig(op), op
+
+
+def test_reconfig_frame_round_trip():
+    from hotstuff_tpu.consensus.wire import TAG_RECONFIG
+
+    frame, op = _reconfig_frame()
+    tag, decoded = decode_message(frame)
+    assert tag == TAG_RECONFIG
+    assert decoded.margin == op.margin
+    assert decoded.sponsor == op.sponsor
+    assert decoded.signature == op.signature
+    assert decoded.new_committee.epoch == 2
+    assert decoded.digest() == op.digest()
+    # the ed25519-pinned decoder accepts it too (all keys are ed25519)
+    decode_message(frame, scheme="ed25519")
+
+
+def test_reconfig_truncation_sweep():
+    frame, _ = _reconfig_frame()
+    decode_message(frame)  # sanity: the original decodes
+    for cut in range(len(frame)):
+        _decode_must_not_crash(frame[:cut])
+    _decode_must_not_crash(frame + b"\x00")  # trailing junk
+    _decode_must_not_crash(frame + frame)
+
+
+def test_reconfig_bad_version_bytes():
+    from hotstuff_tpu.consensus.reconfig import RECONFIG_OP_VERSION
+
+    frame, _ = _reconfig_frame()
+    # the op version byte sits right after the tag
+    for version in range(256):
+        if version == RECONFIG_OP_VERSION:
+            continue
+        with pytest.raises(SerializationError, match="version"):
+            decode_message(bytes([frame[0], version]) + frame[2:])
+
+
+def test_reconfig_member_count_bomb_dies_in_the_codec():
+    from hotstuff_tpu.consensus.reconfig import (
+        MAX_RECONFIG_MEMBERS,
+        RECONFIG_OP_VERSION,
+    )
+    from hotstuff_tpu.consensus.wire import TAG_RECONFIG
+    from hotstuff_tpu.utils.codec import Encoder
+
+    # a forged count past the cap is rejected on the count itself,
+    # before the first member decode
+    bomb = Encoder().u8(TAG_RECONFIG).u8(RECONFIG_OP_VERSION)
+    bomb.u64(2).var_bytes(b"ed25519").u16(MAX_RECONFIG_MEMBERS + 1)
+    with pytest.raises(SerializationError, match="exceeds cap"):
+        decode_message(bomb.finish())
+
+    # exactly AT the cap the count is legal — the absent member bytes
+    # then die as ordinary truncation, a different failure
+    at_cap = Encoder().u8(TAG_RECONFIG).u8(RECONFIG_OP_VERSION)
+    at_cap.u64(2).var_bytes(b"ed25519").u16(MAX_RECONFIG_MEMBERS)
+    with pytest.raises(SerializationError) as exc:
+        decode_message(at_cap.finish())
+    assert "exceeds cap" not in str(exc.value)
+
+    # oversized per-member fields (scheme, host, key) die on their own
+    # var_bytes caps
+    fat_scheme = Encoder().u8(TAG_RECONFIG).u8(RECONFIG_OP_VERSION)
+    fat_scheme.u64(2).var_bytes(b"x" * 64)
+    with pytest.raises(SerializationError):
+        decode_message(fat_scheme.finish())
+
+
+def test_reconfig_mutation_storm():
+    rng = random.Random(0xF030)
+    frame, _ = _reconfig_frame()
+    for _ in range(500):
+        buf = bytearray(frame)
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        _decode_must_not_crash(bytes(buf))
+    # multi-byte storms too: up to 8 flips per frame
+    for _ in range(200):
+        buf = bytearray(frame)
+        for _ in range(rng.randrange(2, 9)):
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        _decode_must_not_crash(bytes(buf))
+
+
+def test_manifest_schedule_links_corpus():
+    """Manifest v2's certified schedule links: round trip, the link-count
+    cap, and the per-link byte cap — all enforced at decode time."""
+    import struct
+
+    from hotstuff_tpu.consensus.wire import (
+        MAX_SCHEDULE_LINKS,
+        TAG_STATE_MANIFEST,
+        encode_state_manifest,
+    )
+
+    pk = keys()[0][0]
+    qc = qc_for_block(chain(1)[0])
+    links = [(b"block-bytes-%d" % i, b"qc-bytes-%d" % i) for i in range(3)]
+    frame = encode_state_manifest(
+        7, b"\x11" * 32, 42, 100, 2, 0, qc, pk, links=links
+    )
+    tag, manifest = decode_message(frame)
+    assert tag == TAG_STATE_MANIFEST
+    assert manifest.links == tuple(links)
+
+    # the encoder refuses an over-cap link list outright
+    with pytest.raises(ValueError, match="schedule links"):
+        encode_state_manifest(
+            7, b"\x11" * 32, 42, 100, 2, 0, qc, pk,
+            links=[(b"b", b"q")] * (MAX_SCHEDULE_LINKS + 1),
+        )
+
+    # a forged on-wire count dies on the count (the u16 sits where the
+    # empty-list frame ends)
+    empty = encode_state_manifest(7, b"\x11" * 32, 42, 100, 2, 0, qc, pk)
+    forged = empty[:-2] + struct.pack("<H", MAX_SCHEDULE_LINKS + 1)
+    with pytest.raises(SerializationError, match="exceeds cap"):
+        decode_message(forged)
+
+    # a link element past the byte cap dies in var_bytes, not as an
+    # allocation of attacker-chosen size
+    from hotstuff_tpu.consensus.wire import MAX_SCHEDULE_LINK_BYTES
+
+    fat = encode_state_manifest(
+        7, b"\x11" * 32, 42, 100, 2, 0, qc, pk,
+        links=[(b"\x00" * (MAX_SCHEDULE_LINK_BYTES + 1), b"q")],
+    )
+    with pytest.raises(SerializationError):
+        decode_message(fat)
+
+    # truncation sweep over the linked manifest (stride keeps it fast)
+    for cut in range(0, len(frame), max(1, len(frame) // 60)):
+        _decode_must_not_crash(frame[:cut])
+
+    # mutations never crash
+    rng = random.Random(0xF031)
+    for _ in range(300):
+        buf = bytearray(frame)
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        _decode_must_not_crash(bytes(buf))
