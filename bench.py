@@ -692,6 +692,40 @@ def bench_state(blocks_n: int = 256, per_block: int = 8) -> dict | None:
         return None
 
 
+def bench_sim(seeds: int = 16, nodes: int = 4) -> dict | None:
+    """Deterministic-simulator throughput probe (docs/SIM.md): a short
+    seeded schedule sweep through ``hotstuff_tpu.sim.run_schedule`` —
+    whole committee in one process, virtual time — measuring how fast
+    this host chews through exploration seeds.  Feeds the
+    ``sim.rounds_per_s`` (consensus rounds simulated per wall second)
+    and ``sim.seeds_per_min`` perfgate guards; returns None (key
+    omitted, guards skip) on any failure so the kernel benchmarks above
+    still publish."""
+    try:
+        from hotstuff_tpu.sim import draw_schedule, run_schedule
+
+        rounds = 0
+        t0 = time.perf_counter()
+        for seed in range(seeds):
+            verdict = run_schedule(draw_schedule(seed, nodes=nodes))
+            if not verdict.ok:
+                raise RuntimeError(
+                    f"seed {seed} failed: {verdict.failures}"
+                )
+            rounds += verdict.rounds
+        dt = time.perf_counter() - t0
+        return {
+            "seeds": seeds,
+            "nodes": nodes,
+            "rounds": rounds,
+            "rounds_per_s": round(rounds / dt, 1),
+            "seeds_per_min": round(seeds * 60.0 / dt, 1),
+        }
+    except Exception as e:  # the bench must survive a broken sim plane
+        print(f"bench_sim skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def probe_tunnel(inflight: int = 16, reps: int = 7) -> dict:
     """Tunnel weather, two views over the same tiny resident-arg jit
     call, pinned in the output so end-to-end swings between rounds are
@@ -782,6 +816,10 @@ def main() -> int:
     # failure so the perfgate state guards skip instead of failing
     state = bench_state()
 
+    # deterministic-simulator sweep throughput; key omitted on failure
+    # so the perfgate sim guards skip instead of failing
+    sim = bench_sim()
+
     print(
         json.dumps(
             {
@@ -801,6 +839,7 @@ def main() -> int:
                 "agg_qc": bench_agg_qc(),
                 **({"load": load} if load is not None else {}),
                 **({"state": state} if state is not None else {}),
+                **({"sim": sim} if sim is not None else {}),
             }
         )
     )

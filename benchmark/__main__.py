@@ -198,6 +198,67 @@ def task_chaos(args) -> int:
     return 0 if ok else 1
 
 
+def task_explore(args) -> int:
+    """Seeded schedule exploration in the deterministic simulator
+    (docs/SIM.md): each seed draws a fault/crash/reconfig schedule, runs
+    the whole committee in one process on a virtual-time loop, and
+    judges it with the production invariant stack.  Failures get a repro
+    bundle plus a greedily-shrunk minimal schedule.  Exit code 1 when
+    any seed fails its profile's expectation."""
+    import os
+    import time
+
+    from hotstuff_tpu.sim import explore
+
+    out_dir = args.out or os.path.join(
+        PathMaker.logs_path(), "sim-explore"
+    )
+    t0 = time.monotonic()
+    result = explore(
+        seeds=args.seeds,
+        nodes=args.nodes,
+        start_seed=args.start,
+        duration_s=args.duration,
+        out_dir=out_dir,
+        do_shrink=not args.no_shrink,
+        progress=Print.info,
+    )
+    dt = time.monotonic() - t0
+    print(
+        "\n"
+        "-----------------------------------------\n"
+        " EXPLORE SUMMARY:\n"
+        "-----------------------------------------\n"
+        f" Seeds: {result.seeds} (start {args.start}, {args.nodes} nodes)\n"
+        f" Passed: {result.passed}/{result.seeds} "
+        f"(honest={result.honest} byz={result.byz})\n"
+        f" Findings: {len(result.findings)}\n"
+        f" Wall-clock: {dt:.1f}s "
+        f"({dt / max(result.seeds, 1):.2f}s/seed)\n"
+        "-----------------------------------------"
+    )
+    for f in result.findings:
+        Print.error(
+            f"seed {f.seed} ({f.profile}) FAILED: "
+            + "; ".join(f.failures[:3])
+        )
+        if f.repro_dir:
+            Print.error(f"  repro bundle: {f.repro_dir}")
+        if f.minimal_events is not None:
+            kinds = ",".join(ev["kind"] for ev in f.minimal_events)
+            Print.error(
+                f"  minimal schedule: {len(f.minimal_events)} "
+                f"event(s) [{kinds}] — replay with "
+                f"`python -m benchmark explore --seeds 1 "
+                f"--start {f.seed} --nodes {args.nodes}`"
+            )
+    if result.ok:
+        Print.info("all schedules matched their profile expectations")
+    else:
+        Print.error("schedule exploration found failures")
+    return 0 if result.ok else 1
+
+
 def task_traces(args) -> int:
     """Merge flight-recorder journals into the cross-node SUMMARY block
     and a Chrome trace-event JSON (open in https://ui.perfetto.dev)."""
@@ -673,6 +734,38 @@ def main(argv=None) -> int:
     p = sub.add_parser("logs")
     p.add_argument("--dir", default=PathMaker.logs_path())
     p.set_defaults(fn=task_logs)
+
+    p = sub.add_parser(
+        "explore",
+        help="seeded schedule sweep through the deterministic "
+        "virtual-time simulator: whole committee in one process, "
+        "invariant verdict per seed, repro bundle + shrunk minimal "
+        "schedule on failure (docs/SIM.md)",
+    )
+    p.add_argument("--seeds", type=int, default=100,
+                   help="number of consecutive seeds to run")
+    p.add_argument("--start", type=int, default=0, help="first seed")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument(
+        "--duration",
+        type=float,
+        default=None,
+        help="virtual seconds per run (default: schedule-drawn; "
+        "HOTSTUFF_SIM_DURATION)",
+    )
+    p.add_argument(
+        "--out",
+        default=None,
+        metavar="DIR",
+        help="directory for failure repro bundles "
+        "(default: <logs>/sim-explore)",
+    )
+    p.add_argument(
+        "--no-shrink",
+        action="store_true",
+        help="skip greedy schedule shrinking on failure",
+    )
+    p.set_defaults(fn=task_explore)
 
     p = sub.add_parser("traces")
     p.add_argument(

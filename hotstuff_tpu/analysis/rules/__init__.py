@@ -1,6 +1,7 @@
 """The rule registry: ``ALL_RULES`` is what the CLI and the gate run."""
 
 from .blocking import NoBlockingInAsync
+from .clock_discipline import ClockDiscipline
 from .env_knobs import EnvKnobRegistry
 from .guarded_by import GuardedBy
 from .taxonomy_rule import TaxonomyRegistry
@@ -12,6 +13,7 @@ ALL_RULES = (
     TaxonomyRegistry(),
     EnvKnobRegistry(),
     GuardedBy(),
+    ClockDiscipline(),
 )
 
 __all__ = [
@@ -21,4 +23,5 @@ __all__ = [
     "TaxonomyRegistry",
     "EnvKnobRegistry",
     "GuardedBy",
+    "ClockDiscipline",
 ]

@@ -612,6 +612,40 @@ class Consensus:
 
             def make_reliable():
                 return NativeReliableSender(fault_plane=fault_plane)
+        elif transport == "sim":
+            # Virtual-time simulation (hotstuff_tpu/sim): the stock
+            # asyncio senders run verbatim — the ambient connector seam
+            # routes their connections through the in-memory SimNet —
+            # and only the listener side needs the sim class.
+            from ..network import ReliableSender, SimpleSender
+            from ..sim.transport import SimReceiver
+
+            receiver_cls = SimReceiver
+            # Virtual link propagation: without it every hop lands in
+            # the same virtual instant and rounds advance at raw CPU
+            # speed — a 12-virtual-second run would burn thousands of
+            # rounds of signature work.  A fixed per-hop delay paces the
+            # protocol like a LAN and makes per-seed CPU cost
+            # proportional to virtual duration, not host speed.
+            if link_delay is None:
+                sim_link_s = (
+                    float(os.environ.get("HOTSTUFF_SIM_LINK_MS", "50"))
+                    / 1000.0
+                )
+                if sim_link_s > 0:
+
+                    def link_delay(dst, _d=sim_link_s):
+                        return lambda: _d
+
+            def make_sender():
+                return SimpleSender(
+                    link_delay=link_delay, fault_plane=fault_plane
+                )
+
+            def make_reliable():
+                return ReliableSender(
+                    link_delay=link_delay, fault_plane=fault_plane
+                )
         else:
             from ..network import ReliableSender, SimpleSender
 

@@ -38,6 +38,7 @@ from collections import OrderedDict
 
 from ..crypto import Digest, PublicKey, SignatureService
 from ..network import ReliableSender
+from ..utils.clock import default_clock
 from .config import Committee
 from .core import ProposerMessage
 from .messages import MAX_BLOCK_PAYLOADS, QC, TC, Block, Round
@@ -178,9 +179,7 @@ class Proposer:
         while len(self.seen) > SEEN_CAP:
             self.seen.popitem(last=False)
         if self._payload_wait is not None:
-            import time
-
-            self.pending[digest] = time.monotonic()
+            self.pending[digest] = default_clock().monotonic()
         else:
             self.pending[digest] = None
 
@@ -223,9 +222,7 @@ class Proposer:
         self.last_made_round = round_
         take = min(len(self.pending), MAX_BLOCK_PAYLOADS)
         if self._payload_wait is not None and take:
-            import time
-
-            now = time.monotonic()
+            now = default_clock().monotonic()
             popped = [self.pending.popitem(last=False) for _ in range(take)]
             for _, arrived in popped:
                 if arrived:  # re-buffered orphans may carry None
@@ -299,12 +296,17 @@ class Proposer:
         # the block before making the next one.
         total_stake = com.stake(self.name)
         threshold = com.quorum_threshold()
-        pending = {
-            asyncio.ensure_future(
-                self._ack_stake(handle, com.stake(name))
-            )
+        # tasks is an ordered LIST (committee order), not a set:
+        # cancelling a waiter propagates into its ACK handle, which the
+        # reliable sender reads as "give up retransmitting this frame" —
+        # id()-ordered set iteration here made the surviving retransmit
+        # set depend on heap layout (caught by the deterministic sim's
+        # byte-identical-journal check).
+        tasks = [
+            asyncio.ensure_future(self._ack_stake(handle, com.stake(name)))
             for name, handle in handles
-        }
+        ]
+        pending = set(tasks)
         try:
             while pending and total_stake < threshold:
                 done, pending = await asyncio.wait(
@@ -316,8 +318,9 @@ class Proposer:
                     # an immediate read, never a block
                     total_stake += t.result()
         finally:
-            for t in pending:
-                t.cancel()
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
 
     async def _byz_equivocate(self, block: Block, names_addresses) -> None:
         """equivocate policy (adversary plane): sign the deterministic

@@ -37,9 +37,9 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-import time
 
 from ..crypto import PublicKey
+from ..utils.clock import default_clock
 from ..network import SimpleSender
 from ..store.state import SnapshotManifest, StateMachine
 from ..utils.codec import CodecError
@@ -69,6 +69,10 @@ SYNC_MIN_LAG_ROUNDS = 8
 #: manifest collection window and chunk-transfer deadline (seconds)
 SYNC_MANIFEST_WAIT_S = 1.0
 SYNC_CHUNK_WAIT_S = 5.0
+#: re-ask cadence for chunks still missing inside the transfer window —
+#: a chunk request is a single frame, so one drop on a faulty link must
+#: not wedge the whole sync until the deadline
+SYNC_CHUNK_RETRY_S = 1.0
 
 
 class StateSyncServer:
@@ -292,7 +296,7 @@ class StateSyncClient:
         ]
         if not peers:
             return 0
-        started = time.monotonic()
+        started = default_clock().monotonic()
         floor = max(last_committed_round, self.state.last_round)
         # delta when local state survived the crash; full otherwise
         from_round = self.state.last_round
@@ -350,10 +354,27 @@ class StateSyncClient:
             )
         entries: list = []
         deadline = loop.time() + self.chunk_wait_s
+        retry_at = loop.time() + SYNC_CHUNK_RETRY_S
         while pending:
-            msg = await self._collect(deadline)
+            msg = await self._collect(min(deadline, retry_at))
             if msg is None:
-                break
+                now = loop.time()
+                if now >= deadline:
+                    break
+                # a chunk ask is a single frame: when a faulty link eats
+                # it, only a re-ask gets the transfer moving again
+                for index in sorted(pending):
+                    await self.network.send(
+                        address,
+                        encode_state_request(
+                            STATE_REQ_CHUNK,
+                            self.name,
+                            index=index,
+                            from_round=from_round,
+                        ),
+                    )
+                retry_at = now + SYNC_CHUNK_RETRY_S
+                continue
             tag, payload = msg
             if tag != TAG_STATE_CHUNK:
                 continue
@@ -391,7 +412,7 @@ class StateSyncClient:
             self.synchronizer.join_floor = max(
                 self.synchronizer.join_floor, best.last_round
             )
-        elapsed = time.monotonic() - started
+        elapsed = default_clock().monotonic() - started
         if self._journal is not None:
             self._journal.record("sync.adopt", best.last_round)
         # NOTE: this log entry is used to compute performance.

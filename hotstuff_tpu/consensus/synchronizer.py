@@ -23,11 +23,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 from ..crypto import Digest, PublicKey
 from ..network import SimpleSender
 from ..store import Store
+from ..utils.clock import default_clock
 from .config import Committee
 from .errors import SerializationError
 from .messages import Block
@@ -85,8 +85,8 @@ class Synchronizer:
 
     async def _retry_loop(self) -> None:
         while True:
-            await asyncio.sleep(TIMER_ACCURACY_S)
-            now = time.monotonic()
+            await default_clock().sleep(TIMER_ACCURACY_S)
+            now = default_clock().monotonic()
             for digest, (asked_at, child_round, parent_round) in list(
                 self._requests.items()
             ):
@@ -171,7 +171,7 @@ class Synchronizer:
         if parent not in self._requests:
             self.log.debug("Requesting sync for block %s", parent)
             self._requests[parent] = (
-                time.monotonic(), block.round, block.qc.round
+                default_clock().monotonic(), block.round, block.qc.round
             )
             if self._journal is not None:
                 self._journal.record(

@@ -11,7 +11,8 @@ across resets.
 from __future__ import annotations
 
 import asyncio
-import time
+
+from ..utils.clock import default_clock
 
 
 class Timer:
@@ -32,7 +33,7 @@ class Timer:
     def reset(self) -> None:
         self._deadline = asyncio.get_running_loop().time() + self.duration
         self.resets += 1
-        self.armed_at_ns = time.monotonic_ns()
+        self.armed_at_ns = default_clock().monotonic_ns()
 
     def expired(self) -> bool:
         """Is the *current* deadline in the past? A ``wait()`` that completed
@@ -52,4 +53,4 @@ class Timer:
             remaining = self._deadline - loop.time()
             if remaining <= 0:
                 return
-            await asyncio.sleep(remaining)
+            await default_clock().sleep(remaining)

@@ -159,6 +159,17 @@ def precompute(pubkeys: list[bytes]) -> int:
     return int(_lib.hs_ed25519_precompute(pks, n))
 
 
+def verify_one(msg: bytes, pk: bytes, sig: bytes) -> bool:
+    """Single-signature verify through ``hs_ed25519_verify_one``.
+    Cofactored acceptance (batch-equation semantics) — callers that need
+    the cofactorless single-signature path keep OpenSSL; this is the
+    fast fallback when ``cryptography`` is absent and the alternative is
+    the pure-Python ladder (~30x slower)."""
+    if len(pk) != 32 or len(sig) != 64 or not available():
+        return False
+    return int(_lib.hs_ed25519_verify_one(msg, len(msg), pk, sig)) == 1
+
+
 def batch_verify_shared(msg: bytes, votes) -> bool:
     """All (pk_bytes, sig_bytes) pairs over one message (QC shape)."""
     n = len(votes)

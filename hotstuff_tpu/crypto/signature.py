@@ -65,6 +65,15 @@ else:
             self._pk = pk_bytes
 
         def verify(self, sig: bytes, msg: bytes) -> None:
+            # fast path: the native batch library's single-verify entry
+            # point (cofactored acceptance); pure-Python ladder only
+            # when the .so is unavailable
+            from . import native_ed25519
+
+            if native_ed25519.available():
+                if not native_ed25519.verify_one(msg, self._pk, sig):
+                    raise InvalidSignature("signature mismatch")
+                return
             from .ed25519_ref import verify as _ref_verify
 
             if not _ref_verify(sig, self._pk, msg):

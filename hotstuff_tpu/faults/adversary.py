@@ -62,8 +62,8 @@ import hashlib
 import json
 import logging
 import random
-import time
 
+from ..utils.clock import default_clock
 from .plane import _addr_key
 
 log = logging.getLogger(__name__)
@@ -150,7 +150,10 @@ class AdversaryPlane:
             r for r in self.rules
             if self.self_id is not None and self.self_id in r.nodes
         ]
-        boot = time.time() if now is None else now
+        clock = default_clock()
+        wall0 = clock.time()
+        mono0 = clock.monotonic()
+        boot = wall0 if now is None else now
         epoch = spec.get("epoch_unix")
         self.epoch = float(epoch) if epoch is not None else boot
         if self.epoch < boot - 3600.0:
@@ -159,6 +162,9 @@ class AdversaryPlane:
                 boot - self.epoch,
             )
             self.epoch = boot
+        # monotonic anchor: window arithmetic survives NTP steps
+        # (same scheme as FaultPlane — see faults/plane.py)
+        self._mono_epoch = mono0 - (wall0 - self.epoch)
         self.rng = random.Random(f"{self.seed}|adversary|{self.self_id}")
         self.counts = {
             "byz_equivocations": 0,
@@ -203,7 +209,9 @@ class AdversaryPlane:
         return bool(self.my_rules)
 
     def _t(self, now: float | None = None) -> float:
-        return (time.time() if now is None else now) - self.epoch
+        if now is None:
+            return default_clock().monotonic() - self._mono_epoch
+        return now - self.epoch
 
     def active(self, policy: str, now: float | None = None) -> bool:
         """Is ``policy`` live on THIS node at ``now``?  The collude
@@ -411,9 +419,9 @@ async def run_adversary_clock(plane: AdversaryPlane, journal=None) -> None:
     render an adversary track.  Spawned by Consensus.spawn on attacking
     nodes; cancelled at shutdown."""
     for t_rel, kind, label in plane.window_edges():
-        delay = (plane.epoch + t_rel) - time.time()
+        delay = (plane._mono_epoch + t_rel) - default_clock().monotonic()
         if delay > 0:
-            await asyncio.sleep(delay)
+            await default_clock().sleep(delay)
         log.info("Adversary window %s: %s (t=%.1fs)", kind, label, t_rel)
         if journal is not None:
             journal.record(f"byz.{kind}", 0, None, label)
@@ -439,7 +447,7 @@ async def run_flood(plane: AdversaryPlane, committee, name,
     rng = plane.rng
     try:
         while True:
-            await asyncio.sleep(FLOOD_BURST_S)
+            await default_clock().sleep(FLOOD_BURST_S)
             if not plane.active("flood"):
                 continue
             rnd = rng.randrange(1, 1 << 20)

@@ -26,12 +26,12 @@ them.
 
 from __future__ import annotations
 
-import asyncio
 import json
 import logging
 import random
-import time
 from typing import NamedTuple
+
+from ..utils.clock import default_clock
 
 log = logging.getLogger(__name__)
 
@@ -298,7 +298,10 @@ class FaultPlane:
         }
         self.self_id = self.nodes.get(_addr_key(self_address))
         self.rules, self._inbound_rules = expand_rules(spec)
-        boot = time.time() if now is None else now
+        clock = default_clock()
+        wall0 = clock.time()
+        mono0 = clock.monotonic()
+        boot = wall0 if now is None else now
         epoch = spec.get("epoch_unix")
         # a stale epoch (config written long before boot, or clock skew)
         # would put the whole timeline in the past; fall back to boot
@@ -309,6 +312,11 @@ class FaultPlane:
                 boot - self.epoch,
             )
             self.epoch = boot
+        # Anchor the window timeline to the MONOTONIC clock: the wall
+        # epoch is only used once, here, to compute the monotonic value
+        # of scenario t=0.  An NTP step after construction can therefore
+        # never shift partition/heal windows mid-run.
+        self._mono_epoch = mono0 - (wall0 - self.epoch)
         self.counts = {
             "dropped": 0,
             "delayed": 0,
@@ -336,7 +344,9 @@ class FaultPlane:
         return cls(spec, self_address, now=now)
 
     def _t(self, now: float | None = None) -> float:
-        return (time.time() if now is None else now) - self.epoch
+        if now is None:
+            return default_clock().monotonic() - self._mono_epoch
+        return now - self.epoch
 
     def describe(self) -> str:
         return (
@@ -408,9 +418,9 @@ async def run_clock(plane: FaultPlane, journal=None) -> None:
     traces (benchmark/traces.py) render partition spans.  Spawned by
     Consensus.spawn when a plane is active; cancelled at shutdown."""
     for t_rel, kind, label in plane.window_edges():
-        delay = (plane.epoch + t_rel) - time.time()
+        delay = (plane._mono_epoch + t_rel) - default_clock().monotonic()
         if delay > 0:
-            await asyncio.sleep(delay)
+            await default_clock().sleep(delay)
         log.info("Fault window %s: %s (t=%.1fs)", kind, label, t_rel)
         if journal is not None:
             journal.record(f"fault.{kind}", 0, None, label)

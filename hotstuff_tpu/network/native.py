@@ -392,6 +392,8 @@ def _resolve(host: str) -> str | None:
     except ValueError:
         pass
     hit = _RESOLVE_CACHE.get(host)
+    # lint: allow(clock-discipline) -- DNS-cache TTL on the native
+    # transport path; the simulator only drives transport="sim"
     now = time.monotonic()
     if hit is not None and (hit[0] is not None or now < hit[1]):
         return hit[0]
@@ -484,6 +486,8 @@ class NativeSimpleSender:
     ) -> None:
         import random
 
+        # lint: allow(clock-discipline) -- native-transport-only helper;
+        # the sim's lucky_broadcast runs the asyncio sender via the seam
         for address in random.sample(addresses, min(nodes, len(addresses))):
             await self.send(address, payload)
 
@@ -646,6 +650,8 @@ class NativeReliableSender:
             # ReliableSender._run): spread post-heal reconnects
             if delay > self.RETRY_DELAY_S:
                 self.jittered_retries += 1
+                # lint: allow(clock-discipline) -- reconnect jitter on
+                # the native reactor; never runs under the simulator
                 delay = random.uniform(0, delay)
             if self._retry_handle.get(pid) is None:
                 self._retry_handle[pid] = asyncio.get_running_loop().call_later(

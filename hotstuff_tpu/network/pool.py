@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import asyncio
 
+from ..utils.clock import default_clock
+
 
 class BoundedPoolMixin:
     _connections: dict
@@ -75,7 +77,7 @@ class BoundedPoolMixin:
 
         async def sweep():
             while len(self._connections) > self._max_conns:
-                await asyncio.sleep(3.0)
+                await default_clock().sleep(3.0)
                 self._evict_idle(self._max_conns)
 
         self._sweeper = asyncio.get_running_loop().create_task(sweep())

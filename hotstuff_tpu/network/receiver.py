@@ -56,7 +56,10 @@ class Receiver:
         self.handler = handler
         self._faults = fault_plane
         self._server: asyncio.AbstractServer | None = None
-        self._writers: set[asyncio.StreamWriter] = set()
+        # insertion-ordered (dict-as-set): shutdown closes connections
+        # in accept order, so teardown is reproducible — a plain set
+        # iterates in id() order, which varies with heap layout
+        self._writers: dict[asyncio.StreamWriter, None] = {}
 
     async def spawn(self) -> None:
         try:
@@ -75,7 +78,7 @@ class Receiver:
         peer = stream_writer.get_extra_info("peername")
         set_nodelay(stream_writer)
         log.debug("Incoming connection from %s", peer)
-        self._writers.add(stream_writer)
+        self._writers[stream_writer] = None
         writer = Writer(stream_writer)
         try:
             while True:
@@ -91,7 +94,7 @@ class Receiver:
         ):
             log.debug("Connection from %s closed", peer)
         finally:
-            self._writers.discard(stream_writer)
+            self._writers.pop(stream_writer, None)
             stream_writer.close()
 
     @property

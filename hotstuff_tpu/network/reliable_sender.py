@@ -37,10 +37,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import random
 from collections import deque
 
 from ..faults.plane import BARRIER_POLL_S, corrupt_frame
+from ..utils.clock import default_clock, default_connector, default_rng
 from .errors import UnexpectedAckError, classify
 from .framing import FramingError, read_frame, send_frame, set_nodelay
 from .pool import BoundedPoolMixin, abort_writer
@@ -106,7 +106,7 @@ class _Connection:
         delay = RETRY_DELAY_S
         while True:
             try:
-                reader, writer = await asyncio.open_connection(*self.address)
+                reader, writer = await default_connector()(*self.address)
             except OSError as e:
                 self.connect_failures += 1
                 log.debug("%s", classify(e, "connect", self.address))
@@ -116,9 +116,9 @@ class _Connection:
                 # instead of stampeding the healed link in lockstep
                 if delay > RETRY_DELAY_S:
                     self.jittered_retries += 1
-                    await asyncio.sleep(random.uniform(0, delay))
+                    await default_clock().sleep(default_rng().uniform(0, delay))
                 else:
-                    await asyncio.sleep(delay)
+                    await default_clock().sleep(delay)
                 delay = min(delay * 2, RETRY_CAP_S)
                 continue
             set_nodelay(writer)
@@ -153,7 +153,7 @@ class _Connection:
         )
         if self._faults is not None and self.pending:
             while self._faults.barrier():
-                await asyncio.sleep(BARRIER_POLL_S)
+                await default_clock().sleep(BARRIER_POLL_S)
         for data, _ in self.pending:
             await send_frame(writer, data)
 
@@ -216,12 +216,12 @@ class _Connection:
             await send_frame(writer, data)
             return
         while faults.barrier():
-            await asyncio.sleep(BARRIER_POLL_S)
+            await default_clock().sleep(BARRIER_POLL_S)
         decision = faults.decide()
         if decision.drop:
             raise FaultDisconnect(f"fault plane dropped frame to {self.address}")
         if decision.delay_s:
-            await asyncio.sleep(decision.delay_s)
+            await default_clock().sleep(decision.delay_s)
         if decision.corrupt:
             await send_frame(writer, corrupt_frame(data))
             raise FaultDisconnect(f"fault plane corrupted frame to {self.address}")
@@ -306,7 +306,7 @@ class ReliableSender(BoundedPoolMixin):
     async def lucky_broadcast(
         self, addresses: list[Address], data: bytes, nodes: int
     ) -> list[CancelHandler]:
-        picks = random.sample(addresses, min(nodes, len(addresses)))
+        picks = default_rng().sample(addresses, min(nodes, len(addresses)))
         return await self.broadcast(picks, data)
 
     def close(self) -> None:

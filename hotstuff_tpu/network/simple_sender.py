@@ -11,9 +11,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import random
 
 from ..faults.plane import corrupt_frame
+from ..utils.clock import default_clock, default_connector, default_rng
 from .errors import classify
 from .framing import read_frame, send_frame, set_nodelay
 from .pool import BoundedPoolMixin, abort_writer
@@ -90,7 +90,7 @@ class _Connection:
         while True:
             at, data = await self._next()
             try:
-                reader, writer = await asyncio.open_connection(*self.address)
+                reader, writer = await default_connector()(*self.address)
             except OSError as e:
                 self.connect_failures += 1
                 log.warning("%s", classify(e, "connect", self.address))
@@ -119,7 +119,7 @@ class _Connection:
         if decision.drop:
             return
         if decision.delay_s:
-            await asyncio.sleep(decision.delay_s)
+            await default_clock().sleep(decision.delay_s)
         payload = corrupt_frame(data) if decision.corrupt else data
         await send_frame(writer, payload)
         if decision.duplicate:
@@ -232,7 +232,7 @@ class SimpleSender(BoundedPoolMixin):
                 and loop.time() < deadline
             ):
                 stalled = True
-                await asyncio.sleep(0.002)
+                await default_clock().sleep(0.002)
             if stalled:
                 self.pacing_stalls += 1
 
@@ -241,7 +241,7 @@ class SimpleSender(BoundedPoolMixin):
     ) -> None:
         """Send to ``nodes`` randomly-picked peers (reference
         simple_sender.rs lucky_broadcast)."""
-        picks = random.sample(addresses, min(nodes, len(addresses)))
+        picks = default_rng().sample(addresses, min(nodes, len(addresses)))
         await self.broadcast(picks, data)
 
     def close(self) -> None:

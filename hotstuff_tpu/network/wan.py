@@ -28,7 +28,8 @@ from __future__ import annotations
 
 import asyncio
 import json
-import random
+
+from ..utils.clock import default_clock, default_rng
 
 Address = tuple[str, int]
 
@@ -110,7 +111,7 @@ class WanModel:
             if dst_region == self.self_region
             else self.matrix.get((self.self_region, dst_region), self.intra_ms)
         )
-        jitter = random.gauss(0.0, base * self.jitter_pct / 100.0)
+        jitter = default_rng().gauss(0.0, base * self.jitter_pct / 100.0)
         return max(0.0, (base + jitter) / 1e3)
 
 
@@ -135,7 +136,7 @@ class LinkScheduler:
     async def wait_until(at: float) -> None:
         remaining = at - asyncio.get_running_loop().time()
         if remaining > 0:
-            await asyncio.sleep(remaining)
+            await default_clock().sleep(remaining)
 
 
 __all__ = ["WanModel", "LinkScheduler", "build_spec", "DEFAULT_REGIONS"]

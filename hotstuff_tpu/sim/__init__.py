@@ -1,0 +1,33 @@
+"""Deterministic virtual-time simulation plane (docs/SIM.md).
+
+Runs an entire N-node committee — core, proposer, synchronizer,
+aggregator, state machine, state-sync, reconfig — inside ONE process on
+a virtual-time event loop (no real sleeps, no real sockets), with the
+existing FaultPlane / AdversaryPlane threaded through an in-memory
+transport.  Every run is a pure function of its schedule seed; failures
+replay from the seed alone and shrink to a minimal failing schedule.
+"""
+
+from .explorer import ExploreResult, explore, shrink
+from .harness import SimCluster
+from .loop import SIM_EPOCH, SimDeadlock, SimLoop, VirtualClock
+from .runner import SimVerdict, run_schedule
+from .schedule import draw_schedule, schedule_to_spec
+from .transport import SimNet, SimReceiver
+
+__all__ = [
+    "SIM_EPOCH",
+    "ExploreResult",
+    "SimCluster",
+    "SimDeadlock",
+    "SimLoop",
+    "SimNet",
+    "SimReceiver",
+    "SimVerdict",
+    "VirtualClock",
+    "draw_schedule",
+    "explore",
+    "run_schedule",
+    "schedule_to_spec",
+    "shrink",
+]

@@ -1,0 +1,170 @@
+"""Seeded schedule exploration + greedy failure shrinking.
+
+``explore`` sweeps a seed range: each seed deterministically draws a
+schedule (partitions, link loss/delay, crashes with torn WAL tails,
+reconfig ops, Byzantine collusion), runs it in virtual time, and judges
+it with the full invariant stack.  A failing seed gets a **repro
+bundle** — the schedule JSON, the merged journal and the rendered
+invariant block, all reproducible from the printed seed alone — and is
+then **shrunk**: events are greedily removed one at a time while the
+failure persists, converging to a minimal failing schedule (re-running
+a candidate costs well under a second of wall-clock, so shrinking is
+cheap).
+
+Failure semantics per profile:
+- ``honest`` schedules must PASS every invariant; any FAIL is a finding.
+- ``byz-collude`` schedules must FAIL full-history safety AND PASS the
+  trusted-subset recheck; anything else (no divergence, or divergence
+  the trusted subset can't absolve) is a finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from .runner import SimVerdict, run_schedule
+from .schedule import draw_schedule
+
+
+@dataclasses.dataclass
+class Finding:
+    seed: int
+    profile: str
+    failures: list[str]
+    repro_dir: str | None
+    minimal_events: list[dict]  #: shrunk schedule's surviving events
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    seeds: int
+    passed: int
+    findings: list[Finding]
+    honest: int
+    byz: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def shrink(
+    schedule: dict,
+    is_failing=None,
+    progress=None,
+) -> dict:
+    """Greedily minimize a failing schedule: repeatedly try dropping one
+    event; keep any drop under which the run still fails.  Loops until a
+    full pass removes nothing (a local minimum — every remaining event
+    is necessary for THIS failure)."""
+    if is_failing is None:
+        is_failing = lambda sched: not run_schedule(sched).ok  # noqa: E731
+    current = dict(schedule)
+    changed = True
+    while changed and current["events"]:
+        changed = False
+        for i in range(len(current["events"])):
+            candidate = dict(current)
+            candidate["events"] = (
+                current["events"][:i] + current["events"][i + 1 :]
+            )
+            if is_failing(candidate):
+                if progress:
+                    progress(
+                        f"  shrink: dropped {current['events'][i]['kind']} "
+                        f"event, {len(candidate['events'])} remain"
+                    )
+                current = candidate
+                changed = True
+                break
+    return current
+
+
+def write_repro_bundle(
+    schedule: dict, verdict: SimVerdict, out_dir: str
+) -> str:
+    """Materialize seed + schedule JSON + merged journal + verdict in
+    ``out_dir`` by re-running the schedule there (deterministic, so the
+    re-run IS the original run)."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "schedule.json"), "w") as f:
+        json.dump(schedule, f, indent=2)
+    rerun = run_schedule(schedule, workdir=out_dir)  # writes journal.jsonl
+    with open(os.path.join(out_dir, "verdict.json"), "w") as f:
+        json.dump(rerun.to_json(), f, indent=2)
+    with open(os.path.join(out_dir, "invariants.txt"), "w") as f:
+        f.write(rerun.block + "\n")
+    return out_dir
+
+
+def explore(
+    seeds: int,
+    nodes: int = 4,
+    start_seed: int = 0,
+    duration_s: float | None = None,
+    out_dir: str | None = None,
+    do_shrink: bool = True,
+    progress=None,
+) -> ExploreResult:
+    """Run ``seeds`` consecutive seeds starting at ``start_seed``; see
+    module docstring for the failure semantics."""
+    say = progress or (lambda _msg: None)
+    findings: list[Finding] = []
+    passed = honest = byz = 0
+    for k in range(seeds):
+        seed = start_seed + k
+        schedule = draw_schedule(seed, nodes=nodes, duration_s=duration_s)
+        if schedule["profile"] == "byz-collude":
+            byz += 1
+        else:
+            honest += 1
+        verdict = run_schedule(schedule)
+        if verdict.ok:
+            passed += 1
+            if (k + 1) % 25 == 0:
+                say(f"  {k + 1}/{seeds} seeds, {len(findings)} findings")
+            continue
+        say(
+            f"  FAIL seed {seed} ({schedule['profile']}): "
+            + "; ".join(verdict.failures)
+        )
+        repro = None
+        if out_dir is not None:
+            repro = write_repro_bundle(
+                schedule,
+                verdict,
+                os.path.join(out_dir, f"repro-{seed}"),
+            )
+            say(f"  repro bundle: {repro}")
+        minimal = schedule
+        if do_shrink and schedule["events"]:
+            minimal = shrink(schedule, progress=say)
+            say(
+                f"  minimal failing schedule: "
+                f"{len(minimal['events'])}/{len(schedule['events'])} events "
+                f"({', '.join(e['kind'] for e in minimal['events'])})"
+            )
+            if repro is not None:
+                with open(os.path.join(repro, "minimal.json"), "w") as f:
+                    json.dump(minimal, f, indent=2)
+        findings.append(
+            Finding(
+                seed=seed,
+                profile=schedule["profile"],
+                failures=list(verdict.failures),
+                repro_dir=repro,
+                minimal_events=list(minimal["events"]),
+            )
+        )
+    return ExploreResult(
+        seeds=seeds,
+        passed=passed,
+        findings=findings,
+        honest=honest,
+        byz=byz,
+    )
+
+
+__all__ = ["ExploreResult", "Finding", "explore", "shrink", "write_repro_bundle"]

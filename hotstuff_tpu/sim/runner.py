@@ -1,0 +1,273 @@
+"""Execute one schedule in virtual time and judge it.
+
+``run_schedule`` is the sim's unit of work: build a fresh
+:class:`~hotstuff_tpu.sim.loop.SimLoop`, install the ambient
+clock/rng/connector seams and the chaos/adversary env, run the committee
+through the schedule, then render each node's captured log records into
+``node-<i>.log`` files in the benchmark log dialect and hand them to the
+EXISTING invariant stack (``benchmark.invariants.check_run``) — safety,
+state-root agreement, liveness-after-heal, epoch agreement, handoff gap
+and the trusted-subset recheck all run unmodified.
+
+Determinism contract: the verdict and the journal digest are a pure
+function of the schedule.  Everything ambient is pinned per run (virtual
+clock at ``SIM_EPOCH``, ``Random("sim-run|<seed>")``, in-memory
+network); the double-run test in tests/test_simnet.py enforces
+byte-identical journals.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime
+import hashlib
+import json
+import logging
+import os
+import random
+import tempfile
+
+from ..utils.clock import (
+    set_default_clock,
+    set_default_connector,
+    set_default_rng,
+)
+from .harness import SIM_BASE_PORT, SimCluster
+from .loop import SIM_EPOCH, SimDeadlock, SimLoop, VirtualClock
+from .schedule import schedule_to_spec
+from .transport import SimNet, set_current_net
+
+#: env the sim pins for the duration of a run (value None = unset)
+_RUN_ENV_BASE = {
+    "HOTSTUFF_WAN_SPEC": None,  # WAN emu draws real-region latencies
+    "HOTSTUFF_MAX_PEER_CONNS": None,
+    "HOTSTUFF_RECONFIG_LISTEN": None,
+    "HOTSTUFF_STATE_SYNC_LAG": "2",  # rejoiners snapshot-sync promptly
+}
+
+
+@dataclasses.dataclass
+class SimVerdict:
+    """One schedule's outcome + everything needed to reproduce it."""
+
+    seed: int
+    profile: str
+    ok: bool  #: run matched its profile's expectation
+    all_ok: bool  #: raw full-history check_run verdict
+    safety_ok: bool
+    trusted_ok: bool | None  #: trusted-subset recheck (byz specs only)
+    commits: int  #: total committed-block observations across nodes
+    rounds: int  #: highest committed round observed by any node
+    journal_digest: str
+    block: str  #: rendered CHAOS/BYZ/RECONFIG report
+    failures: list[str] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _LogCapture(logging.Handler):
+    """Collects every ``hotstuff_tpu`` log record with its VIRTUAL
+    timestamp (``record.created`` is real wall time — useless here)."""
+
+    def __init__(self, clock: VirtualClock):
+        super().__init__(level=logging.INFO)
+        self._clock = clock
+        self.records: list[tuple[float, str, str]] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — never let logging kill the run
+            return
+        self.records.append((self._clock.monotonic(), record.name, msg))
+
+
+def _stamp(vt: float) -> str:
+    """Render virtual seconds as the benchmark log timestamp.  The
+    parser (benchmark/logs.py ``_ts``) reads it back as LOCAL time, so
+    format through ``fromtimestamp`` for an exact round-trip."""
+    dt = datetime.datetime.fromtimestamp(SIM_EPOCH + vt)
+    return f"{dt:%Y-%m-%dT%H:%M:%S}.{dt.microsecond // 1000:03d}Z"
+
+
+def _render_logs(
+    records: list[tuple[float, str, str]],
+    prefix_map: dict[str, int],
+    logs_dir: str,
+    nodes: int,
+) -> None:
+    """Write ``node-<i>.log`` files in the benchmark dialect.  Per-node
+    attribution rides on the actor logger suffix (``...core.<pk8>``);
+    unattributed records (sim harness, planes) stay journal-only."""
+    lines: dict[int, list[str]] = {i: [] for i in range(nodes)}
+    for vt, name, msg in records:
+        suffix = name.rsplit(".", 1)[-1]
+        idx = prefix_map.get(suffix)
+        if idx is not None:
+            lines[idx].append(f"{_stamp(vt)} INFO {msg}")
+    os.makedirs(logs_dir, exist_ok=True)
+    for i in range(nodes):
+        with open(os.path.join(logs_dir, f"node-{i}.log"), "w") as f:
+            f.write("\n".join(lines[i]) + ("\n" if lines[i] else ""))
+
+
+def _write_journal(
+    records: list[tuple[float, str, str]], path: str
+) -> str:
+    """Merged run journal: one JSONL line per captured record, virtual
+    timestamps, stable key order.  Returns the sha256 hex digest — the
+    byte-identity witness for the determinism contract."""
+    payload = "".join(
+        json.dumps({"t": round(vt, 6), "src": name, "msg": msg})
+        + "\n"
+        for vt, name, msg in records
+    ).encode()
+    with open(path, "wb") as f:
+        f.write(payload)
+    return hashlib.sha256(payload).hexdigest()
+
+
+def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
+    """Run one schedule to completion in virtual time (see module
+    docstring).  ``workdir`` receives stores, rendered logs and the
+    journal; a temp dir (cleaned up) is used when omitted."""
+    if workdir is None:
+        with tempfile.TemporaryDirectory(prefix="hotstuff-sim-") as tmp:
+            return run_schedule(schedule, tmp)
+
+    spec = schedule_to_spec(schedule, SIM_BASE_PORT)
+    seed = int(schedule["seed"])
+
+    # -- pin the ambient world ----------------------------------------
+    saved_env = {
+        k: os.environ.get(k)
+        for k in list(_RUN_ENV_BASE) + ["HOTSTUFF_FAULTS", "HOTSTUFF_ADVERSARY"]
+    }
+    for k, v in _RUN_ENV_BASE.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    os.environ["HOTSTUFF_FAULTS"] = json.dumps(spec)
+    if spec.get("adversary"):
+        os.environ["HOTSTUFF_ADVERSARY"] = json.dumps(spec)
+    else:
+        os.environ.pop("HOTSTUFF_ADVERSARY", None)
+
+    loop = SimLoop()
+    clock = VirtualClock(loop)
+    net = SimNet()
+    prev_clock = set_default_clock(clock)
+    prev_rng = set_default_rng(random.Random(f"sim-run|{seed}"))
+    prev_conn = set_default_connector(net.open_connection)
+    prev_net = set_current_net(net)
+
+    capture = _LogCapture(clock)
+    hs_log = logging.getLogger("hotstuff_tpu")
+    prev_level = hs_log.level
+    hs_log.addHandler(capture)
+    hs_log.setLevel(logging.INFO)
+
+    failures: list[str] = []
+    cluster = SimCluster(schedule, workdir, net)
+    try:
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(cluster.run())
+        except SimDeadlock as exc:
+            failures.append(f"virtual-loop deadlock: {exc}")
+        # drain stragglers (cancelled receiver handlers, sender
+        # reconnect loops) so the loop closes clean; sorted by name so
+        # cancellation order never depends on set/heap layout
+        pending = sorted(
+            (t for t in asyncio.all_tasks(loop) if not t.done()),
+            key=lambda t: t.get_name(),
+        )
+        for t in pending:
+            t.cancel()
+        if pending:
+            try:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            except SimDeadlock:
+                failures.append("virtual-loop deadlock during teardown")
+        loop.close()
+    finally:
+        asyncio.set_event_loop(None)
+        set_default_clock(prev_clock)
+        set_default_rng(prev_rng)
+        set_default_connector(prev_conn)
+        set_current_net(prev_net)
+        hs_log.removeHandler(capture)
+        hs_log.setLevel(prev_level)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # -- judge ---------------------------------------------------------
+    from benchmark.invariants import (
+        adversaries_from_spec,
+        check_run,
+        check_safety,
+        commits_from_logs,
+        trusted_subset_recheck,
+    )
+
+    logs_dir = os.path.join(workdir, "logs")
+    _render_logs(capture.records, cluster.prefix_map(), logs_dir, cluster.n)
+    journal_digest = _write_journal(
+        capture.records, os.path.join(workdir, "journal.jsonl")
+    )
+
+    all_ok, block = check_run(logs_dir, spec, epoch_unix=SIM_EPOCH)
+    commits = commits_from_logs(logs_dir)
+    safety_ok, safety_viol = check_safety(commits)
+    adversaries = adversaries_from_spec(spec)
+    trusted_ok: bool | None = None
+    if adversaries:
+        trusted_ok, trusted_viol = trusted_subset_recheck(
+            commits, set(adversaries)
+        )
+
+    profile = schedule.get("profile", "honest")
+    if failures:
+        ok = False
+    elif profile == "byz-collude":
+        # expectation: the collusion REALLY diverges the full history
+        # (FAIL) while the trusted-subset regime absolves it (PASS)
+        ok = (not safety_ok) and bool(trusted_ok)
+        if safety_ok:
+            failures.append("byz-collude schedule left no divergence")
+        if not trusted_ok:
+            failures.extend(
+                f"trusted-subset: {v}" for v in (trusted_viol or ())
+            )
+    else:
+        ok = all_ok
+        if not all_ok:
+            failures.append("invariant check failed (see block)")
+
+    return SimVerdict(
+        seed=seed,
+        profile=profile,
+        ok=ok,
+        all_ok=all_ok,
+        safety_ok=safety_ok,
+        trusted_ok=trusted_ok,
+        commits=sum(len(v) for v in commits.values()),
+        rounds=max(
+            (r for obs in commits.values() for _t, r, _d in obs),
+            default=0,
+        ),
+        journal_digest=journal_digest,
+        block=block,
+        failures=failures,
+    )
+
+
+__all__ = ["SimVerdict", "run_schedule"]

@@ -23,6 +23,9 @@ non-zero when either guarded metric regresses past the threshold
   * ``state.apply_tx_s`` / ``state.sync_catchup_s`` — replicated
     execution-layer apply throughput and snapshot serve+adopt wall cost
     (ISSUE 11; wide per-guard 50% gates, skip-if-missing)
+  * ``sim.rounds_per_s`` / ``sim.seeds_per_min`` — deterministic
+    simulator sweep throughput (ISSUE 15; wide per-guard 50% gates,
+    skip-if-missing)
 
 ``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
 (ISSUE 6): the fresh value must stay within ``--ratchet-slack``
@@ -135,6 +138,24 @@ GUARDS = (
         "state.sync_catchup_s",
         lambda doc: (doc.get("state") or {}).get("sync_catchup_s"),
         +1,
+        0.5,
+    ),
+    # deterministic simulator (ISSUE 15): how fast this host chews
+    # through exploration seeds — consensus rounds simulated per wall
+    # second and seeds per minute over a short sweep.  Whole-committee
+    # Python on a shared single-core rig, so the per-guard gates are
+    # wide; skip-if-missing covers references from before the sim block
+    # existed.
+    (
+        "sim.rounds_per_s",
+        lambda doc: (doc.get("sim") or {}).get("rounds_per_s"),
+        -1,
+        0.5,
+    ),
+    (
+        "sim.seeds_per_min",
+        lambda doc: (doc.get("sim") or {}).get("seeds_per_min"),
+        -1,
         0.5,
     ),
 )

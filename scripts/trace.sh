@@ -63,6 +63,16 @@
 #                                 # and byz-reconfig FAILs full-history
 #                                 # epoch agreement (trusted subset
 #                                 # PASSes); non-zero exit on any break
+#   SIM=1 scripts/trace.sh        # ONLY the deterministic-simulator
+#                                 # sweep (scripts/sim_check.py): a
+#                                 # 500-seed virtual-time explore at
+#                                 # n=4 (faults+crashes+byz mix), zero
+#                                 # honest invariant failures, the
+#                                 # byz-collude family FAILs
+#                                 # full-history / PASSes
+#                                 # trusted-subset, and a double-run
+#                                 # determinism probe; non-zero exit on
+#                                 # any break
 #   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
 #                                 # (scripts/analysis_check.py): every
 #                                 # hotstuff_tpu/analysis lint rule,
@@ -112,6 +122,11 @@ fi
 if [ "${RECONFIG:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/reconfig_check.py "$@"
+fi
+
+if [ "${SIM:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/sim_check.py "$@"
 fi
 
 if [ "${LINT:-0}" = "1" ]; then
