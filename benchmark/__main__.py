@@ -49,8 +49,16 @@ def task_local(args) -> int:
 
         traces = TraceSet.load(PathMaker.journals_path())
         trace_txt = traces.summary()
+        crit_report = None
         if traces.blocks:
-            out = traces.export_chrome_trace(PathMaker.trace_file())
+            from hotstuff_tpu.telemetry import critpath as crit_engine
+
+            crit_report = crit_engine.analyze(traces)
+            if crit_report.commits:
+                trace_txt += crit_engine.render(crit_report)
+            out = traces.export_chrome_trace(
+                PathMaker.trace_file(), critpath=crit_report
+            )
             Print.info(
                 f"Chrome trace written to {out} "
                 "(open in https://ui.perfetto.dev)"
@@ -272,12 +280,33 @@ def task_traces(args) -> int:
         Print.error(f"no journal segments found under {args.dir}")
         return 1
     if traces.journals:
-        print(traces.summary())
-        out = traces.export_chrome_trace(args.out)
+        from hotstuff_tpu.telemetry import critpath as crit_engine
+
+        report = crit_engine.analyze(traces)
+        txt = traces.summary()
+        if report.commits:
+            txt += crit_engine.render(report)
+        print(txt)
+        out = traces.export_chrome_trace(args.out, critpath=report)
         Print.info(f"Chrome trace written to {out}")
     if campaign is not None:
         Print.info(f"Campaign report written to {campaign}")
     return 0
+
+
+def task_critpath(args) -> int:
+    """Commit critical-path attribution (telemetry/critpath.py): the
+    "+ CRITPATH" SUMMARY block, the Perfetto critical-path track, the
+    machine-readable attribution document, and the attribution-diff
+    regression gate (``--diff``)."""
+    from .critpath import run_critpath
+
+    return run_critpath(
+        args.dir,
+        out=args.out,
+        diff_path=args.diff,
+        json_line=args.json,
+    )
 
 
 def task_profile(args) -> int:
@@ -779,6 +808,41 @@ def main(argv=None) -> int:
         help="where to write the Chrome trace-event JSON",
     )
     p.set_defaults(fn=task_traces)
+
+    p = sub.add_parser(
+        "critpath",
+        help="commit critical-path attribution from a run's journals: "
+        "the + CRITPATH block (stage p50/p99, dominant-stage histogram, "
+        "regime classification), the Perfetto critical-path track, and "
+        "the attribution-diff regression gate (--diff)",
+    )
+    p.add_argument(
+        "--dir",
+        default=PathMaker.journals_path(),
+        help="directory holding the per-node journal segments",
+    )
+    p.add_argument(
+        "--out",
+        default=PathMaker.trace_file(),
+        help="where to write the Chrome trace-event JSON "
+        "(with the critical-path track)",
+    )
+    p.add_argument(
+        "--diff",
+        default=None,
+        metavar="REF.json",
+        help="reference attribution to gate against (a committed "
+        "scripts/perf/BENCH_rXX.json, a bench JSON doc, or a prior "
+        "logs/critpath.json); exit 1 when any stage's latency share "
+        "grew beyond HOTSTUFF_CRITPATH_DIFF_PP percentage points",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="also print the attribution as one machine-readable "
+        "JSON line",
+    )
+    p.set_defaults(fn=task_critpath)
 
     p = sub.add_parser(
         "watch",

@@ -9,12 +9,15 @@ module merges a run's journals into one committee-wide timeline:
 2. **Estimate per-node clock offsets** from matched send/recv pairs: a
    propose journaled at the leader and its recv.propose at a replica (or
    a vote.send and its recv.vote) give a one-way wall-clock delta per
-   directed node pair.  The MINIMUM delta over a run approximates
-   (min network delay + clock offset); with both directions measured the
-   symmetric estimate ``offset = (d_ab - d_ba) / 2`` cancels the delay
-   (NTP's classic assumption: symmetric minimum paths).  Offsets are
-   propagated from the best-connected reference node by BFS, so a
-   committee is aligned even when some pairs never exchanged messages.
+   directed node pair.  The MEDIAN delta over a run approximates
+   (typical network delay + clock offset) and is robust to the
+   scheduling/GC outliers that poison a single extreme sample; with both
+   directions measured the symmetric estimate
+   ``offset = (d_ab - d_ba) / 2`` cancels the delay (NTP's classic
+   assumption: symmetric paths).  Offsets are propagated from the
+   best-connected reference node by BFS; nodes with NO matched pair
+   (e.g. crashed before sending) degrade gracefully to offset 0 with a
+   warning — never a crash.
 3. **Reconstruct** every block's cross-node timeline — propose at the
    leader, receive/vote at each replica, QC formation, commit on every
    node — using corrected wall clocks for cross-node edges and raw
@@ -39,7 +42,7 @@ import json
 import os
 import re
 from collections import Counter, defaultdict
-from statistics import mean
+from statistics import mean, median
 
 from hotstuff_tpu.telemetry.taxonomy import (
     BYZ_PREFIX,
@@ -59,16 +62,30 @@ _SEG_RE = re.compile(r"^(?P<prefix>.+)-(?P<seq>\d{6})\.jsonl$")
 # ---- loading ---------------------------------------------------------------
 
 
-def load_journals(dir_path: str) -> dict[str, list[dict]]:
+def load_journals(
+    dir_path: str, stats: dict | None = None
+) -> dict[str, list[dict]]:
     """node id -> that node's records, merged across ring segments and
-    sorted by monotonic time.  Torn lines (a crash mid-write) are
-    skipped; the node id comes from each segment's meta line (filenames
-    are sanitized and ambiguous)."""
+    sorted by record sequence (falling back to monotonic time for
+    journals predating the ``s`` field).  Torn lines (a crash mid-write)
+    are skipped; the node id comes from each segment's meta line
+    (filenames are sanitized and ambiguous).
+
+    A crash-restarted node resumes its ring and can replay records whose
+    sequence numbers were already persisted (a torn tail hides the true
+    max seq) — duplicates are dropped by (node, seq), first occurrence
+    wins.  When ``stats`` (a dict) is passed it is filled with the merge
+    accounting: ``overlap`` (deduped records), ``loaded`` /``dropped``
+    totals and per-node counts (``dropped`` comes from the ring's
+    cumulative no-silent-caps counter in the meta lines)."""
     by_node: dict[str, list[dict]] = defaultdict(list)
+    meta_drop: dict[str, int] = defaultdict(int)
+    overlap = 0
     paths = sorted(glob.glob(os.path.join(dir_path, "*.jsonl")))
     for path in paths:
         node = None
         records = []
+        drop = 0
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -80,6 +97,7 @@ def load_journals(dir_path: str) -> dict[str, list[dict]]:
                     continue  # torn line (crash mid-write)
                 if rec.get("e") == "meta":
                     node = rec.get("n", node)
+                    drop = max(drop, int(rec.get("drop", 0) or 0))
                     continue
                 records.append(rec)
         if node is None:
@@ -87,8 +105,35 @@ def load_journals(dir_path: str) -> dict[str, list[dict]]:
             m = _SEG_RE.match(os.path.basename(path))
             node = m.group("prefix") if m else os.path.basename(path)
         by_node[node].extend(records)
-    for records in by_node.values():
-        records.sort(key=lambda r: r.get("m", 0))
+        meta_drop[node] = max(meta_drop[node], drop)
+    for node, records in by_node.items():
+        seen: set[int] = set()
+        deduped = []
+        for r in records:
+            s = r.get("s")
+            if isinstance(s, int):
+                if s in seen:
+                    overlap += 1
+                    continue
+                seen.add(s)
+            deduped.append(r)
+        # segment files sort chronologically, so first occurrence wins;
+        # order by seq when the journal carries it (restart-safe — the
+        # monotonic clock resets across boots, seqs don't)
+        if len(seen) == len(deduped):
+            deduped.sort(key=lambda r: r.get("s", 0))
+        else:
+            deduped.sort(key=lambda r: r.get("m", 0))
+        by_node[node] = deduped
+    if stats is not None:
+        loaded = {n: len(rs) for n, rs in by_node.items()}
+        stats["overlap"] = overlap
+        stats["loaded"] = sum(loaded.values())
+        stats["dropped"] = sum(meta_drop.values())
+        stats["by_node"] = {
+            n: {"loaded": loaded[n], "dropped": meta_drop.get(n, 0)}
+            for n in by_node
+        }
     return dict(by_node)
 
 
@@ -149,53 +194,60 @@ def merge_campaigns(dir_path: str, out_path: str) -> str | None:
 
 def estimate_offsets(
     journals: dict[str, list[dict]],
+    warnings: list | None = None,
 ) -> tuple[dict[str, int], str | None]:
     """(offsets, reference): per-node wall-clock offset in ns relative
-    to the reference node (``corrected = w - offset[node]``).  Nodes
-    with no matched message pair to the connected component keep offset
-    0 (their cross-node edges are then only as good as NTP)."""
+    to the reference node (``corrected = w - offset[node]``).  Per
+    directed pair the MEDIAN matched send/recv delta is used (robust to
+    scheduling-spike outliers); nodes with no matched message pair to
+    the connected component degrade gracefully to offset 0 (their
+    cross-node edges are then only as good as NTP) with a line appended
+    to ``warnings`` when a list is passed — never a crash."""
     # send-side indexes: who proposed each digest (and when), and when
     # each node sent its vote for each digest
     propose_at: dict[str, tuple[str, int]] = {}
     vote_sent: dict[tuple[str, str], int] = {}
     for node, records in journals.items():
         for r in records:
-            e = r["e"]
-            if e == "propose" and r["d"] not in propose_at:
-                propose_at[r["d"]] = (node, r["w"])
+            e = r.get("e")
+            d, w = r.get("d"), r.get("w")
+            if d is None or w is None:
+                continue
+            if e == "propose" and d not in propose_at:
+                propose_at[d] = (node, w)
             elif e == "vote.send":
-                vote_sent.setdefault((r["d"], node), r["w"])
+                vote_sent.setdefault((d, node), w)
 
-    # minimum observed one-way delta per directed pair (sender, receiver)
-    min_delta: dict[tuple[str, str], int] = {}
-
-    def feed(sender: str, receiver: str, delta: int) -> None:
-        key = (sender, receiver)
-        if key not in min_delta or delta < min_delta[key]:
-            min_delta[key] = delta
+    # every observed one-way delta per directed pair (sender, receiver)
+    deltas: dict[tuple[str, str], list[int]] = defaultdict(list)
 
     for node, records in journals.items():
         for r in records:
-            e = r["e"]
+            e = r.get("e")
+            d, w = r.get("d"), r.get("w")
+            if d is None or w is None:
+                continue
             if e == "recv.propose":
-                src = propose_at.get(r["d"])
+                src = propose_at.get(d)
                 if src is not None and src[0] != node:
-                    feed(src[0], node, r["w"] - src[1])
+                    deltas[(src[0], node)].append(w - src[1])
             elif e == "recv.vote":
-                sent = vote_sent.get((r["d"], r["p"]))
-                if sent is not None and r["p"] != node:
-                    feed(r["p"], node, r["w"] - sent)
+                peer = r.get("p", "")
+                sent = vote_sent.get((d, peer))
+                if sent is not None and peer != node:
+                    deltas[(peer, node)].append(w - sent)
 
     # symmetric pairwise offsets where both directions were measured
     pair_offset: dict[tuple[str, str], float] = {}
     adjacency: dict[str, set[str]] = defaultdict(set)
-    for (a, b), d_ab in min_delta.items():
-        d_ba = min_delta.get((b, a))
-        if d_ba is None:
+    for (a, b), d_ab in deltas.items():
+        d_ba = deltas.get((b, a))
+        if d_ba is None or (a, b) in pair_offset:
             continue
-        # clock(b) - clock(a), delay cancelled under symmetric minimums
-        pair_offset[(a, b)] = (d_ab - d_ba) / 2.0
-        pair_offset[(b, a)] = (d_ba - d_ab) / 2.0
+        # clock(b) - clock(a), delay cancelled under symmetric medians
+        off = (median(d_ab) - median(d_ba)) / 2.0
+        pair_offset[(a, b)] = off
+        pair_offset[(b, a)] = -off
         adjacency[a].add(b)
         adjacency[b].add(a)
 
@@ -214,6 +266,13 @@ def estimate_offsets(
             offsets[b] = offsets[a] + int(pair_offset[(a, b)])
             seen.add(b)
             frontier.append(b)
+    if warnings is not None and len(nodes) > 1:
+        for n in nodes:
+            if n not in seen:
+                warnings.append(
+                    f"node {n}: no matched send/recv pair to reference"
+                    f" {reference}; clock offset defaulted to 0"
+                )
     return offsets, reference
 
 
@@ -223,10 +282,20 @@ def estimate_offsets(
 class TraceSet:
     """A run's merged, clock-aligned committee timeline."""
 
-    def __init__(self, journals: dict[str, list[dict]]):
+    def __init__(
+        self,
+        journals: dict[str, list[dict]],
+        merge_stats: dict | None = None,
+    ):
         self.journals = journals
         self.nodes = sorted(journals)
-        self.offsets, self.reference = estimate_offsets(journals)
+        # merge accounting from load_journals (dedup overlap, ring-drop
+        # counters) — the + CRITPATH journal-coverage line reads these
+        self.merge_stats: dict = merge_stats or {}
+        self.offset_warnings: list[str] = []
+        self.offsets, self.reference = estimate_offsets(
+            journals, self.offset_warnings
+        )
         # digest -> timeline; every (m, w) pair below is (node-local
         # monotonic ns, offset-corrected wall ns)
         self.blocks: dict[str, dict] = {}
@@ -274,7 +343,18 @@ class TraceSet:
 
     @classmethod
     def load(cls, dir_path: str) -> "TraceSet":
-        return cls(load_journals(dir_path))
+        stats: dict = {}
+        return cls(load_journals(dir_path, stats), merge_stats=stats)
+
+    def journal_coverage(self) -> float:
+        """Fraction of journaled records still in the ring at merge time
+        (1.0 = nothing rotated away).  Attribution over a truncated ring
+        is visibly partial, never silently wrong."""
+        loaded = self.merge_stats.get("loaded", 0)
+        dropped = self.merge_stats.get("dropped", 0)
+        if not dropped:
+            return 1.0
+        return loaded / float(loaded + dropped)
 
     def _corr(self, node: str, w: int) -> int:
         return w - self.offsets.get(node, 0)
@@ -288,7 +368,9 @@ class TraceSet:
                 "propose": None,  # (m, w_corr) at the leader
                 "recv": {},  # node -> (m, w_corr), first arrival
                 "vote_send": {},  # node -> (m, w_corr)
-                "qc": None,  # (node, m, w_corr), first formation
+                "recv_vote": {},  # voter -> (recv node, m, w_corr), first
+                "qc_form": None,  # (node, m, w_corr), QC assembled
+                "qc": None,  # (node, m, w_corr), first high-QC adoption
                 "commit": {},  # node -> (m, w_corr)
             }
         elif round_ and not info["round"]:
@@ -400,6 +482,13 @@ class TraceSet:
                         info["recv"][node] = stamp
                 elif e == "vote.send":
                     info["vote_send"].setdefault(node, stamp)
+                elif e == "recv.vote":
+                    voter = r.get("p", "")
+                    if voter and voter not in info["recv_vote"]:
+                        info["recv_vote"][voter] = (node, r["m"], stamp[1])
+                elif e == "qc.form":
+                    if info["qc_form"] is None:
+                        info["qc_form"] = (node, r["m"], stamp[1])
                 elif e == "qc":
                     if info["qc"] is None:
                         info["qc"] = (node, r["m"], stamp[1])
@@ -538,6 +627,20 @@ class TraceSet:
             lines.append(
                 f" Clock offsets vs {self.reference} (ms): {offs}\n"
             )
+        for warning in self.offset_warnings:
+            lines.append(f" WARN {warning}\n")
+        overlap = self.merge_stats.get("overlap", 0)
+        if overlap:
+            lines.append(
+                f" Journal merge: {overlap} replayed record(s) deduped"
+                f" (crash-restart overlap)\n"
+            )
+        dropped = self.merge_stats.get("dropped", 0)
+        if dropped:
+            lines.append(
+                f" Journal ring dropped {dropped} record(s)"
+                f" (coverage {100.0 * self.journal_coverage():.0f}%)\n"
+            )
         gaps = self.edge_gaps()
 
         def row(label: str, values: list[float], extra: str = "") -> None:
@@ -665,12 +768,15 @@ class TraceSet:
 
     # ---- Perfetto export ---------------------------------------------------
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, critpath=None) -> dict:
         """Chrome trace-event JSON (the dict; see export_chrome_trace).
         One track (pid) per node; per block one duration slice per node
         that saw it (leader: propose->commit, replica: recv->commit)
         with a flow arrow per propose->recv edge; timeouts as instant
-        markers."""
+        markers.  ``critpath`` (an optional
+        ``telemetry.critpath.CritPathReport``) adds a dedicated
+        "critical path" track highlighting each commit's winning
+        chain."""
         pid_of = {n: i for i, n in enumerate(self.nodes)}
         events: list[dict] = []
         for node, pid in pid_of.items():
@@ -704,6 +810,13 @@ class TraceSet:
             anchors.extend(w - dur for _, w, dur in rows)
         for samples in self.occupancy_samples.values():
             anchors.extend(w for w, _ in samples)
+        if critpath is not None:
+            for c in critpath.commits:
+                anchors.extend(
+                    s.w_start
+                    for s in c.segments
+                    if s.w_start is not None
+                )
         if not anchors:
             return {"traceEvents": events, "displayTimeUnit": "ms"}
         base = min(anchors)
@@ -1074,12 +1187,61 @@ class TraceSet:
                         "args": {"inflight": depth},
                     }
                 )
+        if critpath is not None and critpath.commits:
+            # dedicated critical-path track (one pid past the
+            # reconfiguration plane): per commit, the winning causal
+            # chain as contiguous stage slices — the one lane that says
+            # where THIS block's wall-clock went
+            crit_pid = len(self.nodes) + 5
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": crit_pid,
+                    "tid": 0,
+                    "args": {"name": "critical path"},
+                }
+            )
+            # pipelined rounds overlap in time: cycle a few lanes so
+            # consecutive chains don't stack into one malformed nest
+            for lane in range(4):
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": crit_pid,
+                        "tid": lane,
+                        "args": {"name": f"chain lane {lane}"},
+                    }
+                )
+            for c in critpath.commits:
+                for seg in c.segments:
+                    if seg.w_start is None or seg.w_end is None:
+                        continue
+                    events.append(
+                        {
+                            "name": seg.stage,
+                            "cat": "critpath",
+                            "ph": "X",
+                            "pid": crit_pid,
+                            "tid": c.round % 4,
+                            "ts": us(seg.w_start),
+                            "dur": max(1.0, us(seg.w_end) - us(seg.w_start)),
+                            "args": {
+                                "stage": seg.stage,
+                                "detail": seg.detail,
+                                "round": c.round,
+                                "digest": c.digest,
+                                "ms": round(seg.ms, 3),
+                            },
+                        }
+                    )
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
-    def export_chrome_trace(self, path: str) -> str:
+    def export_chrome_trace(self, path: str, critpath=None) -> str:
         """Write the Chrome trace-event JSON; open in https://ui.perfetto.dev
         (or chrome://tracing).  Returns ``path``."""
-        doc = self.chrome_trace()
+        doc = self.chrome_trace(critpath=critpath)
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)

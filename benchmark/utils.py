@@ -72,6 +72,12 @@ class PathMaker:
         return os.path.join(PathMaker.logs_path(), "campaign.json")
 
     @staticmethod
+    def critpath_file() -> str:
+        """The machine-readable commit critical-path attribution
+        document (`benchmark critpath` writes it; `--diff` reads one)."""
+        return os.path.join(PathMaker.logs_path(), "critpath.json")
+
+    @staticmethod
     def fault_spec_file() -> str:
         """The chaos-plane scenario spec the committee loads via
         HOTSTUFF_FAULTS (benchmark/chaos.py writes it at config time)."""

@@ -66,6 +66,7 @@ _COLUMNS = (
     ("ROUTE d/m/c", 12),
     ("CREDIT", 7),
     ("P50ms", 7),
+    ("DOMINANT-STAGE", 15),
 )
 
 
@@ -174,6 +175,11 @@ def node_view(name: str, flat: dict) -> dict:
             g(f"metrics.hotstuff_verify_route{{route={r}}}", 0)
             for r in ("device", "mesh", "cpu")
         ),
+        # rolling critical-path attribution the node's HealthMonitor
+        # publishes (telemetry.critpath.rolling_attribution): which
+        # lifecycle edge currently dominates its commit latency
+        "dominant": g("health.dominant_stage", ""),
+        "crit_regime": g("health.regime", ""),
         # node-local detector firings the node itself reports (its own
         # HealthMonitor section) — surfaced in the live incident feed
         "alerts": sorted(
@@ -357,6 +363,7 @@ def render(view: dict) -> str:
             "/".join(str(int(r or 0)) for r in route),
             str(v.get("credit", "") or 0),
             f"{float(v.get('p50_ms') or 0):.1f}",
+            str(v.get("dominant") or "-"),
         )
         lines.append(
             " ".join(str(c).ljust(w) for c, (_, w) in zip(cells, _COLUMNS))

@@ -844,6 +844,12 @@ class Core:
         qc = self.aggregator.add_vote(vote, self.round, sig_verified=sig_verified)
         if qc is not None:
             self.log.debug("Assembled %r", qc)
+            # qc.form marks the FORMATION moment at the assembling node
+            # (quorum-th vote folded in), distinct from the ``qc`` edge
+            # which marks high-QC adoption — the critical-path engine
+            # (telemetry/critpath.py) attributes agg.form from it
+            if self._journal is not None and not qc.is_genesis():
+                self._journal.record("qc.form", qc.round, qc.hash)
             self._process_qc(qc)
             if self.name == self.leader_elector.get_leader(self.round):
                 await self._generate_proposal(None)

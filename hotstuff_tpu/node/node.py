@@ -481,12 +481,20 @@ class Node:
                 if jdir
                 else None
             )
+            from ..telemetry.critpath import rolling_attribution
+
             self._health_monitor = HealthMonitor(
                 tel,
                 tel.node,
                 timeout_s=parameters.timeout_delay / 1000.0,
                 campaign_path=campaign_path,
                 logger=logging.getLogger(f"health.{secret.name}"),
+                # rolling critical-path attribution over the node's own
+                # trace ring (health.py is import-free, so the engine
+                # hook is injected here)
+                attribution_fn=lambda t=tel: rolling_attribution(
+                    t.trace.recent(64)
+                ),
             )
             self._health_task = asyncio.ensure_future(
                 self._health_monitor.run()
@@ -498,6 +506,16 @@ class Node:
                 "health",
                 lambda m=self._health_monitor: {
                     "open": sorted(i.kind for i in m.open_incidents()),
+                    **(
+                        {
+                            "dominant_stage": m.last_attribution.get(
+                                "dominant", ""
+                            ),
+                            "regime": m.last_attribution.get("regime", ""),
+                        }
+                        if m.last_attribution
+                        else {}
+                    ),
                 },
             )
             log.info("Health monitor running for node %s", tel.node)

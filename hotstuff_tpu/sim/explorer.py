@@ -44,6 +44,10 @@ class ExploreResult:
     findings: list[Finding]
     honest: int
     byz: int
+    #: commit critical-path regime -> number of seeds classified there
+    #: (per-seed attribution from the sim journals; seeds whose runs
+    #: committed nothing don't contribute)
+    regimes: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -112,6 +116,7 @@ def explore(
     module docstring for the failure semantics."""
     say = progress or (lambda _msg: None)
     findings: list[Finding] = []
+    regimes: dict = {}
     passed = honest = byz = 0
     for k in range(seeds):
         seed = start_seed + k
@@ -121,6 +126,9 @@ def explore(
         else:
             honest += 1
         verdict = run_schedule(schedule)
+        if verdict.attribution is not None:
+            regime = verdict.attribution.get("regime", "unknown")
+            regimes[regime] = regimes.get(regime, 0) + 1
         if verdict.ok:
             passed += 1
             if (k + 1) % 25 == 0:
@@ -164,6 +172,7 @@ def explore(
         findings=findings,
         honest=honest,
         byz=byz,
+        regimes=regimes,
     )
 
 

@@ -35,6 +35,9 @@ from ..crypto import (
 from ..network.framing import send_frame
 from ..store import Store
 from ..store.engine import WalEngine
+from ..telemetry import NodeTelemetry
+from ..telemetry.journal import Journal
+from ..telemetry.metrics import Registry
 from .transport import SimNet
 
 log = logging.getLogger(__name__)
@@ -69,6 +72,7 @@ class SimNode:
         self.stack: Consensus | None = None
         self.commits: asyncio.Queue | None = None
         self.drain: asyncio.Task | None = None
+        self.tel: NodeTelemetry | None = None
         self.alive = False
         self.restarts = 0
 
@@ -123,6 +127,19 @@ class SimCluster:
         node = self.nodes[i]
         node.store = Store(node.path, engine=WalEngine(node.path))
         node.commits = asyncio.Queue()
+        # Per-node flight recorder on a PRIVATE registry (the global one
+        # belongs to the host process).  resume=True so a crash-restart
+        # keeps the pre-crash segments: the merge dedups the (node, seq)
+        # overlap and critical-path attribution spans the whole run.
+        short = str(node.pk)[:8]
+        node.tel = NodeTelemetry(short, registry=Registry())
+        node.tel.attach_journal(
+            Journal(
+                short,
+                os.path.join(self.workdir, "journals"),
+                resume=node.restarts > 0,
+            )
+        )
         node.stack = await Consensus.spawn(
             node.pk,
             self.membership,
@@ -132,6 +149,7 @@ class SimCluster:
             node.commits,
             bind_host="127.0.0.1",
             transport="sim",
+            telemetry=node.tel,
         )
         node.drain = asyncio.get_running_loop().create_task(
             self._drain(node.commits), name=f"sim-drain-{i}"
@@ -163,6 +181,8 @@ class SimCluster:
         except asyncio.CancelledError:
             pass
         node.store.close()
+        if node.tel is not None and node.tel.journal is not None:
+            node.tel.journal.close()
         k = max(0, int(torn_bytes))
         if k:
             rng = random.Random(f"sim-torn|{self.seed}|{i}")
@@ -182,8 +202,9 @@ class SimCluster:
         node = self.nodes[i]
         if node.alive:
             return
-        await self.start_node(i)
+        # bump BEFORE start_node: restarts > 0 is its resume signal
         node.restarts += 1
+        await self.start_node(i)
         log.info("sim: node %d restarted", i)
 
     async def stop_all(self) -> None:
@@ -198,6 +219,8 @@ class SimCluster:
             except asyncio.CancelledError:
                 pass
             node.store.close()
+            if node.tel is not None and node.tel.journal is not None:
+                node.tel.journal.close()
 
     # -- schedule execution ---------------------------------------------
 

@@ -62,6 +62,11 @@ class SimVerdict:
     journal_digest: str
     block: str  #: rendered CHAOS/BYZ/RECONFIG report
     failures: list[str] = dataclasses.field(default_factory=list)
+    #: commit critical-path attribution document (telemetry/critpath.py
+    #: ``attribution()`` shape) merged from the committee's per-node
+    #: flight-recorder journals; None when the run committed nothing.
+    #: Deterministic per seed — virtual clocks stamp the journals.
+    attribution: dict | None = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -224,6 +229,26 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
         capture.records, os.path.join(workdir, "journal.jsonl")
     )
 
+    # stage attribution from the committee's flight-recorder journals
+    # (best-effort: an attribution failure must never fail the verdict)
+    attribution: dict | None = None
+    try:
+        journals_dir = os.path.join(workdir, "journals")
+        if os.path.isdir(journals_dir):
+            from benchmark.traces import TraceSet
+
+            from ..telemetry import critpath as _critpath
+
+            traces = TraceSet.load(journals_dir)
+            if traces.journals:
+                report = _critpath.analyze(traces)
+                if report.commits:
+                    attribution = report.attribution()
+    except Exception as exc:  # noqa: BLE001 — observability is advisory
+        logging.getLogger(__name__).warning(
+            "sim critpath attribution failed: %s", exc
+        )
+
     all_ok, block = check_run(logs_dir, spec, epoch_unix=SIM_EPOCH)
     commits = commits_from_logs(logs_dir)
     safety_ok, safety_viol = check_safety(commits)
@@ -267,6 +292,7 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
         journal_digest=journal_digest,
         block=block,
         failures=failures,
+        attribution=attribution,
     )
 
 

@@ -62,6 +62,7 @@ HEALTH_KINDS: tuple = (
     "shed_storm",
     "root_divergence",
     "epoch_skew",
+    "crit_regime_shift",
 )
 
 # ---- delta-frame wire format ----------------------------------------------
@@ -429,6 +430,44 @@ def root_divergence(roots_by_node: dict) -> list:
     return out
 
 
+def crit_regime_shift(
+    regime_samples, confirm: int = 3, node: str = ""
+) -> Incident | None:
+    """The node's rolling commit critical-path regime changed and STUCK.
+
+    ``regime_samples``: oldest-to-newest regime strings (one per health
+    tick with enough commit samples; ticks without an attribution are
+    simply not pushed).  Fires when the newest ``confirm`` consecutive
+    samples agree on a regime different from the one established before
+    them — a one-tick flap (a single slow round misclassified) never
+    pages, but "this committee went from verify-bound to network-bound
+    and stayed there" does.  Pure function: unit-testable with fixture
+    windows like every other detector here.
+    """
+    seq = [r for r in regime_samples if r and r != "unknown"]
+    if len(seq) < confirm + 1:
+        return None
+    head = seq[-confirm:]
+    new = head[0]
+    if any(r != new for r in head):
+        return None  # the shift has not settled yet
+    prev = None
+    for r in reversed(seq[:-confirm]):
+        if r != new:
+            prev = r
+            break
+    if prev is None:
+        return None
+    return Incident(
+        "crit_regime_shift",
+        node,
+        "warn",
+        f"commit critical path shifted {prev} -> {new} "
+        f"(confirmed over {confirm} ticks)",
+        float(confirm),
+    )
+
+
 def epoch_skew(epochs_by_node: dict) -> list:
     """Committee-epoch disagreement across the live fleet (ISSUE 14):
     every node's ``core_epoch`` gauge should match once a
@@ -558,6 +597,7 @@ class HealthMonitor:
         stall_k: float = 3.0,
         campaign_path: str | None = None,
         logger=None,
+        attribution_fn=None,
     ):
         self._tel = tel
         self.node = node
@@ -570,6 +610,13 @@ class HealthMonitor:
         self._w_tcs = Window(span_s=span)
         self._w_shed = Window(span_s=span)
         self._tc_ewma: float | None = None
+        # rolling commit critical-path attribution: ``attribution_fn``
+        # (wired by the node from telemetry.critpath.rolling_attribution
+        # over the trace ring — this module stays import-free) returns
+        # {"dominant", "regime", ...} or None when under-sampled
+        self._attribution_fn = attribution_fn
+        self._regimes: deque = deque(maxlen=32)
+        self.last_attribution: dict | None = None
         self._open: dict = {}  # kind -> Incident
         self._quiet: dict = {}  # kind -> consecutive quiet ticks
         self.recorder = CampaignRecorder(
@@ -625,6 +672,21 @@ class HealthMonitor:
         inc = shed_storm(self._w_shed.samples(), node=self.node)
         if inc:
             fired.append(inc)
+        if self._attribution_fn is not None:
+            try:
+                att = self._attribution_fn()
+            except Exception:  # noqa: BLE001 — attribution is advisory
+                att = None
+            if att:
+                self.last_attribution = att
+                regime = att.get("regime")
+                if regime:
+                    self._regimes.append(regime)
+                inc = crit_regime_shift(
+                    list(self._regimes), node=self.node
+                )
+                if inc:
+                    fired.append(inc)
 
         self._transition(fired, round_)
 
@@ -698,6 +760,7 @@ __all__ = [
     "shed_storm",
     "root_divergence",
     "epoch_skew",
+    "crit_regime_shift",
     "CampaignRecorder",
     "HealthMonitor",
 ]

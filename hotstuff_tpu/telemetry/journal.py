@@ -30,23 +30,38 @@ Design constraints (ISSUE 2 tentpole):
 
 Record wire format (one JSON object per line)::
 
-    {"e":"commit","r":12,"d":"wT2Fq1p...","p":"","m":123456789,"w":1699...}
+    {"e":"commit","r":12,"d":"wT2Fq1p...","p":"","m":123456789,"w":1699...,"s":41}
 
 ``e`` event name, ``r`` round (0 = n/a), ``d`` block digest (16-char
 base64 prefix, the same display the node logs use; "" = n/a), ``p``
 peer (8-char node id, "" = n/a / broadcast), ``m`` monotonic ns, ``w``
-wall-clock ns.  Each segment opens with a ``{"e":"meta",...}`` line
-naming the node (filenames are sanitized and must not be trusted).
+wall-clock ns, ``s`` per-node record sequence number (monotonic across
+segments and — with ``resume=True`` — across restarts; the merge in
+``benchmark/traces.py`` dedups replayed records by (node, s)).  Each
+segment opens with a ``{"e":"meta",...}`` line naming the node
+(filenames are sanitized and must not be trusted) and carrying the
+cumulative ``tot``/``drop`` record counters, so trace-time consumers
+can report journal coverage instead of silently attributing from a
+truncated ring.
+
+Timestamps route through the ambient clock seam
+(``hotstuff_tpu/utils/clock.py``): production reads real time, the
+deterministic simulator's VirtualClock makes journal content — and
+therefore critical-path attribution — reproducible per seed.
 """
 
 from __future__ import annotations
 
 import atexit
+import json
 import logging
 import os
+import re
 import signal
 import threading
 import time
+
+from ..utils.clock import SYSTEM_CLOCK, default_clock
 
 log = logging.getLogger(__name__)
 
@@ -122,13 +137,18 @@ class Journal:
         "buffer_records",
         "records_total",
         "segments_rotated",
+        "dropped_records_total",
         "_prefix",
         "_buf",
         "_file",
         "_bytes",
         "_seq",
+        "_rec_seq",
         "_paths",
+        "_path_records",
         "_closed",
+        "_mono_ns",
+        "_wall_ns",
     )
 
     def __init__(
@@ -139,6 +159,7 @@ class Journal:
         segment_bytes: int = SEGMENT_BYTES,
         segments: int = SEGMENTS,
         buffer_records: int = BUFFER_RECORDS,
+        resume: bool = False,
     ):
         self.node = str(node)
         self.dir = dir_path
@@ -147,26 +168,91 @@ class Journal:
         self.buffer_records = max(1, int(buffer_records))
         self.records_total = 0
         self.segments_rotated = 0
+        self.dropped_records_total = 0
         self._prefix = _sanitize(self.node)
         self._buf: list[tuple] = []
         self._file = None
         self._bytes = 0
         self._seq = 0
+        self._rec_seq = 0
         self._paths: list[str] = []
+        self._path_records: list[int] = []
         self._closed = False
+        # Bind the ambient clock once at boot: real time in production,
+        # the simulator's VirtualClock when run_schedule swapped the seam
+        # before spawning the committee (deterministic journal content).
+        clk = default_clock()
+        self._mono_ns = clk.monotonic_ns
+        wall_ns = getattr(clk, "time_ns", None)
+        if wall_ns is None:
+            if clk is SYSTEM_CLOCK:
+                wall_ns = time.time_ns
+            else:
+                wall_ns = lambda c=clk: int(c.time() * 1e9)  # noqa: E731
+        self._wall_ns = wall_ns
         os.makedirs(self.dir, exist_ok=True)
-        # a previous run's segments under the same prefix would merge
-        # into this run's timeline at trace time — drop them
-        for fname in os.listdir(self.dir):
-            if fname.startswith(self._prefix + "-") and fname.endswith(
-                ".jsonl"
-            ):
-                try:
-                    os.unlink(os.path.join(self.dir, fname))
-                except OSError:
-                    pass
+        if resume:
+            # crash-restart: keep the previous boot's segments and keep
+            # numbering (segments AND record seqs) after them, so the
+            # merge sees one continuous, dedupable per-node stream
+            self._resume_scan()
+        else:
+            # a previous run's segments under the same prefix would
+            # merge into this run's timeline at trace time — drop them
+            for fname in os.listdir(self.dir):
+                if fname.startswith(self._prefix + "-") and fname.endswith(
+                    ".jsonl"
+                ):
+                    try:
+                        os.unlink(os.path.join(self.dir, fname))
+                    except OSError:
+                        pass
         _JOURNALS.append(self)
         _install_crash_hooks()
+
+    def _resume_scan(self) -> None:
+        """Adopt pre-existing ring segments (crash-restart): re-enter
+        them into the ring accounting and continue the segment / record
+        sequence numbering after the highest persisted values.  A torn
+        tail line may hide the true max seq — restart records then reuse
+        seq values, which the (node, seq) merge dedup resolves by
+        keeping the first occurrence."""
+        seg_re = re.compile(
+            re.escape(self._prefix) + r"-(\d{6})\.jsonl$"
+        )
+        found: list[tuple[int, str]] = []
+        for fname in os.listdir(self.dir):
+            m = seg_re.match(fname)
+            if m:
+                found.append((int(m.group(1)), os.path.join(self.dir, fname)))
+        found.sort()
+        max_s = -1
+        for seg_no, path in found:
+            nrec = 0
+            try:
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:  # torn tail line
+                            continue
+                        if rec.get("e") == "meta":
+                            self.dropped_records_total = max(
+                                self.dropped_records_total,
+                                int(rec.get("drop", 0)),
+                            )
+                            continue
+                        nrec += 1
+                        s = rec.get("s")
+                        if isinstance(s, int) and s > max_s:
+                            max_s = s
+            except OSError:
+                continue
+            self._paths.append(path)
+            self._path_records.append(nrec)
+            self.records_total += nrec
+            self._seq = max(self._seq, seg_no + 1)
+        self._rec_seq = max_s + 1
 
     # ---- hot path --------------------------------------------------------
 
@@ -183,6 +269,8 @@ class Journal:
         ``dur_ns`` (optional) marks a DURATION record — a span ending at
         this record's timestamps (the verify-pipeline profiler's
         ``span`` events); it lands in the wire format as ``"u"``."""
+        s = self._rec_seq
+        self._rec_seq = s + 1
         buf = self._buf
         buf.append(
             (
@@ -190,8 +278,9 @@ class Journal:
                 round_,
                 digest,
                 peer,
-                time.monotonic_ns(),
-                time.time_ns(),
+                self._mono_ns(),
+                self._wall_ns(),
+                s,
                 dur_ns,
             )
         )
@@ -208,12 +297,12 @@ class Journal:
             return
         self._buf = []
         parts = []
-        for e, r, d, p, m, w, u in buf:
+        for e, r, d, p, m, w, s, u in buf:
             ds = d.encode_base64()[:16] if d is not None else ""
             tail = f',"u":{u}' if u is not None else ""
             parts.append(
-                f'{{"e":"{e}","r":{r},"d":"{ds}","p":"{p}","m":{m},"w":{w}'
-                f"{tail}}}\n"
+                f'{{"e":"{e}","r":{r},"d":"{ds}","p":"{p}","m":{m},"w":{w},'
+                f'"s":{s}{tail}}}\n'
             )
         data = "".join(parts)
         try:
@@ -227,10 +316,16 @@ class Journal:
             return
         self._bytes += len(data)
         self.records_total += len(buf)
+        if self._path_records:
+            self._path_records[-1] += len(buf)
         if self._bytes >= self.segment_bytes:
             self._rotate()
 
     def _open_segment(self):
+        # enforce the ring bound before adding a segment (rotation also
+        # trims, but a resumed ring can already be at capacity here)
+        while len(self._paths) >= self.segments:
+            self._drop_oldest()
         path = os.path.join(
             self.dir, f"{self._prefix}-{self._seq:06d}.jsonl"
         )
@@ -238,14 +333,27 @@ class Journal:
         self._file = f
         self._bytes = 0
         self._paths.append(path)
+        self._path_records.append(0)
         meta = (
             f'{{"e":"meta","n":"{self.node}","seg":{self._seq},'
-            f'"pid":{os.getpid()},"m":{time.monotonic_ns()},'
-            f'"w":{time.time_ns()}}}\n'
+            f'"pid":{os.getpid()},"m":{self._mono_ns()},'
+            f'"w":{self._wall_ns()},"tot":{self.records_total},'
+            f'"drop":{self.dropped_records_total}}}\n'
         )
         f.write(meta)
         self._bytes += len(meta)
         return f
+
+    def _drop_oldest(self) -> None:
+        """Unlink the oldest ring segment, counting its records as
+        dropped — the no-silent-caps counter behind ``journal coverage``
+        in the + CRITPATH block and ``journal.dropped`` in /delta."""
+        oldest = self._paths.pop(0)
+        self.dropped_records_total += self._path_records.pop(0)
+        try:
+            os.unlink(oldest)
+        except OSError:
+            pass
 
     def _rotate(self) -> None:
         if self._file is not None:
@@ -257,11 +365,7 @@ class Journal:
         self._seq += 1
         self.segments_rotated += 1
         while len(self._paths) >= self.segments:
-            oldest = self._paths.pop(0)
-            try:
-                os.unlink(oldest)
-            except OSError:
-                pass
+            self._drop_oldest()
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -288,6 +392,7 @@ class Journal:
             "buffered": len(self._buf),
             "segments": len(self._paths),
             "rotated": self.segments_rotated,
+            "dropped": self.dropped_records_total,
             "dir": self.dir,
         }
 

@@ -83,6 +83,7 @@ BLOCK_EDGES: tuple = (
     "recv.propose",
     "vote.send",
     "recv.vote",
+    "qc.form",
     "qc",
     "commit",
 )
@@ -147,6 +148,36 @@ JOURNAL_EDGES: frozenset = frozenset(
 )
 
 
+# ---- commit critical-path stages (telemetry/critpath.py) -------------------
+
+#: critical-path stage taxonomy: every stage the commit critical-path
+#: engine (``telemetry/critpath.py``) attributes latency to.  Two-round
+#: chained-HotStuff commit means the per-round stages (net.propose,
+#: vote.local, net.vote, agg.form) each appear once per chained round
+#: and sum into one bucket.  ``unattributed`` is the residual between
+#: the measured propose->commit wall and the sum of reconstructed
+#: segments — rendered, never hidden.
+CRITPATH_STAGES: tuple = (
+    "ingest.wait",  # leader payload wait: producer recv -> propose
+    "net.propose",  # propose broadcast -> quorum-th replica receive
+    "vote.local",  # replica receive -> vote send (verify + sign)
+    "net.vote",  # vote send -> receive at the aggregating node
+    "agg.form",  # quorum-th vote receive -> QC assembled
+    "lead.handoff",  # QC formed -> next-round proposal broadcast
+    "commit.exec",  # chained QC formed -> commit observed at the node
+    "unattributed",  # residual: measured total minus reconstructed sum
+)
+
+#: regime classification: which stage buckets vote for which regime —
+#: the argmax group over attributed milliseconds names the run
+CRITPATH_REGIMES: dict = {
+    "ingest-bound": ("ingest.wait",),
+    "network-bound": ("net.propose", "net.vote", "commit.exec"),
+    "verify-bound": ("vote.local",),
+    "aggregation-bound": ("agg.form", "lead.handoff"),
+}
+
+
 def is_registered_edge(name: str) -> bool:
     """Is ``name`` a registered journal edge (static or dynamic)?"""
     return name in JOURNAL_EDGES or name.startswith(JOURNAL_EDGE_PREFIXES)
@@ -175,6 +206,8 @@ __all__ = [
     "RECONFIG_PREFIX",
     "JOURNAL_EDGE_PREFIXES",
     "JOURNAL_EDGES",
+    "CRITPATH_STAGES",
+    "CRITPATH_REGIMES",
     "is_registered_edge",
     "is_registered_stage",
 ]

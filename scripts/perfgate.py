@@ -158,6 +158,26 @@ GUARDS = (
         -1,
         0.5,
     ),
+    # commit critical-path attribution (ISSUE 17): end-to-end commit
+    # latency p50 and attribution coverage from the journal-merged
+    # critpath engine over a sim sweep.  Whole-committee Python on a
+    # shared rig — wide gates; skip-if-missing covers references from
+    # before the critpath block existed.  The attribution SHAPE (per
+    # stage share) is gated separately by attribution_check() below —
+    # a stage whose share of commit latency balloons fails the gate
+    # even when these scalars hold.
+    (
+        "critpath.p50_ms",
+        lambda doc: (doc.get("critpath") or {}).get("p50_ms"),
+        +1,
+        0.75,
+    ),
+    (
+        "critpath.coverage_pct",
+        lambda doc: (doc.get("critpath") or {}).get("coverage_pct"),
+        -1,
+        0.25,
+    ),
 )
 
 #: the ratcheted metric: lower is better, fresh must stay within
@@ -254,6 +274,28 @@ def ratchet_check(
     return []
 
 
+def attribution_check(fresh: dict, ref: dict) -> list[str]:
+    """Attribution-shape gate: failure messages when any critical-path
+    stage's SHARE of commit latency regressed past the engine tolerance
+    (HOTSTUFF_CRITPATH_DIFF_PP) — the scalar-blind regression the plain
+    guards cannot see.  Skip-if-missing on either side, and degrade to
+    skip when the engine is unimportable (perfgate must run anywhere)."""
+    f, r = fresh.get("critpath"), ref.get("critpath")
+    if not isinstance(f, dict) or not isinstance(r, dict):
+        return []
+    try:
+        sys.path.insert(0, REPO)
+        from hotstuff_tpu.telemetry import critpath as engine
+
+        from benchmark.critpath import diff_share_pp
+    except Exception:  # noqa: BLE001 — shape gate is best-effort extra
+        return []
+    return [
+        f"critpath attribution: {msg}"
+        for msg in engine.diff(f, r, share_pp=diff_share_pp())
+    ]
+
+
 def compare(fresh: dict, ref: dict, threshold: float = 0.15) -> list[str]:
     """Failure messages for every guarded metric past the threshold.
     A metric missing on either side is skipped (a bench that stopped
@@ -330,6 +372,7 @@ def main(argv=None) -> int:
         return 1
 
     failures = compare(fresh, ref_doc, args.threshold)
+    failures += attribution_check(fresh, ref_doc)
     ratcheted = ""
     if not args.no_ratchet:
         best = load_best()

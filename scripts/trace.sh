@@ -73,6 +73,15 @@
 #                                 # trusted-subset, and a double-run
 #                                 # determinism probe; non-zero exit on
 #                                 # any break
+#   CRIT=1 scripts/trace.sh       # ONLY the commit critical-path check
+#                                 # (scripts/critpath_check.py): a
+#                                 # journaled 4-node run must attribute
+#                                 # with >= 90% coverage and print the
+#                                 # + CRITPATH block, the --diff gate
+#                                 # passes unchanged / fails a planted
+#                                 # stage-share regression, and the
+#                                 # regime classification is stable
+#                                 # across two identical runs
 #   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
 #                                 # (scripts/analysis_check.py): every
 #                                 # hotstuff_tpu/analysis lint rule,
@@ -127,6 +136,11 @@ fi
 if [ "${SIM:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/sim_check.py "$@"
+fi
+
+if [ "${CRIT:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/critpath_check.py "$@"
 fi
 
 if [ "${LINT:-0}" = "1" ]; then
