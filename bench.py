@@ -748,6 +748,47 @@ def bench_critpath(seed: int = 1, nodes: int = 4) -> dict | None:
         return None
 
 
+def bench_adapt(schedules: int = 6, nodes: int = 4) -> dict | None:
+    """Adaptive-adversary search throughput probe (docs/FAULTS.md): a
+    short sweep of adaptive-profile schedules — state-reactive byz
+    policies live at the consensus seams — measuring how fast this host
+    chews through guided-search candidates (``adapt.schedules_per_min``)
+    and how fast the selection loop scores verdicts
+    (``adapt.fitness_evals_per_s``; pure-Python fitness over the
+    verdict, so it bounds the non-simulation overhead of a generation).
+    Feeds the matching perfgate guards; returns None (key omitted,
+    guards skip) on any failure so the kernel benchmarks above still
+    publish."""
+    try:
+        from hotstuff_tpu.sim import draw_schedule, fitness, run_schedule
+
+        verdicts = []
+        t0 = time.perf_counter()
+        for seed in range(schedules):
+            verdicts.append(
+                run_schedule(
+                    draw_schedule(seed, nodes=nodes, profile="adaptive")
+                )
+            )
+        sched_s = time.perf_counter() - t0
+
+        evals = 2000
+        t0 = time.perf_counter()
+        for k in range(evals):
+            fitness(verdicts[k % len(verdicts)])
+        fit_s = time.perf_counter() - t0
+        return {
+            "schedules": schedules,
+            "nodes": nodes,
+            "threats": sum(1 for v in verdicts if v.threats),
+            "schedules_per_min": round(schedules * 60.0 / sched_s, 1),
+            "fitness_evals_per_s": round(evals / fit_s),
+        }
+    except Exception as e:  # the bench must survive a broken adapt plane
+        print(f"bench_adapt skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def probe_tunnel(inflight: int = 16, reps: int = 7) -> dict:
     """Tunnel weather, two views over the same tiny resident-arg jit
     call, pinned in the output so end-to-end swings between rounds are
@@ -846,6 +887,10 @@ def main() -> int:
     # seed; key omitted on failure so the critpath guards skip
     critpath = bench_critpath()
 
+    # adaptive-adversary guided-search throughput; key omitted on
+    # failure so the perfgate adapt guards skip instead of failing
+    adapt = bench_adapt()
+
     print(
         json.dumps(
             {
@@ -867,6 +912,7 @@ def main() -> int:
                 **({"state": state} if state is not None else {}),
                 **({"sim": sim} if sim is not None else {}),
                 **({"critpath": critpath} if critpath is not None else {}),
+                **({"adapt": adapt} if adapt is not None else {}),
             }
         )
     )

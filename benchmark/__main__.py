@@ -206,6 +206,87 @@ def task_chaos(args) -> int:
     return 0 if ok else 1
 
 
+def _explore_guided(args, out_dir: str) -> int:
+    """``explore --guided``: fitness-guided schedule search (ISSUE 18).
+    Same run budget as the flat sweep (--seeds); prints a GUIDED
+    SUMMARY plus a machine-readable last line for
+    scripts/adapt_check.py."""
+    import json
+    import time
+
+    from hotstuff_tpu.sim import explore_guided
+
+    t0 = time.monotonic()
+    result = explore_guided(
+        budget=args.seeds,
+        nodes=args.nodes,
+        start_seed=args.start,
+        duration_s=args.duration,
+        out_dir=out_dir,
+        do_shrink=not args.no_shrink,
+        corpus_path=args.corpus,
+        scenarios_dir=args.scenarios_dir,
+        progress=Print.info,
+    )
+    dt = time.monotonic() - t0
+    print(
+        "\n"
+        "-----------------------------------------\n"
+        " GUIDED EXPLORE SUMMARY:\n"
+        "-----------------------------------------\n"
+        f" Budget: {result.budget} schedules "
+        f"({result.generations} generations, {args.nodes} nodes)\n"
+        f" Passed: {result.passed}/{result.budget}\n"
+        f" Invariant-threatening: {result.threats} "
+        f"(best fitness {result.best_fitness})\n"
+        f" Findings: {len(result.findings)}\n"
+        f" Promoted: {len(result.promoted)} corpus entries, "
+        f"{len(result.scenarios)} canned scenarios\n"
+        f" Wall-clock: {dt:.1f}s "
+        f"({dt / max(result.budget, 1):.2f}s/schedule)\n"
+        "-----------------------------------------"
+    )
+    for f in result.findings:
+        Print.error(
+            f"seed {f.seed} ({f.profile}) FAILED: "
+            + "; ".join(f.failures[:3])
+        )
+        if f.repro_dir:
+            Print.error(f"  repro bundle: {f.repro_dir}")
+    for path in result.scenarios:
+        Print.info(f"canned scenario: {path}")
+    if result.ok:
+        Print.info(
+            "every discovered threat was a correctly-contained attack"
+        )
+    else:
+        Print.error("guided search found profile-expectation failures")
+    # last line: the machine-readable document (scripts/adapt_check.py)
+    print(json.dumps({
+        "guided": {
+            "budget": result.budget,
+            "generations": result.generations,
+            "passed": result.passed,
+            "threats": result.threats,
+            "best_fitness": result.best_fitness,
+            "findings": len(result.findings),
+            "promoted": [
+                {
+                    "seed": e["seed"],
+                    "profile": e["profile"],
+                    "ok": e["ok"],
+                    "threats": e["threats"],
+                    "journal_digest": e["journal_digest"],
+                }
+                for e in result.promoted
+            ],
+            "scenarios": result.scenarios,
+            "regimes": result.regimes,
+        }
+    }))
+    return 0 if result.ok else 1
+
+
 def task_explore(args) -> int:
     """Seeded schedule exploration in the deterministic simulator
     (docs/SIM.md): each seed draws a fault/crash/reconfig schedule, runs
@@ -221,6 +302,8 @@ def task_explore(args) -> int:
     out_dir = args.out or os.path.join(
         PathMaker.logs_path(), "sim-explore"
     )
+    if getattr(args, "guided", False):
+        return _explore_guided(args, out_dir)
     t0 = time.monotonic()
     result = explore(
         seeds=args.seeds,
@@ -240,6 +323,7 @@ def task_explore(args) -> int:
         f" Seeds: {result.seeds} (start {args.start}, {args.nodes} nodes)\n"
         f" Passed: {result.passed}/{result.seeds} "
         f"(honest={result.honest} byz={result.byz})\n"
+        f" Invariant-threatening: {result.threats}\n"
         f" Findings: {len(result.findings)}\n"
         f" Wall-clock: {dt:.1f}s "
         f"({dt / max(result.seeds, 1):.2f}s/seed)\n"
@@ -793,6 +877,27 @@ def main(argv=None) -> int:
         "--no-shrink",
         action="store_true",
         help="skip greedy schedule shrinking on failure",
+    )
+    p.add_argument(
+        "--guided",
+        action="store_true",
+        help="fitness-guided search (adaptive adversaries + schedule "
+        "mutation across generations) at the same run budget as the "
+        "flat sweep; threatening schedules are shrunk and promoted",
+    )
+    p.add_argument(
+        "--corpus",
+        default=None,
+        metavar="FILE",
+        help="with --guided: append promoted schedules to this "
+        "regression corpus (tests/data/sim_seeds.json dialect)",
+    )
+    p.add_argument(
+        "--scenarios-dir",
+        default=None,
+        metavar="DIR",
+        help="with --guided: emit promoted schedules as canned chaos "
+        "scenario specs (consumable via `benchmark chaos --spec`)",
     )
     p.set_defaults(fn=task_explore)
 

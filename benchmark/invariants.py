@@ -41,7 +41,14 @@ StateRoot = tuple[int, str, int]
 # mirroring the RE_COMMITTED approach: the node's log IS its history).
 RE_BYZ_ATTACK = re.compile(
     r"byz (equivocate|forge-qc|withhold|double-vote|flood|shadow-commit"
-    r"|reconfig-forge|reconfig-shadow)"
+    r"|reconfig-forge|reconfig-shadow"
+    r"|adapt-ambush|adapt-sync|adapt-surf|adapt-snipe"
+    r"|sync-withhold|vote-delay)"
+)
+# Credit-capped flood admission accounting (faults/adversary.py
+# ``ingest_flood``): the victim's typed ACK stream, summed per node.
+RE_FLOOD_ADMISSION = re.compile(
+    r"byz flood admission: accepted (\d+) shed (\d+)"
 )
 # The epoch-activation observation regex (``Epoch <e> activated at
 # round <r>``) is shared with the SUMMARY parser: see logs.RE_EPOCH.
@@ -320,6 +327,11 @@ def byz_activity_from_logs(logs_dir: str) -> dict[str, dict[str, int]]:
         counts: dict[str, int] = {}
         for policy in RE_BYZ_ATTACK.findall(content):
             counts[policy] = counts.get(policy, 0) + 1
+        for accepted, shed in RE_FLOOD_ADMISSION.findall(content):
+            counts["flood_accepted"] = (
+                counts.get("flood_accepted", 0) + int(accepted)
+            )
+            counts["flood_shed"] = counts.get("flood_shed", 0) + int(shed)
         qc_rejects = len(RE_QC_REJECT.findall(content))
         if qc_rejects:
             counts["qc_reject"] = qc_rejects
@@ -580,13 +592,25 @@ def byz_block(
         attacks = {
             k: v
             for k, v in activity.get(name, {}).items()
-            if k not in ("qc_reject", "vote_conflict")
+            if k not in (
+                "qc_reject", "vote_conflict",
+                "flood_accepted", "flood_shed",
+            )
         }
         if attacks:
             who += " — " + ", ".join(
                 f"{k} x{v}" for k, v in sorted(attacks.items())
             )
         lines.append(who + "\n")
+        counts = activity.get(name, {})
+        if counts.get("flood_accepted") or counts.get("flood_shed"):
+            # credit-capped flood: the victim's admission verdict on the
+            # attacker's producer batches (shed = the plane held)
+            lines.append(
+                f"   flood admission at victim: "
+                f"accepted {counts.get('flood_accepted', 0)}, "
+                f"shed {counts.get('flood_shed', 0)}\n"
+            )
     defended = {
         node: counts
         for node, counts in sorted(activity.items())

@@ -754,6 +754,12 @@ class Consensus:
                     ("byz_shadow_commits", "Shadow-branch commits logged"),
                     ("byz_forged_reconfigs", "Forged reconfig ops proposed"),
                     ("byz_shadow_epochs", "Skewed epoch activations logged"),
+                    ("byz_flood_accepted", "Flood payloads the victim admitted"),
+                    ("byz_flood_shed", "Flood payloads the victim shed"),
+                    ("byz_adapt_ambush", "ambush-leader trigger firings"),
+                    ("byz_adapt_sync", "sync-predator trigger firings"),
+                    ("byz_adapt_surf", "timeout-surfer trigger firings"),
+                    ("byz_adapt_snipe", "reconfig-sniper trigger firings"),
                 ):
                     telemetry.gauge(
                         count_name,
@@ -816,6 +822,23 @@ class Consensus:
             adversary=adversary,
             state_machine=state_machine,
         )
+        if adversary is not None:
+            # Adaptive adversary state view (faults/adaptive.py): pure
+            # reads of local protocol state, installed before any task
+            # runs so triggers never observe a half-built node.  The
+            # committee schedule and timer are read live — reconfig
+            # splices and view-change backoff show through.
+            adversary.bind_view({
+                "round": lambda c=self.core: c.round,
+                "leader": lambda r, le=leader_elector: le.get_leader(r),
+                "self": lambda n=name: n,
+                "last_tc_round": lambda c=self.core: c._last_tc_round,
+                "timeout_ms": lambda c=self.core: c.timer.duration * 1000.0,
+                "credit": lambda a=admission: a.last_credit,
+                "boundaries": lambda c=committee: tuple(
+                    r for r, _ in getattr(c, "entries", ()) if r > 0
+                ),
+            })
         # State-sync plane (statesync.py): every node serves snapshots;
         # a recovering node (surviving consensus state ⇒ this is a
         # restart, not a first boot) additionally runs the one-shot
@@ -831,6 +854,7 @@ class Consensus:
             network=make_sender(),
             telemetry=telemetry,
             store=store,
+            adversary=adversary,
         )
         sync_mode = os.environ.get("HOTSTUFF_STATE_SYNC", "auto").lower()
         if sync_mode not in ("0", "off", "never"):

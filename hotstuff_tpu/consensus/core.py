@@ -32,6 +32,7 @@ from ..crypto.async_service import AsyncVerifyService
 from ..crypto.service import VerifierBackend
 from ..network import SimpleSender
 from ..store import Store
+from ..utils.clock import default_clock
 from ..utils.codec import Decoder, Encoder
 from .aggregator import ROUND_LOOKAHEAD, Aggregator
 from .config import Committee, InvalidCommittee
@@ -336,6 +337,10 @@ class Core:
         self._timeout_exponent = 0
         # TC advances since the last QC advance (see _advance_round)
         self._consecutive_tcs = 0
+        # The round most recently advanced past via a TC — the adaptive
+        # adversary's ambush-leader trigger reads this through the
+        # state view (faults/adaptive.py); None until the first TC.
+        self._last_tc_round: Round | None = None
         # Did the current round show any sign of life (a proposal for
         # it)?  An IDLE timeout — no proposal seen and no uncommitted
         # payload block in flight — is the committee waiting for
@@ -723,10 +728,17 @@ class Core:
                 reported_round = from_round
                 break
         adversary = self.adversary
-        if adversary is not None and adversary.active("reconfig"):
+        snipes = (
+            adversary.wants("reconfig", self.round)
+            if adversary is not None else False
+        )
+        if snipes:
             # reconfig policy (shadow half): claim the activation at a
             # skewed round — a divergent epoch history the
-            # epoch-agreement invariant must catch and attribute
+            # epoch-agreement invariant must catch and attribute.  The
+            # reconfig-sniper fires the same attack, but only inside
+            # the epoch-activation margin (wants returns its token).
+            adversary.mark_adaptive(snipes, self.round, self.log)
             reported_round = reported_round + 1 + (epoch % 3)
             adversary.count("byz_shadow_epochs")
             adversary.record("reconfig-shadow", self.round)
@@ -770,6 +782,7 @@ class Core:
         #   convergence under asynchrony is preserved.
         if via_tc:
             self._consecutive_tcs += 1
+            self._last_tc_round = round_
             snap = self._consecutive_tcs == 1
             if self._trace is not None:
                 self._trace.mark_tc_advance()
@@ -1041,10 +1054,17 @@ class Core:
             return
 
         adversary = self.adversary
-        if adversary is not None and adversary.active("withhold"):
+        withholds = (
+            adversary.wants("withhold", block.round)
+            if adversary is not None else False
+        )
+        if withholds:
             # withhold policy: receive, never vote — the committee must
             # reach quorum without us (timeouts), and recover liveness
-            # once the window closes
+            # once the window closes.  Also the reconfig-sniper's
+            # withhold half (wants returns its token near an epoch
+            # activation boundary).
+            adversary.mark_adaptive(withholds, block.round, self.log)
             adversary.count("byz_votes_withheld")
             adversary.record("withhold", block.round, block.digest())
             self.log.info(
@@ -1075,6 +1095,25 @@ class Core:
                 # own vote: we just signed it — no verification needed
                 await self._handle_vote(vote, sig_verified=True)
             else:
+                surfs = (
+                    adversary.wants("vote-delay", block.round)
+                    if adversary is not None else False
+                )
+                if surfs:
+                    # timeout-surfer (faults/adaptive.py): hold the vote
+                    # to a fraction of the OBSERVED view timer — the
+                    # collector reaches quorum just inside the timeout,
+                    # stretching every view without firing a TC
+                    delay = adversary.surf_delay_s(self.timer.duration)
+                    adversary.mark_adaptive(
+                        surfs, block.round, self.log, block.digest()
+                    )
+                    self.log.info(
+                        "byz vote-delay round %d: holding %.0f ms of "
+                        "%.0f ms timer", block.round, delay * 1e3,
+                        self.timer.duration * 1e3,
+                    )
+                    await default_clock().sleep(delay)
                 address = self.committee.address(next_leader)
                 await self.network.send(address, encode_vote(vote))
             if adversary is not None and adversary.active("double-vote"):

@@ -194,16 +194,19 @@ class Proposer:
             # another leader's block, or a competing op won): drop ours
             self.pending_reconfig = None
             op = None
-        if (
-            op is None
-            and self.adversary is not None
-            and self.adversary.active("reconfig")
-        ):
+        snipes = (
+            self.adversary.wants("reconfig", round_)
+            if op is None and self.adversary is not None else False
+        )
+        if snipes:
             # reconfig policy (forge half): attach a forged epoch change
             # — well-formed wire, hostile committee / bad sponsor — that
-            # MUST die in every honest voter's Block.verify
+            # MUST die in every honest voter's Block.verify.  The
+            # reconfig-sniper mounts the same forgery, but only inside
+            # the epoch-activation margin (wants returns its token).
             op = self.adversary.forged_reconfig(self.committee, round_)
             if op is not None:
+                self.adversary.mark_adaptive(snipes, round_, self.log)
                 self.adversary.count("byz_forged_reconfigs")
                 self.adversary.record("reconfig-forge", round_)
                 self.log.info("byz reconfig-forge round %d", round_)
@@ -289,7 +292,17 @@ class Proposer:
 
         await self.tx_loopback.put(block)
 
-        if self.adversary is not None and self.adversary.active("equivocate"):
+        ambushes = (
+            self.adversary.wants("equivocate", block.round)
+            if self.adversary is not None else False
+        )
+        if ambushes:
+            # schedule-driven equivocation, or the ambush-leader trigger
+            # (faults/adaptive.py): equivocate exactly when we lead a
+            # round seated by a fresh TC
+            self.adversary.mark_adaptive(
+                ambushes, block.round, self.log, block.digest()
+            )
             await self._byz_equivocate(block, names_addresses)
 
         # Control system: wait for 2f+1 total stake (ours included) to ACK

@@ -89,6 +89,7 @@ class StateSyncServer:
         network: SimpleSender | None = None,
         telemetry=None,
         store=None,
+        adversary=None,
     ):
         self.name = name
         self.committee = committee
@@ -100,8 +101,15 @@ class StateSyncServer:
         # links served in the manifest so a joiner can verify epoch
         # changes it never witnessed (docs/RECONFIG.md)
         self.store = store
+        # Byzantine adversary plane (faults/adversary.py): None on
+        # honest nodes; the chunk-serving path below is the
+        # sync-predator's attack seam (faults/adaptive.py)
+        self.adversary = adversary
         self._journal = telemetry.journal if telemetry is not None else None
         self._task: asyncio.Task | None = None
+        # per-node logger suffix: multi-node harnesses (sim, local
+        # bench) route records to the right node-*.log by logger name
+        self.log = logging.getLogger(f"{__name__}.{str(name)[:8]}")
 
     async def _schedule_links(self) -> tuple:
         if self.store is None:
@@ -128,6 +136,28 @@ class StateSyncServer:
                 )
                 continue
             if req.kind == STATE_REQ_CHUNK:
+                adversary = self.adversary
+                preys = (
+                    adversary.wants("sync-withhold")
+                    if adversary is not None else False
+                )
+                if preys:
+                    # sync-predator (faults/adaptive.py): withhold
+                    # exactly the chunks this bootstrapping peer needs —
+                    # manifests still flow, so the victim commits to a
+                    # sync it cannot finish until the window closes
+                    adversary.mark_adaptive(
+                        preys, req.from_round, self.log,
+                    )
+                    adversary.record(
+                        "sync-withhold", req.from_round, None,
+                        str(req.origin)[:8],
+                    )
+                    self.log.info(
+                        "byz sync-withhold chunk %d from %s",
+                        req.index, str(req.origin)[:8],
+                    )
+                    continue
                 entries = self.state.chunk(req.index, req.from_round)
                 reply = encode_state_chunk(
                     self.state.version, req.index, req.from_round, entries
@@ -149,6 +179,10 @@ class StateSyncServer:
                     links=await self._schedule_links(),
                 )
                 self.state.snapshots_served += 1
+                if self.adversary is not None:
+                    # sync-predator prey sensing: this peer just began a
+                    # snapshot bootstrap against us
+                    self.adversary.note_syncing(req.origin)
                 if self._journal is not None:
                     self._journal.record(
                         "sync.serve", m.last_round, None, str(req.origin)[:8]

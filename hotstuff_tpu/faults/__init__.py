@@ -5,6 +5,13 @@ the safety/liveness invariant definitions checked by
 ``python -m benchmark chaos``.
 """
 
+from .adaptive import (
+    ADAPTIVE_POLICIES,
+    ADAPTIVE_SHORT,
+    ADAPTIVE_TRIGGERS,
+    CountingRandom,
+    StateView,
+)
 from .adversary import (
     POLICIES,
     AdversaryPlane,
@@ -27,9 +34,14 @@ from .plane import (
 from .scenarios import SCENARIOS, build, last_heal
 
 __all__ = [
+    "ADAPTIVE_POLICIES",
+    "ADAPTIVE_SHORT",
+    "ADAPTIVE_TRIGGERS",
     "AdversaryPlane",
     "AdversaryRule",
     "BARRIER_POLL_S",
+    "CountingRandom",
+    "StateView",
     "Decision",
     "FaultPlane",
     "FaultRule",

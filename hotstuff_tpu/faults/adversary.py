@@ -61,9 +61,21 @@ import asyncio
 import hashlib
 import json
 import logging
-import random
+import os
 
 from ..utils.clock import default_clock
+from .adaptive import (
+    ADAPTIVE_POLICIES,
+    ADAPTIVE_SHORT,
+    ADAPTIVE_TRIGGERS,
+    CountingRandom,
+    StateView,
+    flood_batch_cap,
+    load_rng_state,
+    rng_state_path,
+    save_rng_state,
+    surf_fraction,
+)
 from .plane import _addr_key
 
 log = logging.getLogger(__name__)
@@ -76,7 +88,7 @@ POLICIES = (
     "flood",
     "collude",
     "reconfig",
-)
+) + ADAPTIVE_POLICIES
 
 #: flood policy burst cadence (seconds between bursts)
 FLOOD_BURST_S = 0.025
@@ -165,7 +177,7 @@ class AdversaryPlane:
         # monotonic anchor: window arithmetic survives NTP steps
         # (same scheme as FaultPlane — see faults/plane.py)
         self._mono_epoch = mono0 - (wall0 - self.epoch)
-        self.rng = random.Random(f"{self.seed}|adversary|{self.self_id}")
+        self.rng = CountingRandom(f"{self.seed}|adversary|{self.self_id}")
         self.counts = {
             "byz_equivocations": 0,
             "byz_forged_qcs": 0,
@@ -175,7 +187,39 @@ class AdversaryPlane:
             "byz_shadow_commits": 0,
             "byz_forged_reconfigs": 0,
             "byz_shadow_epochs": 0,
+            "byz_flood_accepted": 0,
+            "byz_flood_shed": 0,
+            "byz_adapt_ambush": 0,
+            "byz_adapt_sync": 0,
+            "byz_adapt_surf": 0,
+            "byz_adapt_snipe": 0,
         }
+        #: adaptive plane (faults/adaptive.py): the read-only protocol-
+        #: state view, installed by Consensus.spawn via bind_view();
+        #: None until then (wants() degrades to active())
+        self.view: StateView | None = None
+        #: peers mid-state-sync against this node (sync-predator prey),
+        #: fed by the StateSyncServer's note_syncing hook
+        self._syncing: set = set()
+        #: credit window last advertised by the flood target's ingest
+        #: ACK (None until the first ACK); caps the next flood batch
+        self.flood_credit: int | None = None
+        # Restart continuity (ISSUE 18 satellite): when the harness
+        # points HOTSTUFF_ADAPT_RNG_DIR at the run workdir, the draw
+        # stream is checkpointed after every recorded decision and a
+        # crash-restarted adversary resumes it instead of replaying
+        # from the top.
+        self._rng_path = None
+        rng_dir = os.environ.get("HOTSTUFF_ADAPT_RNG_DIR")
+        if rng_dir and self.self_id is not None:
+            os.makedirs(rng_dir, exist_ok=True)
+            self._rng_path = rng_state_path(rng_dir, self.self_id)
+            restored = load_rng_state(self._rng_path, self.rng)
+            if restored is not None:
+                log.info(
+                    "adversary rng restored: resuming the decision "
+                    "stream at draw %d", restored,
+                )
         #: colluding node indexes, sorted (collude rules only)
         self.colluders = sorted(
             frozenset().union(
@@ -230,6 +274,78 @@ class AdversaryPlane:
             ):
                 return True
         return False
+
+    # ------------------------------------------------------------------
+    # adaptive plane (faults/adaptive.py)
+
+    def bind_view(self, providers: dict) -> None:
+        """Install the read-only protocol-state view the adaptive
+        triggers observe.  Called by Consensus.spawn once the core is
+        built; provider callbacks are pure reads of local state
+        (round, leader schedule, timer, admission credit, ...).  Takes
+        a dict (not kwargs) because ``self`` is a provider key."""
+        base = {
+            "syncing": lambda s=self._syncing: frozenset(s),
+            "incidents": lambda: 0,
+        }
+        base.update(providers)
+        self.view = StateView(base)
+
+    def note_syncing(self, peer) -> None:
+        """Protocol hook (StateSyncServer): ``peer`` requested a
+        manifest, i.e. began a snapshot bootstrap against this node.
+        Entries persist for the process lifetime — sync-predator stalks
+        the peer for as long as its policy window stays open; once the
+        window closes the chunks flow and the bootstrap completes."""
+        self._syncing.add(peer)
+
+    def wants(self, action: str, round_: int | None = None,
+              now: float | None = None):
+        """Does any live policy want ``action`` in ``round_``?
+
+        Returns ``True`` when a schedule-driven policy window covers
+        the action (exactly ``active()``), the adaptive short token —
+        a truthy str the seams pass to :meth:`mark_adaptive` — when a
+        state-reactive trigger fires, and ``False`` otherwise.
+        Trigger evaluation is a pure read of the state view: ZERO rng
+        draws, so the fixed-draw determinism contract is untouched.
+        """
+        if self.active(action, now):
+            return True
+        if self.view is None or not self.my_rules:
+            return False
+        t = self._t(now)
+        r = self.view.round if round_ is None else int(round_)
+        for rule in self.my_rules:
+            trig = ADAPTIVE_TRIGGERS.get(rule.policy)
+            if trig is None or not rule.active(t):
+                continue
+            actions, fire = trig
+            if action in actions and fire(self.view, r):
+                return ADAPTIVE_SHORT[rule.policy]
+        return False
+
+    def mark_adaptive(self, fired, round_: int = 0, logger=None,
+                      digest=None) -> None:
+        """Attribute an adaptive trigger firing: ``fired`` is the token
+        :meth:`wants` returned.  Bumps the per-policy counter, journals
+        the ``byz.adapt.<token>`` edge and emits the attack log line
+        the ``+ BYZ`` activity regex counts.  A non-str ``fired`` (a
+        plain schedule-driven True) is a no-op."""
+        if not isinstance(fired, str):
+            return
+        self.count(f"byz_adapt_{fired}")
+        self.record(f"adapt.{fired}", round_, digest)
+        (logger or log).info("byz adapt-%s round %d", fired, round_)
+
+    def surf_delay_s(self, timeout_s: float) -> float:
+        """timeout-surfer vote delay: a fixed fraction of the OBSERVED
+        view timer (backoff included), strictly inside the timeout."""
+        return surf_fraction() * float(timeout_s)
+
+    def _save_rng(self) -> None:
+        if self._rng_path is not None:
+            save_rng_state(self._rng_path, self.rng)
 
     def bind(self, committee, self_name) -> None:
         """Resolve node indexes to authority names against the live
@@ -376,6 +492,9 @@ class AdversaryPlane:
 
     def count(self, key: str, n: int = 1) -> None:
         self.counts[key] = self.counts.get(key, 0) + n
+        # decision boundary: checkpoint the draw stream so a restarted
+        # adversary resumes rather than replays it (faults/adaptive.py)
+        self._save_rng()
 
     def record(self, event: str, round_: int = 0, digest=None,
                peer: str = "") -> None:
@@ -383,6 +502,7 @@ class AdversaryPlane:
         ``benchmark traces``)."""
         if self.journal is not None:
             self.journal.record(f"byz.{event}", round_, digest, peer)
+        self._save_rng()
 
     def describe(self) -> str:
         mine = ",".join(sorted({r.policy for r in self.my_rules})) or "none"
@@ -434,10 +554,18 @@ async def run_flood(plane: AdversaryPlane, committee, name,
     well-formed at the wire layer, every signature invalid, so honest
     nodes burn real verification work rejecting them.  The reusable
     form of tests/test_byzantine_e2e.py's ad-hoc burst loop."""
+    from ..consensus.errors import SerializationError
     from ..consensus.messages import QC, Timeout, Vote
-    from ..consensus.wire import encode_timeout, encode_vote
+    from ..consensus.wire import (
+        decode_ingest_ack,
+        encode_producer_batch,
+        encode_timeout,
+        encode_vote,
+    )
     from ..crypto import Digest, Signature
     from ..network import SimpleSender
+    from ..network.framing import read_frame, send_frame
+    from ..utils.clock import default_connector
 
     sender = SimpleSender()
     peers = [
@@ -445,6 +573,66 @@ async def run_flood(plane: AdversaryPlane, committee, name,
     ]
     honest = [nm for nm, _ in peers]
     rng = plane.rng
+    # Credit-capped ingest flood (ISSUE 18 satellite): alongside the
+    # garbage-signature bursts, hammer ONE deterministic victim's
+    # producer port with content-addressed garbage payloads — but never
+    # more per batch than the victim's last advertised admission credit
+    # window.  The attack exercises the shed path (typed BUSY ACKs)
+    # instead of growing the proposer buffer without bound, and the ACK
+    # stream gives the + BYZ block its accepted-vs-shed accounting.
+    target = min(peers, key=lambda p: str(p[0])) if peers else None
+    ingest_conn = None
+
+    async def ingest_flood(rnd: int) -> None:
+        nonlocal ingest_conn
+        if target is None:
+            return
+        cap = flood_batch_cap()
+        credit = plane.flood_credit
+        n = cap if credit is None else max(1, min(cap, credit))
+        items = []
+        for k in range(n):
+            # pure function of (seed, round, k): zero rng draws, and the
+            # body hashes to its digest so content addressing admits it
+            # and the payload really consumes admission credit
+            body = f"byz-flood|{plane.seed}|{rnd}|{k}".encode()
+            items.append((Digest.of(body), body))
+        frame = encode_producer_batch(items)
+        try:
+            if ingest_conn is None:
+                ingest_conn = await default_connector()(*target[1])
+            reader, writer = ingest_conn
+            await send_frame(writer, frame)
+            ack = decode_ingest_ack(
+                await asyncio.wait_for(read_frame(reader), 1.0)
+            )
+        except (
+            ConnectionError,
+            OSError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            SerializationError,
+        ):
+            conn, ingest_conn = ingest_conn, None
+            if conn is not None:
+                try:
+                    conn[1].close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+            return
+        if ack is None:
+            return  # legacy v1 Ack: no admission decision to read
+        plane.flood_credit = ack.credit
+        plane.count("byz_flood_accepted", ack.accepted)
+        plane.count("byz_flood_shed", ack.shed)
+        plane.record(
+            "flood-admission", rnd, None, f"a{ack.accepted}/s{ack.shed}"
+        )
+        log.info(
+            "byz flood admission: accepted %d shed %d credit %d",
+            ack.accepted, ack.shed, ack.credit,
+        )
+
     try:
         while True:
             await default_clock().sleep(FLOOD_BURST_S)
@@ -482,6 +670,7 @@ async def run_flood(plane: AdversaryPlane, committee, name,
             for _, addr in peers:
                 for frame in frames:
                     await sender.send(addr, frame)
+            await ingest_flood(rnd)
             plane.count("byz_floods")
             plane.record("flood", rnd, None, f"{len(frames)}x{len(peers)}")
             log.info(
@@ -491,6 +680,11 @@ async def run_flood(plane: AdversaryPlane, committee, name,
     except asyncio.CancelledError:
         raise
     finally:
+        if ingest_conn is not None:
+            try:
+                ingest_conn[1].close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
         close = getattr(sender, "close", None)
         if close is not None:
             try:

@@ -297,10 +297,14 @@ def last_heal(spec: dict) -> float:
             return float("inf")
         t = max(t, float(restart))
     for rule in spec.get("adversary", ()):
-        # only vote withholding impairs liveness; equivocation, forged
-        # QCs, double votes, and floods are rejected/absorbed while the
-        # committee keeps committing
-        if rule.get("policy") != "withhold":
+        # vote withholding — plus the adaptive policies that delay votes
+        # (timeout-surfer), starve a bootstrap (sync-predator), or
+        # withhold near epoch boundaries (reconfig-sniper) — impairs
+        # liveness; equivocation, forged QCs, double votes, and floods
+        # are rejected/absorbed while the committee keeps committing
+        if rule.get("policy") not in (
+            "withhold", "timeout-surfer", "sync-predator", "reconfig-sniper",
+        ):
             continue
         until = rule.get("until")
         if until is None:

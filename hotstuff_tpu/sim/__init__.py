@@ -8,16 +8,29 @@ transport.  Every run is a pure function of its schedule seed; failures
 replay from the seed alone and shrink to a minimal failing schedule.
 """
 
-from .explorer import ExploreResult, explore, shrink
+from .explorer import (
+    ExploreResult,
+    GuidedResult,
+    explore,
+    explore_guided,
+    fitness,
+    shrink,
+)
 from .harness import SimCluster
 from .loop import SIM_EPOCH, SimDeadlock, SimLoop, VirtualClock
 from .runner import SimVerdict, run_schedule
-from .schedule import draw_schedule, schedule_to_spec
+from .schedule import (
+    draw_schedule,
+    mutate_schedule,
+    profile_of_events,
+    schedule_to_spec,
+)
 from .transport import SimNet, SimReceiver
 
 __all__ = [
     "SIM_EPOCH",
     "ExploreResult",
+    "GuidedResult",
     "SimCluster",
     "SimDeadlock",
     "SimLoop",
@@ -27,6 +40,10 @@ __all__ = [
     "VirtualClock",
     "draw_schedule",
     "explore",
+    "explore_guided",
+    "fitness",
+    "mutate_schedule",
+    "profile_of_events",
     "run_schedule",
     "schedule_to_spec",
     "shrink",

@@ -25,7 +25,7 @@ import json
 import os
 
 from .runner import SimVerdict, run_schedule
-from .schedule import draw_schedule
+from .schedule import draw_schedule, mutate_schedule, schedule_to_spec
 
 
 @dataclasses.dataclass
@@ -48,6 +48,10 @@ class ExploreResult:
     #: (per-seed attribution from the sim journals; seeds whose runs
     #: committed nothing don't contribute)
     regimes: dict = dataclasses.field(default_factory=dict)
+    #: schedules whose run raised an invariant threat (full-history
+    #: divergence or a liveness stall) — the guided-vs-flat comparison
+    #: metric (scripts/adapt_check.py)
+    threats: int = 0
 
     @property
     def ok(self) -> bool:
@@ -117,7 +121,7 @@ def explore(
     say = progress or (lambda _msg: None)
     findings: list[Finding] = []
     regimes: dict = {}
-    passed = honest = byz = 0
+    passed = honest = byz = threats = 0
     for k in range(seeds):
         seed = start_seed + k
         schedule = draw_schedule(seed, nodes=nodes, duration_s=duration_s)
@@ -126,6 +130,8 @@ def explore(
         else:
             honest += 1
         verdict = run_schedule(schedule)
+        if verdict.threats:
+            threats += 1
         if verdict.attribution is not None:
             regime = verdict.attribution.get("regime", "unknown")
             regimes[regime] = regimes.get(regime, 0) + 1
@@ -173,7 +179,323 @@ def explore(
         honest=honest,
         byz=byz,
         regimes=regimes,
+        threats=threats,
     )
 
 
-__all__ = ["ExploreResult", "Finding", "explore", "shrink", "write_repro_bundle"]
+# ---------------------------------------------------------------------------
+# guided search (ISSUE 18): fitness-driven mutation instead of a flat sweep
+
+
+def fitness(verdict: SimVerdict, baseline_regime: str | None = None) -> int:
+    """Score one run for the guided search.  Ordered by how close the
+    schedule got to breaking an invariant: an uncontained attack
+    (trusted-subset FAIL) dominates everything, then full-history
+    divergence, then a liveness stall, then a critpath regime shift,
+    then raw timeout pressure as the gradient signal that lets the
+    search climb toward stalls it hasn't reached yet."""
+    score = 0
+    if verdict.trusted_ok is False:
+        score += 5000
+    if not verdict.safety_ok:
+        score += 1000
+    if "liveness-stall" in verdict.threats:
+        score += 200
+    if baseline_regime is not None and verdict.attribution is not None:
+        regime = verdict.attribution.get("regime")
+        if regime and regime != baseline_regime:
+            score += 25
+    score += 2 * verdict.timeouts
+    return score
+
+
+@dataclasses.dataclass
+class GuidedResult:
+    """Outcome of one guided search (``explore_guided``)."""
+
+    budget: int  #: schedules evaluated by the SEARCH (== flat's seeds)
+    generations: int
+    passed: int
+    threats: int  #: schedules whose run raised an invariant threat
+    best_fitness: int
+    findings: list[Finding]
+    #: corpus entries appended to tests/data/sim_seeds.json (inline
+    #: schedule + expected verdict + journal digest)
+    promoted: list[dict] = dataclasses.field(default_factory=list)
+    #: canned scenario spec files emitted for the real-cluster
+    #: chaos/byz matrix (``python -m benchmark chaos --spec <file>``)
+    scenarios: list[str] = dataclasses.field(default_factory=list)
+    regimes: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _schedule_key(schedule: dict) -> str:
+    return json.dumps(
+        {k: schedule[k] for k in ("nodes", "duration_s", "events")},
+        sort_keys=True,
+    )
+
+
+def promote_to_corpus(entries: list[dict], corpus_path: str) -> int:
+    """Append promoted schedules to the regression corpus, deduplicating
+    on journal digest (the run identity).  Returns how many were new."""
+    with open(corpus_path) as f:
+        corpus = json.load(f)
+    seen = {
+        e.get("journal_digest")
+        for e in corpus["entries"]
+        if e.get("journal_digest")
+    }
+    added = 0
+    for entry in entries:
+        if entry.get("journal_digest") in seen:
+            continue
+        corpus["entries"].append(entry)
+        seen.add(entry.get("journal_digest"))
+        added += 1
+    if added:
+        with open(corpus_path, "w") as f:
+            json.dump(corpus, f, indent=2)
+            f.write("\n")
+    return added
+
+
+def emit_scenario(schedule: dict, verdict: SimVerdict, out_path: str) -> str:
+    """Write a promoted schedule as a canned chaos/byz scenario spec —
+    the exact dialect ``python -m benchmark chaos --spec`` consumes (the
+    chaos bench re-stamps ``nodes``/``epoch_unix`` at boot, so the sim
+    values are placeholders)."""
+    from .harness import SIM_BASE_PORT
+
+    spec = schedule_to_spec(schedule, SIM_BASE_PORT)
+    spec["name"] = f"adapt-{schedule['seed']}"
+    spec["_promoted"] = {
+        "profile": schedule.get("profile", "honest"),
+        "threats": list(verdict.threats),
+        "sim_ok": verdict.ok,
+        "journal_digest": verdict.journal_digest,
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(spec, f, indent=2)
+        f.write("\n")
+    return out_path
+
+
+def explore_guided(
+    budget: int,
+    nodes: int = 4,
+    start_seed: int = 0,
+    duration_s: float | None = None,
+    out_dir: str | None = None,
+    do_shrink: bool = True,
+    corpus_path: str | None = None,
+    scenarios_dir: str | None = None,
+    max_promote: int = 4,
+    progress=None,
+) -> GuidedResult:
+    """Fitness-guided schedule search at the SAME run budget as a flat
+    ``explore(seeds=budget)`` sweep.
+
+    Generation 0 draws ~budget/3 schedules (two thirds forced to the
+    adaptive profile, the rest seed-decided like the flat sweep); every
+    later generation mutates the fittest survivors
+    (:func:`~hotstuff_tpu.sim.schedule.mutate_schedule`) until the
+    budget is spent.  Failing schedules become findings (shrunk, repro
+    bundle); the fittest invariant-threatening schedules are shrunk
+    with a threat-preserving predicate and **promoted**: appended to
+    the regression corpus with their inline schedule + journal digest,
+    and emitted as canned chaos scenario specs.
+    """
+    say = progress or (lambda _msg: None)
+    findings: list[Finding] = []
+    regimes: dict = {}
+    evaluated: list[tuple[int, dict, SimVerdict]] = []
+    seen: set[str] = set()
+    passed = threats = spent = 0
+    baseline_regime: str | None = None
+    gen = 0
+    gen_size = max(2, min(budget, budget // 3 or budget))
+
+    def evaluate(schedule: dict) -> SimVerdict:
+        nonlocal passed, threats, spent
+        verdict = run_schedule(schedule)
+        spent += 1
+        seen.add(_schedule_key(schedule))
+        if verdict.ok:
+            passed += 1
+        if verdict.threats:
+            threats += 1
+            say(
+                f"  THREAT seed {schedule['seed']} "
+                f"({schedule['profile']}): {','.join(verdict.threats)} "
+                f"fitness {fitness(verdict, baseline_regime)}"
+            )
+        if verdict.attribution is not None:
+            regime = verdict.attribution.get("regime", "unknown")
+            regimes[regime] = regimes.get(regime, 0) + 1
+        evaluated.append(
+            (fitness(verdict, baseline_regime), schedule, verdict)
+        )
+        return verdict
+
+    # generation 0: a seeded nursery biased toward adaptive adversaries
+    for k in range(min(gen_size, budget)):
+        profile = "adaptive" if k % 3 != 2 else None
+        schedule = draw_schedule(
+            start_seed + k, nodes=nodes, duration_s=duration_s,
+            profile=profile,
+        )
+        evaluate(schedule)
+    # modal critpath regime of the nursery = the "normal" regime;
+    # mutants that shift it score fitness
+    if regimes:
+        baseline_regime = max(regimes.items(), key=lambda kv: kv[1])[0]
+
+    # later generations: mutate the fittest survivors
+    salt = 0
+    while spent < budget:
+        gen += 1
+        size = min(gen_size, budget - spent)
+        parents = sorted(evaluated, key=lambda e: -e[0])[: max(2, size // 3)]
+        say(
+            f"  gen {gen}: {size} mutants from {len(parents)} parents "
+            f"(best fitness {parents[0][0]})"
+        )
+        for i in range(size):
+            parent = parents[i % len(parents)][1]
+            child = None
+            for _ in range(16):  # skip children identical to a past run
+                salt += 1
+                candidate = mutate_schedule(parent, salt)
+                if _schedule_key(candidate) not in seen:
+                    child = candidate
+                    break
+            evaluate(child if child is not None else candidate)
+
+    # findings: schedules that FAILED their profile expectation
+    for _fit, schedule, verdict in sorted(evaluated, key=lambda e: -e[0]):
+        if verdict.ok:
+            continue
+        say(
+            f"  FAIL seed {schedule['seed']} ({schedule['profile']}): "
+            + "; ".join(verdict.failures)
+        )
+        repro = None
+        if out_dir is not None:
+            repro = write_repro_bundle(
+                schedule, verdict,
+                os.path.join(out_dir, f"repro-{schedule['seed']}"),
+            )
+            say(f"  repro bundle: {repro}")
+        minimal = schedule
+        if do_shrink and schedule["events"]:
+            minimal = shrink(schedule, progress=say)
+            if repro is not None:
+                with open(os.path.join(repro, "minimal.json"), "w") as f:
+                    json.dump(minimal, f, indent=2)
+        findings.append(
+            Finding(
+                seed=schedule["seed"],
+                profile=schedule["profile"],
+                failures=list(verdict.failures),
+                repro_dir=repro,
+                minimal_events=list(minimal["events"]),
+            )
+        )
+
+    # promotion: the fittest threatening schedules (failures first —
+    # sort order above — then contained attacks), shrunk with a
+    # threat-preserving predicate, re-run for their final expectations
+    promoted: list[dict] = []
+    scenarios: list[str] = []
+    # class diversity: a fitness sort alone would fill every slot with
+    # copies of the single highest-scoring attack family; cap each
+    # (profile, threat-set) class so a lower-scoring but DIFFERENT
+    # counterexample (e.g. an adaptive liveness stall next to collude
+    # divergences) still earns a corpus slot
+    per_class = max(1, max_promote // 2)
+    classes: dict[tuple, int] = {}
+    for _fit, schedule, verdict in sorted(evaluated, key=lambda e: -e[0]):
+        if len(promoted) >= max_promote:
+            break
+        if not verdict.threats:
+            continue
+        cls = (schedule.get("profile"), tuple(sorted(verdict.threats)))
+        if classes.get(cls, 0) >= per_class:
+            continue
+        classes[cls] = classes.get(cls, 0) + 1
+        minimal = schedule
+        if do_shrink and schedule["events"]:
+            want = set(verdict.threats)
+            minimal = shrink(
+                schedule,
+                is_failing=lambda s, w=want: (
+                    set(run_schedule(s).threats) >= w
+                ),
+                progress=say,
+            )
+        final = run_schedule(minimal)
+        entry = {
+            "seed": int(minimal["seed"]),
+            "profile": minimal.get("profile", "honest"),
+            "ok": bool(final.ok),
+            "note": (
+                "guided search (ISSUE 18): "
+                + ",".join(
+                    ev.get("policy", ev["kind"])
+                    for ev in minimal["events"]
+                    if ev["kind"] in ("byz", "crash", "reconfig")
+                )
+                + " -> " + ",".join(final.threats)
+            ),
+            "threats": list(final.threats),
+            "journal_digest": final.journal_digest,
+            "schedule": minimal,
+        }
+        promoted.append(entry)
+        say(
+            f"  PROMOTE seed {minimal['seed']} "
+            f"({entry['profile']}, ok={entry['ok']}): {entry['note']}"
+        )
+        if scenarios_dir is not None:
+            scenarios.append(
+                emit_scenario(
+                    minimal, final,
+                    os.path.join(
+                        scenarios_dir, f"adapt-{minimal['seed']}.json"
+                    ),
+                )
+            )
+    if corpus_path is not None and promoted:
+        added = promote_to_corpus(promoted, corpus_path)
+        say(f"  corpus: {added} new entries -> {corpus_path}")
+
+    return GuidedResult(
+        budget=spent,
+        generations=gen,
+        passed=passed,
+        threats=threats,
+        best_fitness=max((f for f, _s, _v in evaluated), default=0),
+        findings=findings,
+        promoted=promoted,
+        scenarios=scenarios,
+        regimes=regimes,
+    )
+
+
+__all__ = [
+    "ExploreResult",
+    "Finding",
+    "GuidedResult",
+    "emit_scenario",
+    "explore",
+    "explore_guided",
+    "fitness",
+    "promote_to_corpus",
+    "shrink",
+    "write_repro_bundle",
+]

@@ -62,6 +62,14 @@ class SimVerdict:
     journal_digest: str
     block: str  #: rendered CHAOS/BYZ/RECONFIG report
     failures: list[str] = dataclasses.field(default_factory=list)
+    #: invariant-threat classification (guided search fitness input):
+    #: "full-history-divergence" when safety failed, "liveness-stall"
+    #: when the run missed liveness/commit expectations with safety
+    #: intact.  Empty for clean runs.
+    threats: list[str] = dataclasses.field(default_factory=list)
+    #: view-timeout firings observed across the committee (fitness
+    #: pressure signal — more timeouts = closer to a stall)
+    timeouts: int = 0
     #: commit critical-path attribution document (telemetry/critpath.py
     #: ``attribution()`` shape) merged from the committee's per-node
     #: flight-recorder journals; None when the run committed nothing.
@@ -148,7 +156,8 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
     # -- pin the ambient world ----------------------------------------
     saved_env = {
         k: os.environ.get(k)
-        for k in list(_RUN_ENV_BASE) + ["HOTSTUFF_FAULTS", "HOTSTUFF_ADVERSARY"]
+        for k in list(_RUN_ENV_BASE)
+        + ["HOTSTUFF_FAULTS", "HOTSTUFF_ADVERSARY", "HOTSTUFF_ADAPT_RNG_DIR"]
     }
     for k, v in _RUN_ENV_BASE.items():
         if v is None:
@@ -158,8 +167,17 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
     os.environ["HOTSTUFF_FAULTS"] = json.dumps(spec)
     if spec.get("adversary"):
         os.environ["HOTSTUFF_ADVERSARY"] = json.dumps(spec)
+        # adversary rng continuity across crash/restart (faults/
+        # adaptive.py): checkpoint the per-node draw stream under the
+        # run workdir so a restarted adversary resumes it — same seed
+        # must keep yielding a byte-identical journal with adaptive
+        # policies active
+        os.environ["HOTSTUFF_ADAPT_RNG_DIR"] = os.path.join(
+            workdir, "adv-rng"
+        )
     else:
         os.environ.pop("HOTSTUFF_ADVERSARY", None)
+        os.environ.pop("HOTSTUFF_ADAPT_RNG_DIR", None)
 
     loop = SimLoop()
     clock = VirtualClock(loop)
@@ -254,10 +272,25 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
     safety_ok, safety_viol = check_safety(commits)
     adversaries = adversaries_from_spec(spec)
     trusted_ok: bool | None = None
+    trusted_viol: list = []
     if adversaries:
         trusted_ok, trusted_viol = trusted_subset_recheck(
             commits, set(adversaries)
         )
+
+    # invariant-threat classification + timeout tally: the guided
+    # explorer's fitness inputs (sim/explorer.py).  Independent of the
+    # per-profile ok judgment below — a threat on an "adaptive" run can
+    # be a correctly-contained attack and still score fitness.
+    threats: list[str] = []
+    if not safety_ok:
+        threats.append("full-history-divergence")
+    elif not all_ok:
+        threats.append("liveness-stall")
+    timeouts = sum(
+        1 for _vt, _name, msg in capture.records
+        if msg.startswith("Timeout reached for round")
+    )
 
     profile = schedule.get("profile", "honest")
     if failures:
@@ -269,6 +302,16 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
         if safety_ok:
             failures.append("byz-collude schedule left no divergence")
         if not trusted_ok:
+            failures.extend(
+                f"trusted-subset: {v}" for v in (trusted_viol or ())
+            )
+    elif profile == "adaptive":
+        # adaptive attacks range from fully absorbed (all invariants
+        # green) to full-history divergence; the containment bar is the
+        # trusted-subset regime — the f+1 honest view must stay
+        # self-consistent no matter what the adversary pulled off
+        ok = trusted_ok is not False
+        if trusted_ok is False:
             failures.extend(
                 f"trusted-subset: {v}" for v in (trusted_viol or ())
             )
@@ -292,6 +335,8 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
         journal_digest=journal_digest,
         block=block,
         failures=failures,
+        threats=threats,
+        timeouts=timeouts,
         attribution=attribution,
     )
 

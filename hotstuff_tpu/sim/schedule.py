@@ -14,9 +14,11 @@ checkers (benchmark/invariants.py ``check_run``) unchanged.
 
 from __future__ import annotations
 
+import copy
 import os
 import random
 
+from ..faults.adaptive import ADAPTIVE_POLICIES
 from .loop import SIM_EPOCH
 
 #: schedule format version (bump on incompatible changes so committed
@@ -85,6 +87,91 @@ def draw_schedule(
                     "jitter_pct": 20,
                     "at": at,
                     "until": until,
+                }
+            )
+    elif profile == "adaptive":
+        # one state-reactive adversary (faults/adaptive.py) plus the
+        # protocol event its trigger preys on.  Windows are BOUNDED —
+        # an unbounded liveness-impairing policy would push last_heal
+        # to +inf and hide a genuine stall from the liveness check.
+        policy = rng.choice(ADAPTIVE_POLICIES)
+        attacker = rng.randrange(nodes)
+        until = round(rng.uniform(4.5, EVENT_MAX_END), 2)
+        events.append(
+            {
+                "kind": "byz",
+                "policy": policy,
+                "nodes": [attacker],
+                "at": 1.0,
+                "until": until,
+            }
+        )
+        if policy == "sync-predator":
+            # prey: a crash-recovered peer state-syncing mid-window
+            victim = (attacker + 1 + rng.randrange(nodes - 1)) % nodes
+            crash_at = round(rng.uniform(EVENT_MIN_AT, 3.0), 2)
+            events.append(
+                {
+                    "kind": "crash",
+                    "node": victim,
+                    "at": crash_at,
+                    "restart_at": round(
+                        crash_at + rng.uniform(1.0, 1.8), 2
+                    ),
+                    "torn_bytes": rng.randint(1, 48),
+                }
+            )
+        elif policy == "reconfig-sniper":
+            # prey: an epoch activation inside the snipe margin
+            events.append(
+                {
+                    "kind": "reconfig",
+                    "at": round(rng.uniform(EVENT_MIN_AT, 3.5), 2),
+                    "sponsor": rng.randrange(nodes),
+                    "margin": rng.randint(2, 6),
+                }
+            )
+            duration += 3.0
+        elif policy == "ambush-leader":
+            # prey: fresh TCs — isolate a peer so view changes seat the
+            # ambusher behind one
+            at, until2 = window()
+            events.append(
+                {
+                    "kind": "isolate",
+                    "node": (attacker + 1) % nodes,
+                    "at": at,
+                    "until": until2,
+                }
+            )
+        elif policy == "timeout-surfer" and rng.random() < 0.5:
+            # surfing alone stretches views; combined with a crashed
+            # peer the committee drops to bare quorum and every
+            # stretched view risks tipping into a stall
+            crash_at = round(rng.uniform(EVENT_MIN_AT, 3.0), 2)
+            events.append(
+                {
+                    "kind": "crash",
+                    "node": (attacker + 1) % nodes,
+                    "at": crash_at,
+                    "restart_at": round(
+                        crash_at + rng.uniform(1.2, 2.0), 2
+                    ),
+                    "torn_bytes": rng.randint(1, 48),
+                }
+            )
+        for _ in range(rng.randint(0, 1)):
+            at, until2 = window()
+            src, dst = rng.sample(range(nodes), 2)
+            events.append(
+                {
+                    "kind": "delay",
+                    "from": [src],
+                    "to": [dst],
+                    "delay_ms": rng.randint(5, 40),
+                    "jitter_pct": 20,
+                    "at": at,
+                    "until": until2,
                 }
             )
     else:
@@ -263,10 +350,139 @@ def schedule_to_spec(schedule: dict, base_port: int) -> dict:
     return spec
 
 
+def profile_of_events(events) -> str:
+    """Recompute a schedule's profile from its event list (mutation can
+    cross profile boundaries): collude anywhere ⇒ the byz-collude
+    judgment, any other adversary ⇒ adaptive, else honest."""
+    policies = [
+        ev.get("policy") for ev in events if ev.get("kind") == "byz"
+    ]
+    if "collude" in policies:
+        return "byz-collude"
+    if policies:
+        return "adaptive"
+    return "honest"
+
+
+def mutate_schedule(schedule: dict, salt: int) -> dict:
+    """One guided-search mutation step: a pure function of
+    ``(schedule, salt)``.  The child gets a derived seed (fresh
+    adversary/ambient rng streams) and a recomputed profile, and every
+    mutated window stays inside the healing envelope so the liveness
+    check keeps applying."""
+    rng = random.Random(f"sim-mutate|{schedule['seed']}|{salt}")
+    child = copy.deepcopy(schedule)
+    events: list[dict] = child["events"]
+    nodes = int(child["nodes"])
+
+    def window() -> tuple[float, float]:
+        at = round(rng.uniform(EVENT_MIN_AT, EVENT_MAX_END - 1.0), 2)
+        until = round(
+            min(at + rng.uniform(0.8, 2.5), EVENT_MAX_END), 2
+        )
+        return at, until
+
+    ops = [
+        "add-adaptive-byz",
+        "add-crash",
+        "add-link-noise",
+        "perturb-timing",
+        "drop-event",
+    ]
+    op = rng.choice(ops)
+    if op == "add-adaptive-byz":
+        policy = rng.choice(ADAPTIVE_POLICIES)
+        until = round(rng.uniform(4.5, EVENT_MAX_END), 2)
+        events.append(
+            {
+                "kind": "byz",
+                "policy": policy,
+                "nodes": [rng.randrange(nodes)],
+                "at": 1.0,
+                "until": until,
+            }
+        )
+        if policy == "reconfig-sniper" and not any(
+            ev["kind"] == "reconfig" for ev in events
+        ):
+            events.append(
+                {
+                    "kind": "reconfig",
+                    "at": round(rng.uniform(EVENT_MIN_AT, 3.5), 2),
+                    "sponsor": rng.randrange(nodes),
+                    "margin": rng.randint(2, 6),
+                }
+            )
+            child["duration_s"] = float(child["duration_s"]) + 3.0
+    elif op == "add-crash":
+        crash_at = round(rng.uniform(EVENT_MIN_AT, 3.0), 2)
+        events.append(
+            {
+                "kind": "crash",
+                "node": rng.randrange(nodes),
+                "at": crash_at,
+                "restart_at": round(crash_at + rng.uniform(1.0, 2.0), 2),
+                "torn_bytes": rng.randint(1, 48),
+            }
+        )
+    elif op == "add-link-noise":
+        at, until = window()
+        src, dst = rng.sample(range(nodes), 2)
+        if rng.random() < 0.5:
+            events.append(
+                {
+                    "kind": "loss",
+                    "from": [src],
+                    "to": [dst],
+                    "drop": round(rng.uniform(0.05, 0.3), 3),
+                    "at": at,
+                    "until": until,
+                }
+            )
+        else:
+            events.append(
+                {
+                    "kind": "delay",
+                    "from": [src],
+                    "to": [dst],
+                    "delay_ms": rng.randint(5, 60),
+                    "jitter_pct": 20,
+                    "at": at,
+                    "until": until,
+                }
+            )
+    elif op == "perturb-timing" and events:
+        ev = rng.choice(events)
+        shift = round(rng.uniform(-0.4, 0.4), 2)
+        if "at" in ev:
+            ev["at"] = round(
+                min(max(0.5, ev["at"] + shift), EVENT_MAX_END - 0.5), 2
+            )
+        if ev.get("until") is not None:
+            ev["until"] = round(
+                min(max(ev["at"] + 0.3, ev["until"] + shift), EVENT_MAX_END),
+                2,
+            )
+        if "restart_at" in ev:
+            ev["restart_at"] = round(
+                max(ev["at"] + 0.5, ev["restart_at"] + shift), 2
+            )
+    elif op == "drop-event" and events:
+        events.pop(rng.randrange(len(events)))
+
+    # derived child seed: fresh ambient/adversary rng streams, and a
+    # distinct corpus identity for promotion (deterministic in salt)
+    child["seed"] = (int(schedule["seed"]) * 1000003 + int(salt)) % (1 << 31)
+    child["profile"] = profile_of_events(events)
+    return child
+
+
 __all__ = [
     "BYZ_FRACTION",
     "DEFAULT_DURATION_S",
     "SCHEDULE_VERSION",
     "draw_schedule",
+    "mutate_schedule",
+    "profile_of_events",
     "schedule_to_spec",
 ]

@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""Adaptive-adversary plane check (docs/FAULTS.md, ISSUE 18).
+
+Runs the guided schedule search against a flat sweep at the SAME run
+budget and asserts the contracts the adaptive plane exists to prove:
+
+- honest seeds stay green: a flat sweep over the budget's seed range
+  produces zero honest-profile findings with the adaptive plane wired
+  in;
+- guided search pays for itself: at equal budget it surfaces strictly
+  more invariant-threatening schedules (full-history FAIL or liveness
+  stall) than the flat sweep;
+- containment: every full-history FAIL the search discovers is
+  absolved by the trusted-subset regime (PASS) — an uncontained attack
+  is a real bug and fails this check;
+- promotion replays: every promoted corpus schedule (inline schedules
+  in tests/data/sim_seeds.json) re-runs to the SAME verdict and a
+  byte-identical journal digest.
+
+Exit non-zero when any contract breaks.
+
+Usage:
+    python scripts/adapt_check.py [--budget N] [--nodes N] [--start N]
+    ADAPT=1 scripts/trace.sh             # same, via the trace wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CORPUS = os.path.join(REPO, "tests", "data", "sim_seeds.json")
+
+
+def check(label: str, ok: bool, detail: str = "") -> bool:
+    print(
+        f"  [{'ok' if ok else 'FAIL'}] {label}"
+        + (f" — {detail}" if detail and not ok else "")
+    )
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--budget", type=int, default=18,
+                    help="schedules per search mode (flat AND guided)")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(REPO, "logs", "adapt-check"),
+        help="failure repro-bundle directory",
+    )
+    args = ap.parse_args(argv)
+
+    from hotstuff_tpu.sim import explore, explore_guided, run_schedule
+
+    say = lambda msg: print(msg, flush=True)  # noqa: E731
+
+    print(
+        f"=== flat sweep: {args.budget} seeds, {args.nodes} nodes "
+        f"(start {args.start}) ==="
+    )
+    t0 = time.monotonic()
+    flat = explore(
+        seeds=args.budget,
+        nodes=args.nodes,
+        start_seed=args.start,
+        out_dir=os.path.join(args.out, "flat"),
+        progress=say,
+    )
+    dt_flat = time.monotonic() - t0
+    print(
+        f"  flat: {flat.passed}/{flat.seeds} passed, "
+        f"{flat.threats} invariant-threatening, "
+        f"{len(flat.findings)} findings ({dt_flat:.1f}s)"
+    )
+
+    print(f"=== guided search: same budget ({args.budget}) ===")
+    t0 = time.monotonic()
+    guided = explore_guided(
+        budget=args.budget,
+        nodes=args.nodes,
+        start_seed=args.start,
+        out_dir=os.path.join(args.out, "guided"),
+        progress=say,
+    )
+    dt_guided = time.monotonic() - t0
+    print(
+        f"  guided: {guided.passed}/{guided.budget} passed, "
+        f"{guided.threats} invariant-threatening "
+        f"(best fitness {guided.best_fitness}), "
+        f"{guided.generations} generations, "
+        f"{len(guided.findings)} findings ({dt_guided:.1f}s)"
+    )
+
+    failed = False
+    honest_failures = [
+        f for f in flat.findings if f.profile == "honest"
+    ]
+    failed |= not check(
+        "honest seeds stay green under the adaptive plane",
+        not honest_failures,
+        "; ".join(
+            f"seed {f.seed}: {'; '.join(f.failures[:2])}"
+            for f in honest_failures[:5]
+        ),
+    )
+    failed |= not check(
+        "guided search surfaces strictly more threats at equal budget",
+        guided.threats > flat.threats,
+        f"guided {guided.threats} <= flat {flat.threats}",
+    )
+    failed |= not check(
+        "every discovered failure is a contained attack "
+        "(trusted-subset PASS) or fixed",
+        guided.ok,
+        "; ".join(
+            f"seed {f.seed} ({f.profile}): {'; '.join(f.failures[:2])}"
+            for f in guided.findings[:5]
+        ),
+    )
+
+    print("=== corpus replay: promoted schedules ===")
+    with open(CORPUS) as f:
+        corpus = json.load(f)
+    promoted = [e for e in corpus["entries"] if "schedule" in e]
+    print(f"  {len(promoted)} promoted entries in {CORPUS}")
+    replayed = divergences = 0
+    for entry in promoted:
+        verdict = run_schedule(entry["schedule"])
+        same_verdict = verdict.ok == entry["ok"] and (
+            list(verdict.threats) == list(entry.get("threats", []))
+        )
+        same_digest = verdict.journal_digest == entry["journal_digest"]
+        replayed += same_verdict and same_digest
+        if not (same_verdict and same_digest):
+            print(
+                f"    seed {entry['seed']}: verdict "
+                f"{'ok' if same_verdict else 'DIVERGED'}, digest "
+                f"{'ok' if same_digest else 'DIVERGED'} "
+                f"(threats {verdict.threats} vs {entry.get('threats')})"
+            )
+        # containment on replay: a full-history FAIL must come with a
+        # trusted-subset PASS
+        if not verdict.safety_ok:
+            divergences += 1
+            if verdict.trusted_ok is not True:
+                failed |= not check(
+                    f"promoted seed {entry['seed']} trusted-subset PASS",
+                    False,
+                    f"trusted_ok={verdict.trusted_ok}",
+                )
+    failed |= not check(
+        "every promoted schedule replays deterministically "
+        "(same verdict + byte-identical digest)",
+        promoted and replayed == len(promoted) or not promoted,
+        f"{replayed}/{len(promoted)} replayed clean",
+    )
+    if promoted:
+        print(
+            f"  replay: {replayed}/{len(promoted)} clean, "
+            f"{divergences} full-history FAILs (all trusted-PASS "
+            f"unless flagged above)"
+        )
+
+    print("adapt check:", "FAIL" if failed else "ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,9 @@ non-zero when either guarded metric regresses past the threshold
   * ``sim.rounds_per_s`` / ``sim.seeds_per_min`` — deterministic
     simulator sweep throughput (ISSUE 15; wide per-guard 50% gates,
     skip-if-missing)
+  * ``adapt.schedules_per_min`` / ``adapt.fitness_evals_per_s`` —
+    adaptive-adversary guided-search throughput (ISSUE 18; wide
+    per-guard 50% gates, skip-if-missing)
 
 ``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
 (ISSUE 6): the fresh value must stay within ``--ratchet-slack``
@@ -177,6 +180,24 @@ GUARDS = (
         lambda doc: (doc.get("critpath") or {}).get("coverage_pct"),
         -1,
         0.25,
+    ),
+    # adaptive-adversary guided search (ISSUE 18): candidate schedules
+    # simulated per minute and fitness evaluations per second — the two
+    # throughputs that bound how much schedule space a guided-search
+    # budget actually covers.  Whole-committee Python on a shared
+    # single-core rig, so the per-guard gates are wide; skip-if-missing
+    # covers references from before the adapt block existed.
+    (
+        "adapt.schedules_per_min",
+        lambda doc: (doc.get("adapt") or {}).get("schedules_per_min"),
+        -1,
+        0.5,
+    ),
+    (
+        "adapt.fitness_evals_per_s",
+        lambda doc: (doc.get("adapt") or {}).get("fitness_evals_per_s"),
+        -1,
+        0.5,
     ),
 )
 

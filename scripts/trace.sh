@@ -73,6 +73,14 @@
 #                                 # trusted-subset, and a double-run
 #                                 # determinism probe; non-zero exit on
 #                                 # any break
+#   ADAPT=1 scripts/trace.sh      # ONLY the adaptive-adversary check
+#                                 # (scripts/adapt_check.py): guided
+#                                 # schedule search beats the flat sweep
+#                                 # on invariant-threatening schedules
+#                                 # at equal budget, honest seeds stay
+#                                 # green, and every promoted corpus
+#                                 # schedule replays to the same verdict
+#                                 # with a byte-identical journal digest
 #   CRIT=1 scripts/trace.sh       # ONLY the commit critical-path check
 #                                 # (scripts/critpath_check.py): a
 #                                 # journaled 4-node run must attribute
@@ -136,6 +144,11 @@ fi
 if [ "${SIM:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/sim_check.py "$@"
+fi
+
+if [ "${ADAPT:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/adapt_check.py "$@"
 fi
 
 if [ "${CRIT:-0}" = "1" ]; then

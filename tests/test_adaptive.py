@@ -1,0 +1,269 @@
+"""Adaptive adversary policies (hotstuff_tpu/faults/adaptive.py).
+
+The state-view seam: the view is READ-ONLY and deterministic, every
+trigger is a pure predicate of (view, round) that fires on exactly the
+protocol state it was designed to exploit and stays silent otherwise,
+``wants()`` consumes ZERO rng draws on the trigger path (the fixed-draw
+determinism contract), the rng checkpoint resumes the decision stream
+across a restart, and ``mutate_schedule`` is a pure function of
+(parent, salt) so guided-search generations are replayable.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from hotstuff_tpu.faults.adaptive import (
+    ADAPTIVE_POLICIES,
+    ADAPTIVE_SHORT,
+    ADAPTIVE_TRIGGERS,
+    CountingRandom,
+    StateView,
+    ambush_trigger,
+    load_rng_state,
+    rng_state_path,
+    save_rng_state,
+    snipe_trigger,
+    surf_trigger,
+    sync_trigger,
+)
+from hotstuff_tpu.faults.adversary import POLICIES, AdversaryPlane
+
+
+def _spec(policy, nodes=0, at=0.0, until=None, seed=3, base=9_940, n=4):
+    return {
+        "name": f"byz-{policy}",
+        "seed": seed,
+        "epoch_unix": time.time(),
+        "nodes": {f"127.0.0.1:{base + i}": i for i in range(n)},
+        "adversary": [
+            {"policy": policy, "node": nodes, "at": at, "until": until}
+        ],
+    }
+
+
+def _view(**over):
+    """A hand-built fixture view: an attacker at node 0 in a 4-committee,
+    round 10, no TC history, nobody syncing, static committee."""
+    providers = {
+        "round": lambda: 10,
+        "leader": lambda r: f"auth-{r % 4}",
+        "self": lambda: "auth-0",
+        "last_tc_round": lambda: None,
+        "timeout_ms": lambda: 5000.0,
+        "credit": lambda: 32,
+        "syncing": lambda: frozenset(),
+        "boundaries": lambda: (),
+        "incidents": lambda: 0,
+    }
+    providers.update(over)
+    return StateView(providers)
+
+
+# ---- the view is read-only and deterministic --------------------------------
+
+
+def test_state_view_is_read_only():
+    view = _view()
+    with pytest.raises(AttributeError):
+        view.round = 99
+    with pytest.raises(AttributeError):
+        view.extra = "steer"
+    with pytest.raises(AttributeError):
+        del view.round
+    # nor can a policy reach the provider table to swap callbacks
+    with pytest.raises(AttributeError):
+        view._providers = {}
+
+
+def test_state_view_reads_are_fresh_and_defaulted():
+    state = {"round": 3}
+    view = _view(**{"round": lambda: state["round"]})
+    assert view.round == 3
+    state["round"] = 7
+    assert view.round == 7  # fresh pure read, no cached snapshot
+    # missing providers degrade to inert defaults, never raise
+    bare = StateView({})
+    assert bare.round == 0
+    assert bare.last_tc_round is None
+    assert bare.timeout_ms == 0.0
+    assert bare.credit is None
+    assert bare.syncing_peers == frozenset()
+    assert bare.epoch_boundaries == ()
+    assert bare.incidents == 0
+    assert not bare.is_leader(5)
+
+
+# ---- each trigger fires on its fixture and stays silent otherwise -----------
+
+
+def test_ambush_trigger_needs_fresh_tc_and_leadership():
+    # round 12: auth-0 leads (12 % 4 == 0) and round 11 ended in a TC
+    armed = _view(**{"last_tc_round": lambda: 11})
+    assert ambush_trigger(armed, 12)
+    # leading but the TC is stale
+    assert not ambush_trigger(_view(**{"last_tc_round": lambda: 9}), 12)
+    # fresh TC but someone else leads round 13
+    assert not ambush_trigger(_view(**{"last_tc_round": lambda: 12}), 13)
+    # no TC ever
+    assert not ambush_trigger(_view(), 12)
+
+
+def test_sync_trigger_needs_a_bootstrapping_peer():
+    assert not sync_trigger(_view(), 10)
+    prey = _view(**{"syncing": lambda: frozenset({"auth-2"})})
+    assert sync_trigger(prey, 10)
+
+
+def test_surf_trigger_spares_votes_we_collect_ourselves():
+    # auth-0 collects round-12 votes (leads 12), so delaying the round-11
+    # vote stalls nobody but us
+    assert not surf_trigger(_view(), 11)
+    assert surf_trigger(_view(), 10)  # round-11 collector is auth-3
+
+
+def test_snipe_trigger_fires_only_inside_the_margin(monkeypatch):
+    monkeypatch.setenv("HOTSTUFF_ADAPT_SNIPE_MARGIN", "4")
+    view = _view(**{"boundaries": lambda: (40,)})
+    assert snipe_trigger(view, 36)
+    assert snipe_trigger(view, 44)
+    assert not snipe_trigger(view, 35)
+    assert not snipe_trigger(view, 45)
+    assert not snipe_trigger(_view(), 40)  # static committee: no window
+
+
+# ---- wants(): the seam contract ---------------------------------------------
+
+
+def _plane(policy, **kw):
+    spec = _spec(policy, **kw)
+    plane = AdversaryPlane(spec, ("127.0.0.1", 9_940))
+    return plane, spec["epoch_unix"]
+
+
+def test_adaptive_policies_ride_the_base_rule_table():
+    assert set(ADAPTIVE_POLICIES) <= set(POLICIES)
+    assert set(ADAPTIVE_SHORT) == set(ADAPTIVE_POLICIES)
+    assert set(ADAPTIVE_TRIGGERS) == set(ADAPTIVE_POLICIES)
+
+
+def test_wants_returns_token_when_trigger_fires():
+    plane, epoch = _plane("ambush-leader")
+    plane.bind_view({
+        "round": lambda: 12,
+        "leader": lambda r: f"auth-{r % 4}",
+        "self": lambda: "auth-0",
+        "last_tc_round": lambda: 11,
+    })
+    fired = plane.wants("equivocate", 12, now=epoch + 1.0)
+    assert fired == "ambush"
+    # silent outside the trigger state ...
+    assert plane.wants("equivocate", 13, now=epoch + 1.0) is False
+    # ... for other actions ...
+    assert plane.wants("withhold", 12, now=epoch + 1.0) is False
+    # ... and outside the policy window
+    assert plane.wants("equivocate", 12, now=epoch - 1.0) is False
+
+
+def test_wants_without_view_degrades_to_schedule_gating():
+    plane, epoch = _plane("timeout-surfer")
+    assert plane.view is None
+    assert plane.wants("vote-delay", 5, now=epoch + 1.0) is False
+    # a schedule-driven policy still answers plain True through wants()
+    base, epoch2 = _plane("withhold")
+    assert base.wants("withhold", 5, now=epoch2 + 1.0) is True
+
+
+def test_trigger_evaluation_consumes_zero_rng_draws():
+    """The determinism contract: the seeded decision stream is
+    byte-for-byte the same whether adaptive triggers fire or not."""
+    plane, epoch = _plane("reconfig-sniper")
+    plane.bind_view({
+        "round": lambda: 40,
+        "boundaries": lambda: (40,),
+    })
+    before = plane.rng.draws
+    assert plane.wants("reconfig", 40, now=epoch + 1.0) == "snipe"
+    assert plane.wants("withhold", 40, now=epoch + 1.0) == "snipe"
+    assert plane.wants("reconfig", 400, now=epoch + 1.0) is False
+    assert plane.rng.draws == before == 0
+
+
+def test_mark_adaptive_counts_and_ignores_schedule_true():
+    plane, epoch = _plane("sync-predator")
+    plane.bind_view({})
+    plane.note_syncing("auth-3")
+    fired = plane.wants("sync-withhold", now=epoch + 1.0)
+    assert fired == "sync"
+    plane.mark_adaptive(fired, 7)
+    assert plane.counts["byz_adapt_sync"] == 1
+    plane.mark_adaptive(True, 7)  # schedule-driven True: no-op
+    assert plane.counts["byz_adapt_sync"] == 1
+
+
+def test_surf_delay_stays_inside_the_timer(monkeypatch):
+    plane, _ = _plane("timeout-surfer")
+    assert 0.0 < plane.surf_delay_s(5.0) < 5.0
+    monkeypatch.setenv("HOTSTUFF_ADAPT_SURF_FRACTION", "7.0")
+    assert plane.surf_delay_s(5.0) <= 0.95 * 5.0  # clamp holds
+
+
+# ---- rng continuity across restarts -----------------------------------------
+
+
+def test_counting_random_checkpoint_resumes_the_stream(tmp_path):
+    path = rng_state_path(str(tmp_path), 2)
+    a = CountingRandom("3|adversary|2")
+    reference = [a.random() for _ in range(10)]
+
+    b = CountingRandom("3|adversary|2")
+    assert [b.random() for _ in range(4)] == reference[:4]
+    save_rng_state(path, b)
+    assert b.draws == 4
+
+    # "restart": a fresh generator restored from the checkpoint must
+    # RESUME at draw 4, not replay from the top
+    c = CountingRandom("3|adversary|2")
+    assert load_rng_state(path, c) == 4
+    assert [c.random() for _ in range(6)] == reference[4:]
+    assert c.draws == 10
+    # no checkpoint -> None, generator untouched
+    assert load_rng_state(str(tmp_path / "missing.json"),
+                          CountingRandom(0)) is None
+
+
+def test_plane_restores_rng_from_env_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOTSTUFF_ADAPT_RNG_DIR", str(tmp_path))
+    a, _ = _plane("timeout-surfer")
+    reference = [a.rng.random() for _ in range(8)]
+
+    b, _ = _plane("timeout-surfer")
+    [b.rng.random() for _ in range(3)]
+    b.count("byz_adapt_surf")  # decision boundary: checkpoints the rng
+
+    restarted, _ = _plane("timeout-surfer")
+    assert restarted.rng.draws == 3
+    assert [restarted.rng.random() for _ in range(5)] == reference[3:]
+
+
+# ---- mutate_schedule is a pure function of (parent, salt) -------------------
+
+
+def test_mutate_schedule_deterministic_and_non_destructive():
+    from hotstuff_tpu.sim import draw_schedule, mutate_schedule
+
+    parent = draw_schedule(5, nodes=4, profile="adaptive")
+    snapshot = __import__("copy").deepcopy(parent)
+    a = mutate_schedule(parent, 1)
+    b = mutate_schedule(parent, 1)
+    assert a == b  # same salt, same child
+    assert parent == snapshot  # the parent is never modified in place
+    assert a["seed"] != parent["seed"]
+    c = mutate_schedule(parent, 2)
+    assert c != a  # different salt explores a different neighbor
+    from hotstuff_tpu.sim import profile_of_events
+
+    for child in (a, b, c):
+        assert child["profile"] == profile_of_events(child["events"])

@@ -171,7 +171,24 @@ def _corpus():
 )
 def test_regression_corpus(entry):
     """Every seed that ever produced an invariant failure during the sim
-    plane's development, replayed against today's tree."""
+    plane's development, replayed against today's tree.  Entries with an
+    inline ``schedule`` were promoted by the guided adversary search
+    (docs/FAULTS.md): those must replay to the SAME verdict, the same
+    threat set, and a byte-identical journal digest."""
+    if "schedule" in entry:
+        schedule = entry["schedule"]
+        assert schedule["profile"] == entry["profile"]
+        verdict = run_schedule(schedule)
+        assert verdict.ok == entry["ok"], (entry["note"], verdict.failures)
+        assert list(verdict.threats) == list(entry.get("threats", [])), (
+            entry["note"],
+            verdict.threats,
+        )
+        assert verdict.journal_digest == entry["journal_digest"], (
+            entry["note"],
+            "journal digest diverged from the promoted counterexample",
+        )
+        return
     schedule = draw_schedule(entry["seed"], nodes=_corpus()["nodes"])
     assert schedule["profile"] == entry["profile"]
     verdict = run_schedule(schedule)
