@@ -748,6 +748,61 @@ def bench_critpath(seed: int = 1, nodes: int = 4) -> dict | None:
         return None
 
 
+def bench_net(seed: int = 1, nodes: int = 4) -> dict | None:
+    """Wire-level flow accounting probe (ISSUE 19): one deterministic
+    sim schedule with the flow accountant on, read back through
+    ``SimVerdict.flows`` (per-node flow tables, byte-identical across
+    same-seed runs).  Reports the median per-node propose-amplification
+    factor — wire propose egress / logical propose bytes, exactly n-1
+    when every proposal is one broadcast — and the committee's wire
+    egress per committed block.  Feeds the ``net.leader_amp_p50`` and
+    ``net.wire_bytes_per_commit`` perfgate guards; returns None (key
+    omitted, guards skip) when accounting is disabled or the sim plane
+    fails, so the kernel benchmarks above still publish."""
+    try:
+        from hotstuff_tpu.sim import draw_schedule, run_schedule
+
+        verdict = run_schedule(draw_schedule(seed, nodes=nodes))
+        if not verdict.flows:
+            raise RuntimeError(
+                "no flow tables (HOTSTUFF_NET=0 or nothing sent)"
+            )
+        tx_total = 0
+        amps = []
+        for tables in verdict.flows.values():
+            propose_tx = 0
+            propose_logical = 0
+            for table in tables:
+                for key, row in (table.get("flows") or {}).items():
+                    _peer, d, cls = key.rsplit("|", 2)
+                    if d == "tx":
+                        tx_total += row[0]
+                        if cls == "propose":
+                            propose_tx += row[0]
+                logical = (table.get("logical") or {}).get("propose")
+                if logical:
+                    propose_logical += logical[0]
+            if propose_logical:
+                amps.append(propose_tx / propose_logical)
+        amps.sort()
+        # verdict.commits counts per-node observations; every node
+        # observes every committed block, so unique blocks ~ commits/n
+        unique = max(1, round(verdict.commits / max(nodes, 1)))
+        return {
+            "seed": seed,
+            "nodes": nodes,
+            "tx_bytes": tx_total,
+            "commits": unique,
+            "leader_amp_p50": (
+                round(amps[len(amps) // 2], 3) if amps else None
+            ),
+            "wire_bytes_per_commit": round(tx_total / unique),
+        }
+    except Exception as e:  # the bench must survive a broken net plane
+        print(f"bench_net skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def bench_adapt(schedules: int = 6, nodes: int = 4) -> dict | None:
     """Adaptive-adversary search throughput probe (docs/FAULTS.md): a
     short sweep of adaptive-profile schedules — state-reactive byz
@@ -891,6 +946,11 @@ def main() -> int:
     # failure so the perfgate adapt guards skip instead of failing
     adapt = bench_adapt()
 
+    # wire-level flow accounting rollup (propose amplification + wire
+    # bytes per commit); key omitted on failure or with HOTSTUFF_NET=0
+    # so the perfgate net guards skip instead of failing
+    net = bench_net()
+
     print(
         json.dumps(
             {
@@ -913,6 +973,7 @@ def main() -> int:
                 **({"sim": sim} if sim is not None else {}),
                 **({"critpath": critpath} if critpath is not None else {}),
                 **({"adapt": adapt} if adapt is not None else {}),
+                **({"net": net} if net is not None else {}),
             }
         )
     )

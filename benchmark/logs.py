@@ -211,6 +211,18 @@ class LogParser:
                 self.health_events.append((_ts(ts), doc))
         self.health_events.sort(key=lambda e: e[0])
 
+        # wire-level flow accounting (ISSUE 19): the flows section of
+        # each node's last snapshot — per-(peer, dir, class) byte
+        # ledgers plus the per-class amplification factors.  A doc with
+        # {"enabled": False} means the node ran with HOTSTUFF_NET=0:
+        # the block renders n/a rather than vanishing, so "accounting
+        # off" is never mistaken for "no traffic".
+        self.flow_docs: list[dict] = [
+            d["flows"]
+            for d in self.telemetry_docs
+            if isinstance(d.get("flows"), dict)
+        ]
+
         # compact-certificate telemetry (ISSUE 9): the aggregator section
         # records the last emitted QC's wire size (compact = agg sig +
         # signer bitmap, vote-list = n x full votes) and how many
@@ -449,6 +461,7 @@ class LogParser:
             + self._verify_stats_txt()
             + self._telemetry_breakdown_txt()
             + self._health_txt()
+            + self._net_txt()
             + extra
             + "-----------------------------------------\n"
         )
@@ -591,6 +604,123 @@ class LogParser:
             lines.append(
                 f" SLO burn: {100.0 * min(burn, 1.0):.1f}% of monitored"
                 " node-time inside an open incident\n"
+            )
+        return "".join(lines)
+
+    def net_summary(self) -> dict | None:
+        """Committee-wide wire flow rollup (ISSUE 19), or None when no
+        node exported an ENABLED flows section.  The perfgate ``net``
+        block and the scaling table read this instead of re-deriving it
+        from raw snapshots."""
+        live = [f for f in self.flow_docs if f.get("enabled")]
+        if not live:
+            return None
+        tx = sum(f.get("tx_bytes", 0) for f in live)
+        rx = sum(f.get("rx_bytes", 0) for f in live)
+        cls_tx: dict[str, int] = {}
+        cls_fr: dict[str, int] = {}
+        for f in live:
+            for cls, ent in (f.get("classes") or {}).items():
+                cls_tx[cls] = cls_tx.get(cls, 0) + ent.get("tx_bytes", 0)
+                cls_fr[cls] = cls_fr.get(cls, 0) + ent.get("tx_frames", 0)
+        amps = sorted(
+            a
+            for f in live
+            for a in [(f.get("amp") or {}).get("propose")]
+            if a
+        )
+
+        def pct(p: float) -> float:
+            import math
+
+            return amps[min(len(amps) - 1, math.ceil(p * len(amps)) - 1)]
+
+        return {
+            "nodes": len(live),
+            "tx_bytes": tx,
+            "rx_bytes": rx,
+            "retx_bytes": sum(f.get("retx_bytes", 0) for f in live),
+            "retx_frames": sum(f.get("retx_frames", 0) for f in live),
+            "peers_elided": sum(f.get("peers_elided", 0) for f in live),
+            "class_tx_bytes": cls_tx,
+            "class_tx_frames": cls_fr,
+            "leader_amp_p50": pct(0.50) if amps else None,
+            "leader_amp_p99": pct(0.99) if amps else None,
+            "wire_bytes_per_commit": (
+                round(tx / len(self.commits)) if self.commits else None
+            ),
+        }
+
+    def _net_txt(self) -> str:
+        """The ``+ NET`` block (wire-level flow accounting, ISSUE 19):
+        committee-wide egress/ingress, wire bytes per commit, per-class
+        egress shares (they sum to 100% of accounted bytes — every
+        frame lands in exactly one class), propose-amplification
+        percentiles across nodes, retransmit overhead, and the
+        compact-QC-on-wire vs vote-list-equivalent comparison."""
+        if not self.flow_docs:
+            return ""
+        net = self.net_summary()
+        lines = [" + NET (wire flow accounting):\n"]
+        if net is None:
+            lines.append(
+                " Flow accounting: n/a (disabled — HOTSTUFF_NET=0)\n"
+            )
+            return "".join(lines)
+        tx, rx = net["tx_bytes"], net["rx_bytes"]
+        _, dur = self.consensus_throughput()
+        rate_txt = f" ({round(tx / dur):,} B/s)" if dur and tx else ""
+        lines.append(
+            f" Wire egress: {tx:,} B across {net['nodes']}"
+            f" node(s){rate_txt}\n"
+        )
+        lines.append(f" Wire ingress: {rx:,} B\n")
+        wpc = net["wire_bytes_per_commit"]
+        lines.append(
+            f" Wire bytes per commit: {wpc:,} B egress"
+            f" ({len(self.commits)} commits)\n"
+            if wpc is not None
+            else " Wire bytes per commit: n/a (no commits in the window)\n"
+        )
+        if tx:
+            for cls, b in sorted(
+                net["class_tx_bytes"].items(), key=lambda e: (-e[1], e[0])
+            ):
+                if b:
+                    lines.append(
+                        f" Class {cls + ':':<13} {b:>12,} B egress"
+                        f" ({100.0 * b / tx:5.1f}%)\n"
+                    )
+        if net["leader_amp_p50"] is not None:
+            lines.append(
+                f" Propose amplification p50/p99:"
+                f" {net['leader_amp_p50']:.2f} /"
+                f" {net['leader_amp_p99']:.2f}"
+                " (wire/logical egress; broadcast fan-out = n-1)\n"
+            )
+        if tx:
+            lines.append(
+                f" Retransmit overhead: {net['retx_bytes']:,} B"
+                f" ({100.0 * net['retx_bytes'] / tx:.2f}% of egress,"
+                f" {net['retx_frames']} frame(s))\n"
+            )
+        # compact-QC on-wire proof: the last emitted QC's wire size vs
+        # what a quorum of individual votes costs on this run's links
+        # (mean accounted vote frame x 2f+1)
+        vote_b = net["class_tx_bytes"].get("vote", 0)
+        vote_f = net["class_tx_frames"].get("vote", 0)
+        if self.qc_wire_bytes and vote_f:
+            quorum = self.num_node_logs - (self.num_node_logs - 1) // 3
+            votelist = round(quorum * vote_b / vote_f)
+            form = "compact" if self.compact_qcs else "vote-list"
+            lines.append(
+                f" QC on-wire ({form}): {self.qc_wire_bytes:,} B vs"
+                f" ~{votelist:,} B as a {quorum}-vote list\n"
+            )
+        if net["peers_elided"]:
+            lines.append(
+                f" Peer gauges elided: {net['peers_elided']}"
+                " (beyond top-K export; counted, never silent)\n"
             )
         return "".join(lines)
 

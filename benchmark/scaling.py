@@ -142,6 +142,15 @@ def run_scaling(
                         (s.get("ingest") or {}).get("drop_newest", 0)
                         for s in stats
                     ),
+                    # wire-flow columns (ISSUE 19): committee-wide wire
+                    # egress and the median propose-amplification factor
+                    # (n-1 when every proposal is one broadcast)
+                    "net_tx_bytes": (parser.net_summary() or {}).get(
+                        "tx_bytes", 0
+                    ),
+                    "net_amp_p50": (parser.net_summary() or {}).get(
+                        "leader_amp_p50"
+                    ),
                     # live-reconfiguration column (ISSUE 14): the newest
                     # epoch the committee activated during the window
                     # (1 = static committee, the sweep's normal state)
@@ -168,7 +177,7 @@ def format_report(
         f"{'nodes':>6} {'epoch':>5} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
         f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p/m':>13} "
         f"{'qc B':>6} {'agg':>5} {'shed':>6} {'dropN':>5} "
-        f"{'pred 1-core/node':>17}",
+        f"{'net MB':>7} {'amp':>5} {'pred 1-core/node':>17}",
     ]
     for r in rows:
         window = max(r["window_s"], 1e-9)
@@ -196,13 +205,17 @@ def format_report(
         shed_txt = f"{shed}" if shed else "-"
         drops = r.get("ingest_drops", 0)
         drops_txt = f"{drops}" if drops else "-"
+        net_tx = r.get("net_tx_bytes", 0)
+        net_txt = f"{net_tx / 1e6:.1f}" if net_tx else "-"
+        amp = r.get("net_amp_p50")
+        amp_txt = f"{amp:.1f}" if amp else "-"
         lines.append(
             f"{r['nodes']:>6} {r.get('epoch', 1):>5} "
             f"{r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
             f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>13} "
             f"{qc_txt:>6} {agg_txt:>5} {shed_txt:>6} {drops_txt:>5} "
-            f"{predicted:>17.0f}"
+            f"{net_txt:>7} {amp_txt:>5} {predicted:>17.0f}"
         )
     lines += [
         "",
@@ -231,6 +244,10 @@ def format_report(
         "BUSY reply vs payloads SILENTLY dropped at the full proposer "
         "buffer — dropN must stay '-' whenever admission control is "
         "doing its job (docs/LOAD.md);",
+        "- net MB / amp: committee-wide wire egress (flow accounting, "
+        "HOTSTUFF_NET) and the median propose-amplification factor — "
+        "wire/logical egress bytes, n-1 when every proposal is exactly "
+        "one broadcast ('-' with accounting disabled);",
         "- pred: payloads/s one node sustains on a DEDICATED core (the "
         "reference topology, one host per node) = 1/c.  Committee size "
         "multiplies the fleet's total work, not the per-node cost, so "

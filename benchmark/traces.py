@@ -50,6 +50,7 @@ from hotstuff_tpu.telemetry.taxonomy import (
     FAULT_PREFIX,
     HEALTH_PREFIX,
     INGEST_PREFIX,
+    NET_PREFIX,
     RECONFIG_PREFIX,
     SPAN_ANNOTATION_STAGES,
 )
@@ -324,6 +325,12 @@ class TraceSet:
         # round) per journaled epoch-change step (submit/commit/
         # activate/retire/link)
         self.reconfig_events: list[tuple[int, str, str, int]] = []
+        # network-plane flow samples (ISSUE 19): (w_corr, node,
+        # direction, class, cumulative bytes).  The flow accountant
+        # journals one net.tx/net.rx record per HOTSTUFF_NET_SAMPLE
+        # charges; the class rides the peer field, the node's cumulative
+        # direction bytes ride the "u" field.
+        self.net_events: list[tuple[int, str, str, str, int]] = []
         # health-plane incident windows (ISSUE 13): (node, kind,
         # w_open_corr, w_close_corr|None).  Each node's in-process
         # monitor journals open/close per detector, phase in the peer
@@ -435,6 +442,20 @@ class TraceSet:
                         )
                     )
                     continue
+                if e.startswith(NET_PREFIX):
+                    # network-plane samples must never reach _block
+                    # either ("d" is None): class in the peer field,
+                    # cumulative direction bytes in the "u" field
+                    self.net_events.append(
+                        (
+                            self._corr(node, r["w"]),
+                            node,
+                            e[len(NET_PREFIX):],
+                            r.get("p", ""),
+                            int(r.get("u") or 0),
+                        )
+                    )
+                    continue
                 if e in CONTROL_EDGES:
                     continue
                 if e == "recv.producer":
@@ -521,6 +542,7 @@ class TraceSet:
         self.byz_events.sort()
         self.ingest_events.sort()
         self.reconfig_events.sort()
+        self.net_events.sort()
         # health incidents pair per (node, detector kind) — each node's
         # monitor journals only its own firings
         health_open: dict[tuple[str, str], int] = {}
@@ -722,6 +744,22 @@ class TraceSet:
                 )
                 + "\n"
             )
+        if self.net_events:
+            nodes = sorted({n for _w, n, _d, _c, _v in self.net_events})
+            peak_tx = max(
+                (v for _w, _n, d, _c, v in self.net_events if d == "tx"),
+                default=0,
+            )
+            peak_rx = max(
+                (v for _w, _n, d, _c, v in self.net_events if d == "rx"),
+                default=0,
+            )
+            lines.append(
+                f" Network plane journaled: {len(self.net_events)}"
+                f" flow sample(s) on {', '.join(nodes)};"
+                f" peak per-node cumulative egress {peak_tx:,} B,"
+                f" ingress {peak_rx:,} B\n"
+            )
         if self.reconfig_events:
             steps = Counter(s for _w, _n, s, _r in self.reconfig_events)
             shown = ", ".join(
@@ -803,6 +841,7 @@ class TraceSet:
         anchors.extend(w for w, _, _, _ in self.byz_events)
         anchors.extend(w for w, _, _, _ in self.ingest_events)
         anchors.extend(w for w, _, _, _ in self.reconfig_events)
+        anchors.extend(w for w, _, _, _, _ in self.net_events)
         anchors.extend(w for _, _, w, _ in self.health_spans)
         anchors.extend(w for _, _, _, w in self.health_spans if w is not None)
         for rows in self.verify_spans.values():
@@ -1138,6 +1177,67 @@ class TraceSet:
                         "args": {"step": step, "round": rnd, "node": node},
                     }
                 )
+        if self.net_events:
+            # dedicated network plane (one pid past the critical path):
+            # one cumulative-bytes counter track per (node, direction) —
+            # Perfetto renders the slope, i.e. per-node bandwidth — plus
+            # one flow lane per message class with a marker per journaled
+            # sample, so a propose burst reads directly against the
+            # rounds and fault windows that caused it
+            net_pid = len(self.nodes) + 6
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": net_pid,
+                    "tid": 0,
+                    "args": {"name": "network plane"},
+                }
+            )
+            classes = sorted(
+                {c for _w, _n, _d, c, _v in self.net_events if c}
+            )
+            tid_of = {c: i + 1 for i, c in enumerate(classes)}
+            for c, tid in tid_of.items():
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": net_pid,
+                        "tid": tid,
+                        "args": {"name": f"flow {c}"},
+                    }
+                )
+            for w, node, d, cls, v in self.net_events:
+                events.append(
+                    {
+                        "name": f"net {d} {node}",
+                        "cat": "net",
+                        "ph": "C",
+                        "pid": net_pid,
+                        "tid": 0,
+                        "ts": us(w),
+                        "args": {"bytes": v},
+                    }
+                )
+                if cls in tid_of:
+                    events.append(
+                        {
+                            "name": f"{d} {cls}",
+                            "cat": "net",
+                            "ph": "i",
+                            "s": "t",
+                            "pid": net_pid,
+                            "tid": tid_of[cls],
+                            "ts": us(w),
+                            "args": {
+                                "node": node,
+                                "dir": d,
+                                "class": cls,
+                                "cum_bytes": v,
+                            },
+                        }
+                    )
         for node, rows in sorted(self.verify_spans.items()):
             # verify-pipeline profiler track (ISSUE 4): one thread lane
             # under the journaling node's process, so the dispatch

@@ -65,6 +65,8 @@ _COLUMNS = (
     ("LDR", 3),
     ("ROUTE d/m/c", 12),
     ("CREDIT", 7),
+    ("EGR/S", 8),
+    ("AMP", 5),
     ("P50ms", 7),
     ("DOMINANT-STAGE", 15),
 )
@@ -166,6 +168,11 @@ def node_view(name: str, flat: dict) -> dict:
         "shed": g("ingest.shed_total", 0),
         "version": g("state.version", 0),
         "root": g("state.root", ""),
+        # wire flow accounting (ISSUE 19): cumulative egress bytes (the
+        # EGR/S column is its window slope) and the node's propose
+        # amplification factor (n-1 when every proposal is one broadcast)
+        "net_tx": g("flows.tx_bytes", 0),
+        "amp": g("flows.amp.propose", 0.0),
         "p50_ms": g(
             "metrics.hotstuff_commit_edge_seconds{edge=propose_to_commit}"
             ".p50_ms",
@@ -218,6 +225,8 @@ class FleetWatcher:
         self.offsets = offsets or {}
         span = max(60.0, 4 * stall_k * timeout_s)
         self._w_commits = {f.name: Window(span_s=span) for f in self.feeds}
+        # per-node cumulative wire-egress windows (EGR/S column slope)
+        self._w_net = {f.name: Window(span_s=span) for f in self.feeds}
         self._last_sample: dict = {}  # node -> (t, view)
         self._pool = ThreadPoolExecutor(max_workers=max(len(self.feeds), 1))
         self.incidents: list = []  # (t, Incident) history
@@ -247,6 +256,7 @@ class FleetWatcher:
             view["stale"] = False
             self._last_sample[feed.name] = (now, view)
             self._w_commits[feed.name].push(now, float(view["commits"] or 0))
+            self._w_net[feed.name].push(now, float(view.get("net_tx") or 0))
             rounds_by_node[feed.name] = (now, float(view["round"] or 0))
             if view["root"]:
                 roots_by_node[feed.name] = (
@@ -362,6 +372,8 @@ def render(view: dict) -> str:
             "*" if v.get("name") == view["leader"] else "",
             "/".join(str(int(r or 0)) for r in route),
             str(v.get("credit", "") or 0),
+            _fmt_egress(v),
+            _fmt_amp(v),
             f"{float(v.get('p50_ms') or 0):.1f}",
             str(v.get("dominant") or "-"),
         )
@@ -390,6 +402,21 @@ def _fmt_rate(v: dict) -> str:
     return f"{r:.1f}" if isinstance(r, float) else "-"
 
 
+def _fmt_egress(v: dict) -> str:
+    """Wire egress B/s (window slope over flows.tx_bytes), scaled."""
+    r = v.get("egress_rate")
+    if not isinstance(r, float):
+        return "-"
+    if r >= 1e6:
+        return f"{r / 1e6:.1f}MB"
+    return f"{r / 1e3:.1f}kB"
+
+
+def _fmt_amp(v: dict) -> str:
+    a = v.get("amp")
+    return f"{float(a):.1f}" if a else "-"
+
+
 def run_watch(
     watcher: FleetWatcher,
     duration: float = 0.0,
@@ -415,6 +442,15 @@ def run_watch(
                 if len(samples) >= 2:
                     (ta, va), (tb, vb) = samples[0], samples[-1]
                     v["commit_rate"] = (
+                        (vb - va) / (tb - ta) if tb > ta else 0.0
+                    )
+                # wire-egress B/s: same window-slope treatment over the
+                # node's cumulative flows.tx_bytes counter
+                wn = watcher._w_net.get(v.get("name", ""), None)
+                samples = wn.samples() if wn else []
+                if len(samples) >= 2:
+                    (ta, va), (tb, vb) = samples[0], samples[-1]
+                    v["egress_rate"] = (
                         (vb - va) / (tb - ta) if tb > ta else 0.0
                     )
             if out is print and sys.stdout.isatty() and not once:

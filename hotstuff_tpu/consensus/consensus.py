@@ -598,6 +598,22 @@ class Consensus:
                 adversary.bind(committee, name)
                 log.info("Adversary plane active: %s", adversary.describe())
 
+        # Wire-level flow accounting (ISSUE 19, telemetry/flows.py):
+        # one accountant per node, threaded through every sender and
+        # the receiver the way the fault plane is — each frame charged
+        # to a (peer, direction, class) flow at its transmit/receive
+        # site, surfaced as the snapshot's ``flows`` section.
+        flows = None
+        if telemetry is not None:
+            from ..telemetry.flows import FlowAccounting
+
+            flows = FlowAccounting(node=str(name))
+            flows.label_peers(
+                (str(peer)[:8], addr)
+                for peer, addr in committee.broadcast_addresses(name)
+            )
+            telemetry.attach_flows(flows)
+
         if transport == "native":
             from ..network.native import (
                 NativeReceiver,
@@ -608,10 +624,12 @@ class Consensus:
             receiver_cls = NativeReceiver
 
             def make_sender():
-                return NativeSimpleSender(fault_plane=fault_plane)
+                return NativeSimpleSender(fault_plane=fault_plane, flows=flows)
 
             def make_reliable():
-                return NativeReliableSender(fault_plane=fault_plane)
+                return NativeReliableSender(
+                    fault_plane=fault_plane, flows=flows
+                )
         elif transport == "sim":
             # Virtual-time simulation (hotstuff_tpu/sim): the stock
             # asyncio senders run verbatim — the ambient connector seam
@@ -639,12 +657,16 @@ class Consensus:
 
             def make_sender():
                 return SimpleSender(
-                    link_delay=link_delay, fault_plane=fault_plane
+                    link_delay=link_delay,
+                    fault_plane=fault_plane,
+                    flows=flows,
                 )
 
             def make_reliable():
                 return ReliableSender(
-                    link_delay=link_delay, fault_plane=fault_plane
+                    link_delay=link_delay,
+                    fault_plane=fault_plane,
+                    flows=flows,
                 )
         else:
             from ..network import ReliableSender, SimpleSender
@@ -664,6 +686,7 @@ class Consensus:
                     link_delay=link_delay,
                     max_conns=max_conns,
                     fault_plane=fault_plane,
+                    flows=flows,
                 )
 
             def make_reliable():
@@ -671,6 +694,7 @@ class Consensus:
                     link_delay=link_delay,
                     max_conns=max_conns,
                     fault_plane=fault_plane,
+                    flows=flows,
                 )
         self.receiver = receiver_cls(
             bind_host,
@@ -688,6 +712,7 @@ class Consensus:
                 committee=committee,
             ),
             fault_plane=fault_plane,
+            flows=flows,
         )
         await self.receiver.spawn()
         log.info(
@@ -778,18 +803,16 @@ class Consensus:
             network=make_sender(),
             telemetry=telemetry,
         )
-        # Per-peer network gauges at small committee sizes (ROADMAP
-        # follow-up): bounded label cardinality, and small committees are
-        # where per-peer attribution is readable.  All four senders dial
-        # the same peer set (the broadcast addresses); works for bare
-        # committees and epoch schedules alike (union view).
+        # Per-peer network gauges at EVERY committee size (ISSUE 19
+        # no-silent-caps rule): register_network caps the registered
+        # gauge cardinality at PEER_GAUGE_MAX_COMMITTEE and counts the
+        # rest in net_peers_elided — nothing is silently dropped.  All
+        # four senders dial the same peer set (the broadcast
+        # addresses); works for bare committees and epoch schedules
+        # alike (union view).
         peers = None
         if telemetry is not None:
-            from .. import telemetry as telemetry_mod
-
-            all_peers = committee.broadcast_addresses(name)
-            if len(all_peers) + 1 <= telemetry_mod.PEER_GAUGE_MAX_COMMITTEE:
-                peers = all_peers
+            peers = committee.broadcast_addresses(name)
         if telemetry is not None:
             telemetry.register_store(store)
             telemetry.register_network(

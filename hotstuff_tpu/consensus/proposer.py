@@ -285,10 +285,19 @@ class Proposer:
         com = self.committee.for_round(round_)
         names_addresses = self.committee.broadcast_addresses(self.name)
         message = encode_propose(block)
-        handles = [
-            (name, await self.network.send(address, message))
-            for name, address in names_addresses
-        ]
+        # broadcast() (not a per-peer send loop) so flow accounting
+        # charges ONE logical propose per proposal: the wire/logical
+        # ratio is the leader amplification factor (== n-1 here).
+        # ReliableSender.broadcast enqueues per address in list order,
+        # so handles pair with names exactly as the loop did.
+        handles = list(
+            zip(
+                (name for name, _ in names_addresses),
+                await self.network.broadcast(
+                    [address for _, address in names_addresses], message
+                ),
+            )
+        )
 
         await self.tx_loopback.put(block)
 

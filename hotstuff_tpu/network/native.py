@@ -85,11 +85,12 @@ def _load_lib() -> ctypes.CDLL:
             raise ImportError(f"cannot build {_LIB_NAME}: {e}") from e
         # no toolchain but a prebuilt library exists: try it
     lib = ctypes.CDLL(path)
-    if not hasattr(lib, "ht_set_read_paused"):
-        # keep the documented contract (ImportError, so importorskip /
+    if not hasattr(lib, "ht_counters"):
+        # probe the NEWEST entry point so a stale prebuilt .so keeps
+        # the documented contract (ImportError, so importorskip /
         # try-except fallbacks behave instead of AttributeError at bind)
         raise ImportError(
-            f"stale {_LIB_NAME}: missing ht_set_read_paused; "
+            f"stale {_LIB_NAME}: missing ht_counters; "
             f"rebuild with `make -C native`"
         )
     lib.ht_start.restype = ctypes.c_void_p
@@ -133,6 +134,11 @@ def _load_lib() -> ctypes.CDLL:
     lib.ht_close_listener.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.ht_close_conn.restype = ctypes.c_int
     lib.ht_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.ht_counters.restype = None
+    lib.ht_counters.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_ulonglong),
+    ]
     lib.ht_stop.restype = None
     lib.ht_stop.argtypes = [ctypes.c_void_p]
     return lib
@@ -171,6 +177,19 @@ class Reactor:
         if cls._instance is None:
             cls._instance = cls()
         return cls._instance
+
+    def counters(self) -> dict:
+        """Cumulative reactor wire counters (ISSUE 19): the C++-side
+        ground truth the Python flow accounting is cross-checked
+        against (tests/test_flows.py loopback round-trip)."""
+        out = (ctypes.c_ulonglong * 4)()
+        self.lib.ht_counters(self.handle, out)
+        return {
+            "tx_bytes": int(out[0]),
+            "tx_frames": int(out[1]),
+            "rx_bytes": int(out[2]),
+            "rx_frames": int(out[3]),
+        }
 
     def ensure_reader(self) -> None:
         """Register the notify-fd reader with the RUNNING loop.  The
@@ -231,14 +250,19 @@ class Reactor:
 class NativeWriter:
     """Reply channel handed to MessageHandler.dispatch."""
 
-    def __init__(self, reactor: Reactor, conn_id: int):
+    def __init__(self, reactor: Reactor, conn_id: int, flows=None):
         self._reactor = reactor
         self._conn = conn_id
+        self._flows = flows
 
     async def send(self, payload: bytes) -> None:
-        self._reactor.lib.ht_reply(
+        rc = self._reactor.lib.ht_reply(
             self._reactor.handle, self._conn, payload, len(payload)
         )
+        # replies leave on the accepted connection; a refused reply
+        # (outbox full -> connection closed) never hits the wire
+        if rc == 0 and self._flows is not None:
+            self._flows.tx(self.peer, payload)
 
     @property
     def peer(self):
@@ -265,11 +289,14 @@ class NativeReceiver:
     HIGH_WATER = 256
     LOW_WATER = 64
 
-    def __init__(self, host: str, port: int, handler, fault_plane=None):
+    def __init__(
+        self, host: str, port: int, handler, fault_plane=None, flows=None
+    ):
         self.host = host
         self.port = port
         self.handler = handler
         self._faults = fault_plane
+        self._flows = flows
         self.reactor = Reactor.shared()
         self._listener = -1
         self._queues: dict[int, asyncio.Queue] = {}
@@ -300,6 +327,10 @@ class NativeReceiver:
             return
         if kind != KIND_FRAME_ACCEPTED:
             return
+        # charge receive flows at delivery from the reactor (accepted
+        # conns carry no committee identity: attributed to "native")
+        if self._flows is not None:
+            self._flows.rx(("native", conn_id), payload)
         q = self._queues.get(conn_id)
         if q is None:
             q = asyncio.Queue()
@@ -315,7 +346,7 @@ class NativeReceiver:
             )
 
     async def _worker(self, conn_id: int, q: asyncio.Queue) -> None:
-        writer = NativeWriter(self.reactor, conn_id)
+        writer = NativeWriter(self.reactor, conn_id, flows=self._flows)
         while True:
             payload = await q.get()
             if payload is None:
@@ -416,9 +447,10 @@ class NativeSimpleSender:
     overtake it: reordering is fair game on a lossy best-effort link),
     corrupt mangles the bytes, duplicate hands the frame over twice."""
 
-    def __init__(self, fault_plane=None):
+    def __init__(self, fault_plane=None, flows=None):
         self.reactor = Reactor.shared()
         self._fault_plane = fault_plane
+        self._flows = flows
         self._links: dict[Address, object] = {}
         self._peers: dict[Address, int] = {}
 
@@ -442,6 +474,11 @@ class NativeSimpleSender:
         return peer
 
     async def send(self, address: Address, payload: bytes) -> None:
+        if self._flows is not None:
+            self._flows.logical(payload)
+        await self._dispatch(address, payload)
+
+    async def _dispatch(self, address: Address, payload: bytes) -> None:
         self.reactor.ensure_reader()
         peer = self._peer(address)
         if peer is None:
@@ -455,31 +492,43 @@ class NativeSimpleSender:
                 payload = corrupt_frame(payload)
             if decision.delay_s:
                 asyncio.get_running_loop().call_later(
-                    decision.delay_s, self._send_now, peer, payload,
-                    decision.duplicate,
+                    decision.delay_s, self._send_now, address, peer,
+                    payload, decision.duplicate,
                 )
                 return
             if decision.duplicate:
-                self._send_now(peer, payload, True)
+                self._send_now(address, peer, payload, True)
                 return
-        self.reactor.lib.ht_send(
+        rc = self.reactor.lib.ht_send(
             self.reactor.handle, peer, payload, len(payload)
         )
+        if rc == 0 and self._flows is not None:
+            self._flows.tx(address, payload)
 
-    def _send_now(self, peer: int, payload: bytes, duplicate: bool) -> None:
+    def _send_now(
+        self, address: Address, peer: int, payload: bytes, duplicate: bool
+    ) -> None:
         if not self.reactor.handle:
             return  # reactor stopped while the frame sat in its delay
-        self.reactor.lib.ht_send(
+        rc = self.reactor.lib.ht_send(
             self.reactor.handle, peer, payload, len(payload)
         )
+        if rc == 0 and self._flows is not None:
+            self._flows.tx(address, payload)
         if duplicate:
-            self.reactor.lib.ht_send(
+            rc = self.reactor.lib.ht_send(
                 self.reactor.handle, peer, payload, len(payload)
             )
+            if rc == 0 and self._flows is not None:
+                self._flows.tx(address, payload)
 
     async def broadcast(self, addresses: list[Address], payload: bytes) -> None:
+        # ONE logical charge per broadcast call regardless of fan-out
+        # (wire/logical per class == amplification factor)
+        if self._flows is not None and addresses:
+            self._flows.logical(payload)
         for address in addresses:
-            await self.send(address, payload)
+            await self._dispatch(address, payload)
 
     async def lucky_broadcast(
         self, addresses: list[Address], payload: bytes, nodes: int
@@ -488,8 +537,11 @@ class NativeSimpleSender:
 
         # lint: allow(clock-discipline) -- native-transport-only helper;
         # the sim's lucky_broadcast runs the asyncio sender via the seam
-        for address in random.sample(addresses, min(nodes, len(addresses))):
-            await self.send(address, payload)
+        picks = random.sample(addresses, min(nodes, len(addresses)))
+        if self._flows is not None and picks:
+            self._flows.logical(payload)
+        for address in picks:
+            await self._dispatch(address, payload)
 
     def close(self) -> None:
         if self.reactor.handle:
@@ -532,12 +584,18 @@ class NativeReliableSender:
     #: retries whose backoff sleep was jittered (telemetry aggregate)
     jittered_retries = 0
 
-    def __init__(self, fault_plane=None):
+    def __init__(self, fault_plane=None, flows=None):
         self.reactor = Reactor.shared()
         self._fault_plane = fault_plane
+        self._flows = flows
         self._links: dict[int, object] = {}  # pid -> LinkFaults | None
         self._peers: dict[Address, int] = {}
-        self._queue: dict[int, deque] = {}  # pid -> deque[(payload, fut)]
+        self._addrs: dict[int, Address] = {}  # pid -> address (flow peer)
+        # pid -> deque[[payload, fut, transmitted]]: the third slot
+        # flips once the frame first reaches the reactor, so a
+        # post-disconnect re-send is charged as a RETRANSMIT at the
+        # actual re-send time (sent resets to 0 on KIND_PEER_CLOSED)
+        self._queue: dict[int, deque] = {}
         self._sent: dict[int, int] = {}  # pid -> sent prefix length
         self._delay: dict[int, float] = {}
         self._retry_handle: dict[int, object] = {}
@@ -555,6 +613,7 @@ class NativeReliableSender:
                 self.reactor.handle, host.encode(), address[1]
             )
             self._peers[address] = pid
+            self._addrs[pid] = address
             self._queue[pid] = deque()
             self._sent[pid] = 0
             if self._fault_plane is not None:
@@ -567,6 +626,11 @@ class NativeReliableSender:
         return pid
 
     async def send(self, address: Address, payload: bytes) -> asyncio.Future:
+        if self._flows is not None:
+            self._flows.logical(payload)
+        return await self._enqueue(address, payload)
+
+    async def _enqueue(self, address: Address, payload: bytes) -> asyncio.Future:
         self.reactor.ensure_reader()
         pid = self._peer(address)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -576,14 +640,17 @@ class NativeReliableSender:
             # orphan list lets close() cancel it if nobody does
             self._orphans.append(fut)
             return fut
-        self._queue[pid].append((payload, fut))
+        self._queue[pid].append([payload, fut, False])
         self._flush(pid)
         return fut
 
     async def broadcast(
         self, addresses: list[Address], payload: bytes
     ) -> list[asyncio.Future]:
-        return [await self.send(a, payload) for a in addresses]
+        # ONE logical charge per broadcast call regardless of fan-out
+        if self._flows is not None and addresses:
+            self._flows.logical(payload)
+        return [await self._enqueue(a, payload) for a in addresses]
 
     def _flush(self, pid: int) -> None:
         """Hand unsent queue suffix to the reactor, in order, stopping
@@ -592,7 +659,8 @@ class NativeReliableSender:
         q = self._queue[pid]
         faults = self._links.get(pid)
         while self._sent[pid] < len(q):
-            payload, fut = q[self._sent[pid]]
+            entry = q[self._sent[pid]]
+            payload, fut = entry[0], entry[1]
             if fut.cancelled():
                 # still occupies a pairing slot only if already sent;
                 # unsent cancelled frames can simply be dropped
@@ -619,6 +687,15 @@ class NativeReliableSender:
                         )
                     )
                 return
+            if self._flows is not None:
+                # a frame handed to the reactor a second time (sent
+                # reset by a disconnect) is a retransmit, charged NOW
+                self._flows.tx(
+                    self._addrs.get(pid, ("native", pid)),
+                    payload,
+                    retx=entry[2],
+                )
+            entry[2] = True
             self._sent[pid] += 1
 
     def _retry_flush(self, pid: int) -> None:
@@ -635,7 +712,7 @@ class NativeReliableSender:
             # pop the oldest SENT frame (cancelled futures still consumed
             # an ACK slot on the wire — the peer ACKed the frame)
             if self._sent[pid] > 0:
-                _, fut = q.popleft()
+                fut = q.popleft()[1]
                 self._sent[pid] -= 1
                 if not fut.cancelled():
                     fut.set_result(payload)
@@ -667,14 +744,15 @@ class NativeReliableSender:
             if self.reactor.handle:
                 self.reactor.lib.ht_close_conn(self.reactor.handle, pid)
         for q in self._queue.values():
-            for _, fut in q:
-                if not fut.done():
-                    fut.cancel()  # no caller may hang on a dead sender
+            for entry in q:
+                if not entry[1].done():
+                    entry[1].cancel()  # no caller may hang on a dead sender
         for fut in self._orphans:
             if not fut.done():
                 fut.cancel()
         self._orphans.clear()
         self._peers.clear()
+        self._addrs.clear()
         self._queue.clear()
         self._sent.clear()
 

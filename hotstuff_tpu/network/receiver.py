@@ -22,10 +22,15 @@ log = logging.getLogger(__name__)
 class Writer:
     """Reply-channel handed to MessageHandler.dispatch."""
 
-    def __init__(self, stream_writer: asyncio.StreamWriter):
+    def __init__(self, stream_writer: asyncio.StreamWriter, flows=None):
         self._writer = stream_writer
+        self._flows = flows
 
     async def send(self, payload: bytes) -> None:
+        # replies (ACKs, state-read values) leave on the accepted
+        # socket, not through a sender — charge their egress here
+        if self._flows is not None:
+            self._flows.tx(self.peer, payload)
         await send_frame(self._writer, payload)
 
     @property
@@ -49,12 +54,18 @@ class Receiver:
     must die too."""
 
     def __init__(
-        self, host: str, port: int, handler: MessageHandler, fault_plane=None
+        self,
+        host: str,
+        port: int,
+        handler: MessageHandler,
+        fault_plane=None,
+        flows=None,
     ):
         self.host = host
         self.port = port
         self.handler = handler
         self._faults = fault_plane
+        self._flows = flows
         self._server: asyncio.AbstractServer | None = None
         # insertion-ordered (dict-as-set): shutdown closes connections
         # in accept order, so teardown is reproducible — a plain set
@@ -79,10 +90,14 @@ class Receiver:
         set_nodelay(stream_writer)
         log.debug("Incoming connection from %s", peer)
         self._writers[stream_writer] = None
-        writer = Writer(stream_writer)
+        writer = Writer(stream_writer, flows=self._flows)
         try:
             while True:
                 frame = await read_frame(reader)
+                # charged before the inbound cut: the bytes crossed the
+                # wire whether or not the isolate window swallows them
+                if self._flows is not None:
+                    self._flows.rx(peer, frame)
                 if self._faults is not None and self._faults.inbound_cut():
                     continue  # isolate window: swallow the frame unACKed
                 await self.handler.dispatch(writer, frame)

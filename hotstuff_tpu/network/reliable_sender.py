@@ -62,9 +62,10 @@ class FaultDisconnect(ConnectionError):
 
 
 class _Connection:
-    def __init__(self, address: Address, delay_fn=None, faults=None):
+    def __init__(self, address: Address, delay_fn=None, faults=None, flows=None):
         self.address = address
         self._faults = faults
+        self._flows = flows
         #: retries whose backoff sleep was jittered (telemetry reads
         #: this: stampede-avoided reconnect attempts)
         self.jittered_retries = 0
@@ -155,6 +156,11 @@ class _Connection:
             while self._faults.barrier():
                 await default_clock().sleep(BARRIER_POLL_S)
         for data, _ in self.pending:
+            # charged as a RETRANSMIT at the actual re-send instant —
+            # never at enqueue time — so net_retx_bytes counts bytes
+            # that really crossed the healed link a second time
+            if self._flows is not None:
+                self._flows.tx(self.address, data, retx=True)
             await send_frame(writer, data)
 
         async def writer_loop():
@@ -213,18 +219,26 @@ class _Connection:
         the reliable-link fault semantics)."""
         faults = self._faults
         if faults is None:
+            if self._flows is not None:
+                self._flows.tx(self.address, data)
             await send_frame(writer, data)
             return
         while faults.barrier():
             await default_clock().sleep(BARRIER_POLL_S)
         decision = faults.decide()
         if decision.drop:
+            # never written: never charged (accounted == bytes written)
             raise FaultDisconnect(f"fault plane dropped frame to {self.address}")
         if decision.delay_s:
             await default_clock().sleep(decision.delay_s)
         if decision.corrupt:
-            await send_frame(writer, corrupt_frame(data))
+            mangled = corrupt_frame(data)
+            if self._flows is not None:
+                self._flows.tx(self.address, mangled)
+            await send_frame(writer, mangled)
             raise FaultDisconnect(f"fault plane corrupted frame to {self.address}")
+        if self._flows is not None:
+            self._flows.tx(self.address, data)
         await send_frame(writer, data)
 
     @staticmethod
@@ -271,11 +285,13 @@ class ReliableSender(BoundedPoolMixin):
         link_delay=None,
         max_conns: int | None = None,
         fault_plane=None,
+        flows=None,
     ):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
         self._max_conns = max_conns
         self._fault_plane = fault_plane
+        self._flows = flows
         self._sweeper: asyncio.Task | None = None
 
     def _connection(self, address: Address) -> _Connection:
@@ -286,22 +302,33 @@ class ReliableSender(BoundedPoolMixin):
         faults = (
             self._fault_plane.link(address) if self._fault_plane else None
         )
-        conn = _Connection(address, delay_fn=delay_fn, faults=faults)
+        conn = _Connection(
+            address, delay_fn=delay_fn, faults=faults, flows=self._flows
+        )
         self._admit(address, conn)
         return conn
 
-    async def send(self, address: Address, data: bytes) -> CancelHandler:
-        """Queue ``data`` for reliable delivery; the returned future resolves
-        with the peer's ACK payload."""
+    async def _enqueue(self, address: Address, data: bytes) -> CancelHandler:
         fut: CancelHandler = asyncio.get_running_loop().create_future()
         conn = self._connection(address)
         await conn.queue.put((conn.deliver_at(), data, fut))
         return fut
 
+    async def send(self, address: Address, data: bytes) -> CancelHandler:
+        """Queue ``data`` for reliable delivery; the returned future resolves
+        with the peer's ACK payload."""
+        if self._flows is not None:
+            self._flows.logical(data)
+        return await self._enqueue(address, data)
+
     async def broadcast(
         self, addresses: list[Address], data: bytes
     ) -> list[CancelHandler]:
-        return [await self.send(addr, data) for addr in addresses]
+        # ONE logical charge per broadcast call regardless of fan-out —
+        # the wire/logical ratio per class is the amplification factor
+        if self._flows is not None and addresses:
+            self._flows.logical(data)
+        return [await self._enqueue(addr, data) for addr in addresses]
 
     async def lucky_broadcast(
         self, addresses: list[Address], data: bytes, nodes: int

@@ -40,9 +40,10 @@ class _Connection:
     exactly message loss), delays sleep inline, corruption flips a byte,
     duplication writes the frame twice."""
 
-    def __init__(self, address: Address, delay_fn=None, faults=None):
+    def __init__(self, address: Address, delay_fn=None, faults=None, flows=None):
         self.address = address
         self._faults = faults
+        self._flows = flows
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
         self._scheduler = (
             None if delay_fn is None else LinkScheduler(delay_fn)
@@ -112,7 +113,12 @@ class _Connection:
                 self._writer = None  # disconnected: back to retry state
 
     async def _transmit(self, writer: asyncio.StreamWriter, data: bytes) -> None:
+        # flow accounting charges at THIS site — after the fault
+        # decision — so dropped frames are never charged and duplicated
+        # ones are charged twice: accounted bytes == bytes written
         if self._faults is None:
+            if self._flows is not None:
+                self._flows.tx(self.address, data)
             await send_frame(writer, data)
             return
         decision = self._faults.decide()
@@ -121,8 +127,12 @@ class _Connection:
         if decision.delay_s:
             await default_clock().sleep(decision.delay_s)
         payload = corrupt_frame(data) if decision.corrupt else data
+        if self._flows is not None:
+            self._flows.tx(self.address, payload)
         await send_frame(writer, payload)
         if decision.duplicate:
+            if self._flows is not None:
+                self._flows.tx(self.address, payload)
             await send_frame(writer, payload)
 
     @staticmethod
@@ -171,11 +181,13 @@ class SimpleSender(BoundedPoolMixin):
         link_delay=None,
         max_conns: int | None = None,
         fault_plane=None,
+        flows=None,
     ):
         self._connections: dict[Address, _Connection] = {}
         self._link_delay = link_delay
         self._max_conns = max_conns
         self._fault_plane = fault_plane
+        self._flows = flows
         self._sweeper: asyncio.Task | None = None
 
     def _connection(self, address: Address) -> _Connection:
@@ -186,21 +198,32 @@ class SimpleSender(BoundedPoolMixin):
         faults = (
             self._fault_plane.link(address) if self._fault_plane else None
         )
-        conn = _Connection(address, delay_fn=delay_fn, faults=faults)
+        conn = _Connection(
+            address, delay_fn=delay_fn, faults=faults, flows=self._flows
+        )
         self._admit(address, conn)
         return conn
 
-    async def send(self, address: Address, data: bytes) -> None:
+    def _enqueue(self, address: Address, data: bytes) -> None:
         conn = self._connection(address)
         try:
             conn.put_nowait(data)
         except asyncio.QueueFull:
             log.warning("Dropping message to %s: channel full", address)
 
+    async def send(self, address: Address, data: bytes) -> None:
+        if self._flows is not None:
+            self._flows.logical(data)
+        self._enqueue(address, data)
+
     async def broadcast(self, addresses: list[Address], data: bytes) -> None:
+        # ONE logical charge per broadcast call regardless of fan-out —
+        # the wire/logical ratio per class is the amplification factor
+        if self._flows is not None and addresses:
+            self._flows.logical(data)
         if self._max_conns is None or len(addresses) <= self._max_conns:
             for addr in addresses:
-                await self.send(addr, data)
+                self._enqueue(addr, data)
             return
         # Bounded pool: pace the fan-out so the working set stays near
         # the cap — without this, a committee-wide broadcast creates
@@ -217,7 +240,7 @@ class SimpleSender(BoundedPoolMixin):
         for start in range(0, len(addresses), self._max_conns):
             chunk = addresses[start : start + self._max_conns]
             for addr in chunk:
-                await self.send(addr, data)
+                self._enqueue(addr, data)
             sent.extend(chunk)
             deadline = loop.time() + 2.0
             stalled = False

@@ -114,6 +114,11 @@ class SimCluster:
             SimNode(i, pk, sk, os.path.join(workdir, f"store-{i}"))
             for i, (pk, sk) in enumerate(pairs)
         ]
+        # node short-name -> [flow table per boot] (telemetry/flows.py
+        # ``table()``), harvested at each crash/stop: all charges are
+        # driven by virtual-time scheduling, so a same-seed double-run
+        # must reproduce these byte-for-byte (SimVerdict.flows)
+        self.flow_tables: dict[str, list[dict]] = {}
 
     #: ``str(pk)[:8] -> node index``: the per-actor logger suffix
     #: (e.g. ``hotstuff_tpu.consensus.core.<pk8>``), used by the runner
@@ -181,6 +186,7 @@ class SimCluster:
         except asyncio.CancelledError:
             pass
         node.store.close()
+        self._harvest_flows(node)
         if node.tel is not None and node.tel.journal is not None:
             node.tel.journal.close()
         k = max(0, int(torn_bytes))
@@ -219,8 +225,20 @@ class SimCluster:
             except asyncio.CancelledError:
                 pass
             node.store.close()
+            self._harvest_flows(node)
             if node.tel is not None and node.tel.journal is not None:
                 node.tel.journal.close()
+
+    def _harvest_flows(self, node: SimNode) -> None:
+        """Snapshot the node's flow table at teardown (one entry per
+        boot — the accountant is rebuilt on restart)."""
+        tel = node.tel
+        flows = getattr(tel, "flows", None) if tel is not None else None
+        if flows is None or not flows.enabled:
+            return
+        self.flow_tables.setdefault(str(node.pk)[:8], []).append(
+            flows.table()
+        )
 
     # -- schedule execution ---------------------------------------------
 

@@ -75,6 +75,12 @@ class SimVerdict:
     #: flight-recorder journals; None when the run committed nothing.
     #: Deterministic per seed — virtual clocks stamp the journals.
     attribution: dict | None = None
+    #: wire-level flow tables per node short-name, one table per boot
+    #: (telemetry/flows.py ``table()``): integer byte ledgers driven
+    #: entirely by virtual-time scheduling, so a same-seed double-run
+    #: must reproduce them byte-for-byte (tests/test_flows.py).  None
+    #: when accounting is disabled.
+    flows: dict | None = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -338,6 +344,7 @@ def run_schedule(schedule: dict, workdir: str | None = None) -> SimVerdict:
         threats=threats,
         timeouts=timeouts,
         attribution=attribution,
+        flows=cluster.flow_tables or None,
     )
 
 

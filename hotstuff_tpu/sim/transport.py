@@ -133,8 +133,15 @@ class SimReceiver(Receiver):
     loop, fault-plane inbound cut and handler dispatch are inherited
     verbatim; only listen/accept/teardown differ."""
 
-    def __init__(self, host, port, handler, fault_plane=None, net=None):
-        super().__init__(host, port, handler, fault_plane=fault_plane)
+    def __init__(
+        self, host, port, handler, fault_plane=None, flows=None, net=None
+    ):
+        # flow accounting inherits the production rx charge site;
+        # server-side peernames are ("sim-client", n) so receive flows
+        # attribute to the deterministic "sim-client" label
+        super().__init__(
+            host, port, handler, fault_plane=fault_plane, flows=flows
+        )
         self._net = net if net is not None else current_net()
         # dict-as-ordered-set: teardown cancels handlers in accept
         # order (determinism contract — no id()-ordered iteration)

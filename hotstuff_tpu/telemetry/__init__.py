@@ -52,10 +52,12 @@ _NODES: dict[str, "NodeTelemetry"] = {}
 _FORCED = False
 _JOURNAL_DIR: str | None = None  # forced via --journal-dir
 
-#: committees at or below this size get per-PEER network gauges
-#: (``net.peer.<name>.*``) in addition to the per-role ones — the label
-#: cardinality is bounded (<= 8 peers x 4 senders) and small committees
-#: are exactly where per-peer attribution is readable
+#: per-PEER network gauges (``net_peer_*``) are registered for at most
+#: this many peers per sender role — label cardinality stays bounded at
+#: any committee size.  Peers beyond the cap are NEVER silently dropped
+#: (ISSUE 19 no-silent-caps rule): a ``net_peers_elided`` gauge counts
+#: them, the snapshot's ``net.peer`` block ranks ALL peers by flow
+#: bytes and shows the top-K, and byte totals always cover everyone.
 PEER_GAUGE_MAX_COMMITTEE = 8
 
 
@@ -211,6 +213,7 @@ class NodeTelemetry:
         self.trace = TraceRecorder(self.registry, self.labels)
         self.workstats = None  # utils.workstats.WorkStats, attached by Node
         self.journal = None  # telemetry.journal.Journal, attached by Node
+        self.flows = None  # telemetry.flows.FlowAccounting, attached by Node
         self._sections: dict[str, Callable[[], dict]] = {}
         self._senders: list[tuple[str, object]] = []
         # peer short-name -> [(sender, address)]: feeds the per-peer
@@ -246,6 +249,31 @@ class NodeTelemetry:
         self.journal = journal
         self.add_section("journal", journal.stats)
 
+    def attach_flows(self, flows) -> None:
+        """Attach the node's wire-level flow accountant
+        (telemetry/flows.py): snapshot section, /metrics byte gauges,
+        and the sampled ``net.tx``/``net.rx`` journal records."""
+        self.flows = flows
+        flows.bind_journal(lambda: self.journal)
+        self.add_section("flows", flows.snapshot)
+        if not flows.enabled:
+            return
+        self.gauge(
+            "net_tx_bytes",
+            "Wire bytes written across all links (frames + prefixes)",
+            fn=flows.tx_bytes,
+        )
+        self.gauge(
+            "net_rx_bytes",
+            "Wire bytes read across all links (frames + prefixes)",
+            fn=flows.rx_bytes,
+        )
+        self.gauge(
+            "net_retx_bytes",
+            "Wire bytes retransmitted by reliable links (subset of tx)",
+            fn=flows.retx_bytes,
+        )
+
     def add_section(self, name: str, fn: Callable[[], dict]) -> None:
         self._sections[name] = fn
 
@@ -264,10 +292,11 @@ class NodeTelemetry:
         from evicted connections age out with them (live-peer view).
 
         ``peers``: optional [(public key, address)] of this sender's
-        live peers — when given (committee size <=
-        PEER_GAUGE_MAX_COMMITTEE, wired by Consensus.spawn), per-PEER
-        gauges are exported under ``net_peer_*`` in /metrics and a
-        ``net.peer.<name>.*`` block appears in the snapshot."""
+        live peers (wired by Consensus.spawn at EVERY committee size) —
+        per-PEER gauges are exported under ``net_peer_*`` in /metrics
+        for the first PEER_GAUGE_MAX_COMMITTEE peers, the rest counted
+        by ``net_peers_elided`` (never silently dropped), and a ranked
+        ``net.peer`` block appears in the snapshot."""
         self._senders.append((role, sender))
         labels = {**self.labels, "role": role}
         reg = self.registry
@@ -330,8 +359,25 @@ class NodeTelemetry:
             + getattr(s, "jittered_retries", 0),
         )
         if peers:
-            for peer_name, address in peers:
+            peers = list(peers)
+            reg.gauge(
+                "net_peers_elided",
+                "Peers beyond the per-peer gauge cap (still fully "
+                "counted in flow totals and the ranked snapshot block)",
+                labels,
+                fn=lambda n=max(
+                    0, len(peers) - PEER_GAUGE_MAX_COMMITTEE
+                ): n,
+            )
+            for peer_name, address in peers[:PEER_GAUGE_MAX_COMMITTEE]:
                 self._register_peer(role, sender, peer_name, address)
+            # beyond the gauge cap: no registry instruments, but the
+            # snapshot's ranked peer block still tracks the connection
+            for peer_name, address in peers[PEER_GAUGE_MAX_COMMITTEE:]:
+                short = str(peer_name)[:8]
+                self._peer_conns.setdefault(short, []).append(
+                    (sender, address)
+                )
 
     def _register_peer(self, role: str, sender, peer_name, address) -> None:
         """Per-peer gauges over one sender's connection to ``address``.
@@ -411,10 +457,21 @@ class NodeTelemetry:
                 entry["pacing_stalls"] = s.pacing_stalls
             out[role] = entry
         if self._peer_conns:
+            # rank by flow bytes when the accountant is attached so the
+            # top-K block shows the peers that actually matter; the
+            # rest are an explicit count, never a silent drop
+            shorts = list(self._peer_conns)
+            flow_bytes: dict[str, int] = {}
+            if self.flows is not None and self.flows.enabled:
+                flow_bytes = {
+                    p: tx + rx for p, tx, rx in self.flows.peer_totals()
+                }
+                shorts.sort(key=lambda s: (-flow_bytes.get(s, 0), s))
+            shown = shorts[:PEER_GAUGE_MAX_COMMITTEE]
             peer_out = {}
-            for short, conns in self._peer_conns.items():
+            for short in shown:
                 queued = failures = retrying = 0
-                for sender, address in conns:
+                for sender, address in self._peer_conns[short]:
                     c = getattr(sender, "_connections", {}).get(address)
                     if c is None:
                         continue
@@ -429,7 +486,10 @@ class NodeTelemetry:
                     "retrying": retrying,
                     "connect_failures": failures,
                 }
+                if short in flow_bytes:
+                    peer_out[short]["bytes"] = flow_bytes[short]
             out["peer"] = peer_out
+            out["peers_elided"] = len(shorts) - len(shown)
         return out
 
     def snapshot(self) -> dict:

@@ -63,6 +63,7 @@ HEALTH_KINDS: tuple = (
     "root_divergence",
     "epoch_skew",
     "crit_regime_shift",
+    "bandwidth_storm",
 )
 
 # ---- delta-frame wire format ----------------------------------------------
@@ -295,6 +296,42 @@ def view_change_storm(
             "warn",
             f"TC rate {r:.2f}/s vs baseline {baseline_ewma:.2f}/s "
             f"(x{factor:g} threshold)",
+            r,
+        )
+        return inc, baseline_ewma
+    return None, (1.0 - alpha) * baseline_ewma + alpha * r
+
+
+def bandwidth_storm(
+    egress_samples,
+    baseline_ewma: float | None,
+    alpha: float = 0.3,
+    factor: float = 4.0,
+    min_rate: float = 65536.0,
+    node: str = "",
+) -> tuple:
+    """Wire egress rate above the EWMA baseline (ISSUE 19):
+    ``(incident | None, new ewma)``.
+
+    ``egress_samples``: ``(t, net_tx_bytes total)`` from the node's flow
+    accountant.  Same EWMA discipline as :func:`view_change_storm`: the
+    first observed rate seeds the baseline and only quiet ticks are
+    absorbed, so a retransmit or equivocation storm cannot normalize
+    itself.  ``min_rate`` (bytes/s) floors the trigger so a chatty-idle
+    committee never pages on its own heartbeat traffic.
+    """
+    r = rate(egress_samples)
+    if r is None:
+        return None, baseline_ewma
+    if baseline_ewma is None:
+        return None, r
+    if r >= min_rate and r > factor * baseline_ewma:
+        inc = Incident(
+            "bandwidth_storm",
+            node,
+            "warn",
+            f"wire egress {r / 1e3:.0f} kB/s vs baseline "
+            f"{baseline_ewma / 1e3:.0f} kB/s (x{factor:g} threshold)",
             r,
         )
         return inc, baseline_ewma
@@ -581,7 +618,8 @@ class HealthMonitor:
 
     Samples the node's own telemetry snapshot once per ``interval_s``,
     feeds the node-local detectors (leader-stall via commit progress,
-    view-change storm, commit collapse, shed storm), and turns firings
+    view-change storm, commit collapse, shed storm, bandwidth storm),
+    and turns firings
     into incident records on three surfaces at once: a
     ``health.<kind>`` open/close journal edge pair (the Perfetto
     incidents track), a ``Health incident: {json}`` log line (the
@@ -609,7 +647,9 @@ class HealthMonitor:
         self._w_commits = Window(span_s=span)
         self._w_tcs = Window(span_s=span)
         self._w_shed = Window(span_s=span)
+        self._w_net = Window(span_s=span)
         self._tc_ewma: float | None = None
+        self._net_ewma: float | None = None
         # rolling commit critical-path attribution: ``attribution_fn``
         # (wired by the node from telemetry.critpath.rolling_attribution
         # over the trace ring — this module stays import-free) returns
@@ -643,13 +683,17 @@ class HealthMonitor:
         trace = snap.get("trace", {}) or {}
         ingest = snap.get("ingest", {}) or {}
         state = snap.get("state", {}) or {}
+        flows = snap.get("flows", {}) or {}
         commits = float(trace.get("commits", 0) or 0)
         tcs = float(trace.get("tc_advances", 0) or 0)
         shed = float(ingest.get("shed_total", 0) or 0)
+        net_tx = float(flows.get("tx_bytes", 0) or 0)
         round_ = int(trace.get("last_commit_round", 0) or 0)
         self._w_commits.push(now, commits)
         self._w_tcs.push(now, tcs)
         self._w_shed.push(now, shed)
+        if flows.get("enabled"):
+            self._w_net.push(now, net_tx)
 
         fired = []
         inc = leader_stall(
@@ -670,6 +714,11 @@ class HealthMonitor:
         if inc:
             fired.append(inc)
         inc = shed_storm(self._w_shed.samples(), node=self.node)
+        if inc:
+            fired.append(inc)
+        inc, self._net_ewma = bandwidth_storm(
+            self._w_net.samples(), self._net_ewma, node=self.node
+        )
         if inc:
             fired.append(inc)
         if self._attribution_fn is not None:
@@ -697,6 +746,7 @@ class HealthMonitor:
                 "commits": commits,
                 "tcs": tcs,
                 "shed": shed,
+                "net_tx": net_tx,
                 "credit": ingest.get("last_credit", 0),
                 "version": state.get("version", 0),
                 "incidents": len(self._open),
@@ -755,6 +805,7 @@ __all__ = [
     "Incident",
     "leader_stall",
     "view_change_storm",
+    "bandwidth_storm",
     "commit_collapse",
     "straggler",
     "shed_storm",

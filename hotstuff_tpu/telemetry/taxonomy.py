@@ -134,12 +134,42 @@ BYZ_PREFIX = "byz."
 INGEST_PREFIX = "ingest."
 HEALTH_PREFIX = "health."
 RECONFIG_PREFIX = "reconfig."
+NET_PREFIX = "net."
 JOURNAL_EDGE_PREFIXES: tuple = (
     FAULT_PREFIX,
     BYZ_PREFIX,
     HEALTH_PREFIX,
     RECONFIG_PREFIX,
+    NET_PREFIX,
 )
+
+# ---- wire-level flow classes (telemetry/flows.py) --------------------------
+
+#: every message class the flow accounting plane charges a frame to —
+#: derived from the wire-tag taxonomy (consensus/wire.py first byte;
+#: ``telemetry/flows.py`` owns the byte->class map, and
+#: ``tests/test_flows.py`` cross-checks it against the live wire
+#: constants so tag drift is a test failure, not a silently-mislabelled
+#: flow).  ``qc-compact`` wire cost rides inside ``propose`` frames and
+#: is reported from the aggregator telemetry next to these classes.
+FLOW_CLASSES: tuple = (
+    "propose",
+    "vote",
+    "timeout",
+    "tc",
+    "sync-req",
+    "producer-v1",
+    "producer-v2",
+    "ingest-ack",
+    "state-sync",
+    "reconfig",
+    "ack",
+    "other",
+)
+
+#: flow directions: every accounted frame is charged to exactly one
+#: ``(peer, direction, class)`` flow at its send and its receive site
+FLOW_DIRECTIONS: tuple = ("tx", "rx")
 
 #: every registered static journal edge name (what ``journal.record``
 #: call sites are checked against)
@@ -204,6 +234,9 @@ __all__ = [
     "INGEST_PREFIX",
     "HEALTH_PREFIX",
     "RECONFIG_PREFIX",
+    "NET_PREFIX",
+    "FLOW_CLASSES",
+    "FLOW_DIRECTIONS",
     "JOURNAL_EDGE_PREFIXES",
     "JOURNAL_EDGES",
     "CRITPATH_STAGES",

@@ -99,6 +99,16 @@ struct Reactor {
   std::thread thread;
   std::atomic<bool> running{false};
 
+  // Wire-level flow accounting (ISSUE 19): cumulative counters over
+  // every socket the reactor owns, read back via ht_counters.  tx_bytes
+  // counts bytes ::send actually accepted (length prefixes included);
+  // rx_bytes counts 4+len per extracted frame; tx_frames counts frames
+  // framed into an outbox (a best-effort drop of a queued frame on
+  // disconnect can leave tx_bytes below tx_frames' framed total).
+  // Atomics: bumped on the reactor thread, read from Python threads.
+  std::atomic<unsigned long long> tx_bytes{0}, tx_frames{0};
+  std::atomic<unsigned long long> rx_bytes{0}, rx_frames{0};
+
   std::mutex mu;  // guards events, conns map mutation, outboxes, next_id
   std::deque<Event> events;
   std::map<long, Conn> conns;
@@ -248,6 +258,8 @@ struct Reactor {
         while (!c.wbuf.empty()) {
           ssize_t n = ::send(c.fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
           if (n > 0) {
+            tx_bytes.fetch_add(static_cast<unsigned long long>(n),
+                               std::memory_order_relaxed);
             c.wbuf.erase(0, static_cast<size_t>(n));
             flush_outbox_locked(c);
           } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
@@ -305,6 +317,8 @@ struct Reactor {
               } else if (r.size() >= 4 + len) {
                 payload = r.substr(4, len);
                 r.erase(0, 4 + static_cast<size_t>(len));
+                rx_bytes.fetch_add(4ull + len, std::memory_order_relaxed);
+                rx_frames.fetch_add(1, std::memory_order_relaxed);
                 have = true;
               }
             }
@@ -542,6 +556,7 @@ int ht_send(void* rp, long peer, const uint8_t* data, int len) {
     std::string framed;
     frame_into(framed, data, len);
     it->second.outbox.push_back(std::move(framed));
+    r->tx_frames.fetch_add(1, std::memory_order_relaxed);
   }
   char b = 1;
   (void)!write(r->wake_w, &b, 1);
@@ -568,10 +583,22 @@ int ht_reply(void* rp, long conn, const uint8_t* data, int len) {
     std::string framed;
     frame_into(framed, data, len);
     it->second.outbox.push_back(std::move(framed));
+    r->tx_frames.fetch_add(1, std::memory_order_relaxed);
   }
   char b = 1;
   (void)!write(r->wake_w, &b, 1);
   return 0;
+}
+
+// Cumulative wire counters (ISSUE 19): out[0]=tx_bytes (accepted by
+// ::send, prefixes included), out[1]=tx_frames (framed into outboxes),
+// out[2]=rx_bytes (4+len per extracted frame), out[3]=rx_frames.
+void ht_counters(void* rp, unsigned long long out[4]) {
+  auto* r = static_cast<Reactor*>(rp);
+  out[0] = r->tx_bytes.load(std::memory_order_relaxed);
+  out[1] = r->tx_frames.load(std::memory_order_relaxed);
+  out[2] = r->rx_bytes.load(std::memory_order_relaxed);
+  out[3] = r->rx_frames.load(std::memory_order_relaxed);
 }
 
 // Drain one event.  Returns payload length (>= 0) with *src/*kind set,

@@ -29,6 +29,11 @@ non-zero when either guarded metric regresses past the threshold
   * ``adapt.schedules_per_min`` / ``adapt.fitness_evals_per_s`` —
     adaptive-adversary guided-search throughput (ISSUE 18; wide
     per-guard 50% gates, skip-if-missing)
+  * ``net.leader_amp_p50`` / ``net.wire_bytes_per_commit`` —
+    wire-level flow accounting rollup: median propose-amplification
+    factor (gated in both directions — a fall means lost charges, a
+    rise means redundant sends) and committee wire egress per commit
+    (ISSUE 19; wide per-guard 50% gates, skip-if-missing)
 
 ``tunnel_dispatch_p50_ms`` is gated as a RATCHET instead of a guard
 (ISSUE 6): the fresh value must stay within ``--ratchet-slack``
@@ -197,6 +202,31 @@ GUARDS = (
         "adapt.fitness_evals_per_s",
         lambda doc: (doc.get("adapt") or {}).get("fitness_evals_per_s"),
         -1,
+        0.5,
+    ),
+    # wire-level flow accounting (ISSUE 19): the median per-node
+    # propose-amplification factor (wire/logical egress; exactly n-1
+    # when every proposal is one broadcast — a FALL means charges went
+    # missing, a RISE means redundant sends crept in, both regressions,
+    # so the amp guard gates in both directions via two entries) and the
+    # committee's wire egress per committed block.  Skip-if-missing
+    # covers references from before the net block existed.
+    (
+        "net.leader_amp_p50",
+        lambda doc: (doc.get("net") or {}).get("leader_amp_p50"),
+        +1,
+        0.5,
+    ),
+    (
+        "net.leader_amp_p50 (floor)",
+        lambda doc: (doc.get("net") or {}).get("leader_amp_p50"),
+        -1,
+        0.5,
+    ),
+    (
+        "net.wire_bytes_per_commit",
+        lambda doc: (doc.get("net") or {}).get("wire_bytes_per_commit"),
+        +1,
         0.5,
     ),
 )

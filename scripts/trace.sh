@@ -90,6 +90,17 @@
 #                                 # stage-share regression, and the
 #                                 # regime classification is stable
 #                                 # across two identical runs
+#   NET=1 scripts/trace.sh        # ONLY the wire-level flow accounting
+#                                 # check (scripts/net_check.py): a
+#                                 # 4-node run must print + NET with
+#                                 # propose amplification ~ n-1, class
+#                                 # shares covering >= 95% of egress,
+#                                 # compact QCs beating the vote list
+#                                 # on the wire and zero clean-link
+#                                 # retransmits; same-seed sim runs
+#                                 # must produce byte-identical flow
+#                                 # tables and amp stays sane under
+#                                 # flapping-link chaos
 #   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
 #                                 # (scripts/analysis_check.py): every
 #                                 # hotstuff_tpu/analysis lint rule,
@@ -154,6 +165,11 @@ fi
 if [ "${CRIT:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/critpath_check.py "$@"
+fi
+
+if [ "${NET:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/net_check.py "$@"
 fi
 
 if [ "${LINT:-0}" = "1" ]; then
