@@ -71,6 +71,7 @@ RE_VERIFY_STATS = re.compile(
     r"(?:mesh=(\d+) )?"
     r"(?:agg=(\d+) agg_sigs=(\d+) )?"
     r"ewma_ms=([\d.]+)"
+    r"(?: zc=(\d+) fb=(\d+))?"
 )
 # periodic per-node telemetry snapshot (telemetry/exporter.py) — a
 # cumulative JSON document superseding 'Work stats:'; keep the LAST
@@ -144,13 +145,14 @@ class LogParser:
         for log_idx, content in enumerate(node_logs):
             for (
                 tag, disp, dev, cpu, probe, dsig, csig, miss, waits,
-                depth, mesh, agg, agg_sigs, ewma,
+                depth, mesh, agg, agg_sigs, ewma, zc, fb,
             ) in RE_VERIFY_STATS.findall(content):
                 per_tag[(log_idx, tag)] = (
                     int(disp), int(dsig), int(csig), int(miss),
                     float(ewma), int(dev), int(cpu or 0), int(probe or 0),
                     int(waits or 0), int(depth or 1), int(mesh or 0),
                     int(agg or 0), int(agg_sigs or 0),
+                    int(zc or 0), int(fb or 0),
                 )
         self.device_sigs = sum(v[1] for v in per_tag.values())
         self.cpu_route_sigs = sum(v[2] for v in per_tag.values())
@@ -180,6 +182,12 @@ class LogParser:
         # certificates stood in for
         self.agg_claims = sum(v[11] for v in per_tag.values())
         self.agg_claim_sigs = sum(v[12] for v in per_tag.values())
+        # zero-copy ingest split (ISSUE 20): waves adopted straight
+        # from a native staging arena vs. vote-overlapping waves that
+        # fell back to the Python flatten path; pre-ingest logs omit
+        # the zc=/fb= suffix and read as 0/0 (hit rate renders as '-')
+        self.zero_copy_waves = sum(v[13] for v in per_tag.values())
+        self.ingest_fallback_waves = sum(v[14] for v in per_tag.values())
 
         # telemetry snapshots (cumulative): last document per node log
         import json as _json
@@ -531,6 +539,17 @@ class LogParser:
             out += (
                 f" Verify route waves: {shares} of {waves:,}"
                 f" (queued {self.pipeline_waits}{depth})\n"
+            )
+        # zero-copy ingest hit rate (ISSUE 20): of the waves that
+        # touched the native staging arenas, how many were adopted
+        # without the Python flatten hop
+        zc_total = self.zero_copy_waves + self.ingest_fallback_waves
+        if zc_total:
+            out += (
+                f" Verify zero-copy ingest: {self.zero_copy_waves:,} of"
+                f" {zc_total:,} vote waves adopted"
+                f" ({100.0 * self.zero_copy_waves / zc_total:.0f}%"
+                f" hit rate)\n"
             )
         # aggregate-certificate route (ISSUE 9): compact QCs/TCs served
         # by one pairing each instead of per-signature batches

@@ -125,6 +125,10 @@ def run_scaling(
                     # flapping is visible per rate in the SUMMARY
                     "route_waves": dict(parser.route_waves),
                     "pipeline_waits": parser.pipeline_waits,
+                    # zero-copy ingest split (ISSUE 20): arena-adopted
+                    # waves vs. flatten fallbacks on vote waves
+                    "zero_copy_waves": parser.zero_copy_waves,
+                    "ingest_fallback_waves": parser.ingest_fallback_waves,
                     # compact-certificate columns (ISSUE 9): last emitted
                     # QC wire size plus how many certificates took the
                     # aggregate one-pairing route
@@ -176,7 +180,7 @@ def format_report(
         "",
         f"{'nodes':>6} {'epoch':>5} {'tps':>7} {'lat ms':>7} {'sigs/s':>8} "
         f"{'crypto s':>9} {'lag ms':>7} {'c us':>7} {'route d/c/p/m':>13} "
-        f"{'qc B':>6} {'agg':>5} {'shed':>6} {'dropN':>5} "
+        f"{'zc%':>4} {'qc B':>6} {'agg':>5} {'shed':>6} {'dropN':>5} "
         f"{'net MB':>7} {'amp':>5} {'pred 1-core/node':>17}",
     ]
     for r in rows:
@@ -197,6 +201,9 @@ def format_report(
             )
         else:
             route = "-"
+        zc = r.get("zero_copy_waves", 0)
+        zc_total = zc + r.get("ingest_fallback_waves", 0)
+        zc_txt = f"{100 * zc // zc_total}" if zc_total else "-"
         qc_bytes = r.get("qc_bytes", 0)
         qc_txt = f"{qc_bytes}" if qc_bytes else "-"
         agg_claims = r.get("agg_claims", 0)
@@ -214,7 +221,7 @@ def format_report(
             f"{r['tps']:>7.0f} {r['latency_ms']:>7.0f} "
             f"{sig_rate:>8.0f} {r['verify_wall_s']:>9.2f} "
             f"{r['loop_lag_mean_ms']:>7.2f} {c_us:>7.0f} {route:>13} "
-            f"{qc_txt:>6} {agg_txt:>5} {shed_txt:>6} {drops_txt:>5} "
+            f"{zc_txt:>4} {qc_txt:>6} {agg_txt:>5} {shed_txt:>6} {drops_txt:>5} "
             f"{net_txt:>7} {amp_txt:>5} {predicted:>17.0f}"
         )
     lines += [
@@ -236,6 +243,10 @@ def format_report(
         "visible as lag >> 1 ms;",
         "- c us: measured per-(node, payload) protocol cost = "
         "window / (payloads x nodes) core-microseconds;",
+        "- zc%: zero-copy ingest hit rate — vote waves the verify "
+        "service adopted straight from a native staging arena as a "
+        "share of arena-touching waves (adopted + flatten fallbacks; "
+        "'-' for non-native transports or pre-ingest logs);",
         "- qc B / agg: last emitted QC's wire size and certificates "
         "served by the aggregate one-pairing route (BLS compact form: "
         "48 B agg sig + ceil(n/8) B signer bitmap vs n x 144 B vote "

@@ -374,6 +374,65 @@ class ConsensusReceiverHandler:
         else:
             await self.tx_consensus.put((tag, payload))
 
+    async def dispatch_producer_v2(
+        self, writer: Writer, frame: bytes, digests: bytes, spans: list
+    ) -> None:
+        """Zero-copy ingest fast path for batched producer frames
+        (ISSUE 20): the native parser already validated wire bounds and
+        emitted the digest column plus ``(offset, length)`` body windows
+        into ``frame``, so this mirrors the TAG_PRODUCER_V2 branch of
+        ``dispatch`` without building per-item payload tuples — bodies
+        stay memoryview windows and only ACCEPTED items materialize
+        bytes for the body store.  Wire parity with the Python Decoder
+        is enforced by the differential fuzz corpus
+        (tests/test_wire_fuzz.py); any frame the native parser rejects
+        takes the normal decode path instead of this one."""
+        from ..crypto import Digest
+
+        if self._msg_counters is not None and TAG_PRODUCER_V2 < len(
+            self._msg_counters
+        ):
+            self._msg_counters[TAG_PRODUCER_V2].inc()
+        mv = memoryview(frame)
+        j = self._journal
+        if j is not None and spans:
+            # sampled: the batch's first digest stands for the frame
+            j.record("recv.producer", 0, Digest(bytes(digests[:32])), "client")
+        valid = []
+        for i, (off, ln) in enumerate(spans):
+            digest = Digest(bytes(digests[i * 32 : (i + 1) * 32]))
+            body = mv[off : off + ln]
+            if ln and Digest.of(body) != digest:
+                log.warning(
+                    "Dropping batched producer payload whose body "
+                    "does not match its digest"
+                )
+                if self._dropped is not None:
+                    self._dropped.inc()
+                continue
+            valid.append((digest, body))
+        if self.admission is not None:
+            decision = self.admission.admit(len(valid))
+        else:
+            from ..ingest import Decision
+
+            decision = Decision(len(valid), 0, 0, 0)
+        for digest, body in valid[: decision.accepted]:
+            if len(body) and self.bodies is not None:
+                await self.bodies.admit(digest, bytes(body))
+            await self.tx_producer.put(digest)
+        try:
+            await writer.send(
+                encode_ingest_ack(
+                    decision.accepted,
+                    decision.shed,
+                    decision.credit,
+                    decision.retry_after_ms,
+                )
+            )
+        except (ConnectionError, OSError):
+            pass
+
     async def _serve_state_read(self, writer: Writer, payload) -> None:
         """QC-anchored stale read: answer at the last applied version —
         by construction while catching up, too — with the anchor

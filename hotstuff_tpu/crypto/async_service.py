@@ -212,23 +212,38 @@ def claim_sig_count(c) -> int:
 
 def flatten_claims(claims: list) -> tuple[list, list, list, list]:
     """Claims -> (digests, pks, sigs, spans); spans[i] = (start, end)
-    slice of the flat arrays belonging to claims[i]."""
-    digests: list[bytes] = []
-    pks: list[bytes] = []
-    sigs: list[bytes] = []
-    spans: list[tuple[int, int]] = []
+    slice of the flat arrays belonging to claims[i].
+
+    This is the Python fallback for transports without the native
+    zero-copy ingest plane (ISSUE 20) — kept allocation-lean: the
+    column lists are preallocated at their final length in one sizing
+    pass and filled by index, so the hot loop never grows a list or
+    re-reads ``len`` per claim (measured as the ``flatten`` p50 in
+    ``benchmark profile``)."""
+    n_claims = len(claims)
+    spans: list = [None] * n_claims
+    total = 0
+    for i, claim in enumerate(claims):
+        k = 1 if claim[0] == "one" else len(claim[2])
+        spans[i] = (total, total + k)
+        total += k
+    digests: list = [None] * total
+    pks: list = [None] * total
+    sigs: list = [None] * total
+    pos = 0
     for claim in claims:
-        start = len(digests)
         if claim[0] == "one":
-            digests.append(claim[1])
-            pks.append(claim[2])
-            sigs.append(claim[3])
+            digests[pos] = claim[1]
+            pks[pos] = claim[2]
+            sigs[pos] = claim[3]
+            pos += 1
         else:  # "shared"
+            d = claim[1]
             for pk, sig in claim[2]:
-                digests.append(claim[1])
-                pks.append(pk)
-                sigs.append(sig)
-        spans.append((start, len(digests)))
+                digests[pos] = d
+                pks[pos] = pk
+                sigs[pos] = sig
+                pos += 1
     return digests, pks, sigs, spans
 
 
@@ -342,6 +357,309 @@ def eval_claims_sync(backend, claims: list) -> list[bool]:
             return [e > s for s, e in spans]
     ok = backend.verify_many(digests, pks, sigs)
     return [all(ok[s:e]) if e > s else False for s, e in spans]
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy wire -> device ingest (ISSUE 20)
+#
+# With the native transport, vote frames are parsed and packed IN C++
+# (native/wave_pack.cpp) straight into bucket-shaped staging arenas at
+# the reactor's read path.  When a dispatch wave's claim stream turns
+# out to be exactly the packed arena prefix (receive order == claim
+# submission order on a single-node transport), the service ADOPTS the
+# arena — flatten/prepare become NumPy frombuffer views over memory the
+# native parser already filled — instead of walking Python claim
+# objects.  Adoption is an exact byte-level match; ANY divergence
+# (deduped duplicates, stake/lookahead-dropped votes, mixed QC+vote
+# waves, co-located multi-node dedup) falls back to flatten_claims.
+# The arena is an accelerator, never a correctness dependency.
+# ---------------------------------------------------------------------------
+
+#: wire tag of a vote frame (consensus/wire.py TAG_VOTE).  Hardcoded —
+#: importing consensus.wire here would cycle (wire imports crypto);
+#: tests/test_wire_fuzz.py asserts this constant against the live one.
+INGEST_TAG_VOTE = 1
+
+DEFAULT_INGEST_RING_DEPTH = 6
+
+
+def zero_copy_from_env() -> bool:
+    """HOTSTUFF_ZERO_COPY: "0"/"off" disables the native ingest-arena
+    fast path; default on (subject to native-packer availability)."""
+    import os
+
+    raw = os.environ.get("HOTSTUFF_ZERO_COPY", "").strip().lower()
+    return raw not in ("0", "off", "no", "false", "none")
+
+
+def ingest_arena_rows_from_env() -> int:
+    """HOTSTUFF_INGEST_ARENA_ROWS: staging-arena capacity in claim rows;
+    default = the largest canonical wave bucket, so every bucket-shaped
+    wave is a prefix view of one arena."""
+    import os
+
+    raw = os.environ.get("HOTSTUFF_INGEST_ARENA_ROWS", "")
+    try:
+        rows = int(raw)
+    except ValueError:
+        rows = 0
+    return rows if rows > 0 else DEFAULT_WAVE_BUCKETS[-1]
+
+
+def ingest_ring_from_env() -> int:
+    """HOTSTUFF_INGEST_RING: staging arenas in the native ring (min 2:
+    one open for packing while sealed ones are in flight); default 6 —
+    pipeline depth 2, a probe, and headroom before pack falls back."""
+    import os
+
+    raw = os.environ.get("HOTSTUFF_INGEST_RING", "")
+    try:
+        depth = int(raw)
+    except ValueError:
+        depth = 0
+    return depth if depth >= 2 else DEFAULT_INGEST_RING_DEPTH
+
+
+_pad_claim_cached: tuple | None = None
+
+
+def make_pad_claim() -> tuple:
+    """The deterministic filler claim for fixed-shape padding: one VALID
+    self-contained ed25519 signature over a reserved digest.  Shared by
+    the service's Python packing (_pack_wave) and the native ingest
+    arenas (wp_set_pad pre-fills every arena row with it), so an
+    adopted wave's pad rows are byte-identical to Python-padded ones."""
+    global _pad_claim_cached
+    if _pad_claim_cached is None:
+        from .digest import Digest
+        from .keys import generate_keypair
+        from .signature import Signature
+
+        pk, sk = generate_keypair(b"\xa5" * 32, 0xFFFF)
+        digest = Digest.of(b"hotstuff_tpu wave pad claim v1")
+        sig = Signature.new(digest, sk)
+        _pad_claim_cached = (
+            "one", digest.to_bytes(), pk.to_bytes(), sig.to_bytes()
+        )
+    return _pad_claim_cached
+
+
+class AdoptedWave:
+    """A sealed native staging arena adopted as one verification wave:
+    ``n`` real claim rows followed by valid pad rows up to ``rows`` (the
+    wave bucket).  The column views die when ``release`` recycles the
+    arena — every consumer releases in a ``finally``."""
+
+    __slots__ = (
+        "ingest", "arena", "n", "rows",
+        "dig", "pk", "sig", "dig_addr", "pk_addr", "sig_addr",
+        "_released",
+    )
+
+    def __init__(self, ingest, arena: int, n: int, rows: int, info):
+        from .native_ed25519 import column_view
+
+        self.ingest = ingest
+        self.arena = arena
+        self.n = n
+        self.rows = rows
+        self.dig_addr, self.pk_addr, self.sig_addr = info[0], info[1], info[2]
+        self.dig = column_view(self.dig_addr, rows * 32)
+        self.pk = column_view(self.pk_addr, rows * 32)
+        self.sig = column_view(self.sig_addr, rows * 64)
+        self._released = False
+
+    def release(self) -> None:
+        """Recycle the arena (idempotent; runs on verifier slot threads
+        — the native mutex serializes with event-loop packing)."""
+        if not self._released:
+            self._released = True
+            self.ingest.packer.recycle(self.arena)
+
+
+class ZeroCopyIngest:
+    """Process-global zero-copy ingest plane: owns the native arena
+    ring and the Python-side key mirror that proves adoption safety.
+
+    ``note_vote_frame`` (event loop, receiver path) packs each vote's
+    digest/pk/sig columns natively and mirrors the claim KEY (the exact
+    bytes ``Vote.claim()`` would produce).  ``try_adopt`` (event loop,
+    dispatcher) hands the arena over iff the wave's claims are exactly
+    the packed key prefix — verdicts bind positionally downstream, so
+    the match must be exact, and the mirror makes it checkable without
+    decoding anything twice."""
+
+    def __init__(
+        self, capacity: int | None = None, ring_depth: int | None = None
+    ):
+        from .native_ed25519 import WavePacker
+
+        cap = capacity if capacity else ingest_arena_rows_from_env()
+        depth = ring_depth if ring_depth else ingest_ring_from_env()
+        self.packer = WavePacker(cap, depth)
+        pad = make_pad_claim()
+        if not self.packer.set_pad(pad[1], pad[2], pad[3]):
+            raise RuntimeError("wave packer pad install failed")
+        self._keys: list[tuple] = []
+        self.packed_votes = 0
+        self.zero_copy_waves = 0
+        self.fallback_waves = 0
+
+    @property
+    def active(self) -> bool:
+        """Any packed votes pending adoption?  The dispatcher skips the
+        adoption attempt entirely when nothing was packed (sim/asyncio
+        transports, non-vote traffic)."""
+        return bool(self._keys)
+
+    def note_vote_frame(self, frame: bytes) -> bool:
+        r = self.packer.pack_vote(frame)
+        if isinstance(r, int):
+            if r == -2:
+                # open arena full: the pack stream outran adoption (an
+                # idle service, or votes that never became claims) —
+                # resync rather than wedge with a full arena forever
+                self._resync()
+            return False
+        _slot, digest = r
+        # the claim key mirrors Vote.claim(): (digest, author pk, sig) —
+        # pk/sig slices at the fixed ed25519 vote-frame offsets
+        self._keys.append((digest, frame[45:77], frame[81:145]))
+        self.packed_votes += 1
+        return True
+
+    def try_adopt(self, claims: list, buckets) -> AdoptedWave | None:
+        """Adopt the packed prefix as ``claims``' wave, or None.
+
+        On a mismatch that OVERLAPS the packed stream (a packed vote is
+        in this wave but not at its packed position: dedup, a dropped
+        vote, a mixed QC+vote wave) the open arena is discarded — those
+        rows can never line up again.  A wave fully DISJOINT from the
+        packed keys (pure QC/proposal wave between vote bursts) leaves
+        the arena untouched for the next wave."""
+        keys = self._keys
+        n = len(claims)
+        if n <= len(keys):
+            for i in range(n):
+                c = claims[i]
+                if c[0] != "one" or (c[1], c[2], c[3]) != keys[i]:
+                    break
+            else:
+                rows = next((b for b in buckets if b >= n), None)
+                if rows is None or rows > self.packer.capacity:
+                    rows = n
+                arena = self.packer.seal(n)
+                if arena is None:
+                    self._resync()
+                    return None
+                info = self.packer.arena_info(arena)
+                if info is None:  # unreachable right after seal; be safe
+                    self.packer.recycle(arena)
+                    self._resync()
+                    return None
+                del keys[:n]
+                self.zero_copy_waves += 1
+                return AdoptedWave(self, arena, n, rows, info)
+        key_set = set(keys)
+        if any(
+            c[0] == "one" and (c[1], c[2], c[3]) in key_set for c in claims
+        ):
+            self._resync()
+            self.fallback_waves += 1
+        return None
+
+    def _resync(self) -> None:
+        self.packer.discard()
+        self._keys.clear()
+
+    def counters(self) -> dict:
+        out = self.packer.counters()
+        out["zero_copy_waves"] = self.zero_copy_waves
+        out["fallback_waves"] = self.fallback_waves
+        return out
+
+
+#: None = never tried; False = disabled/unavailable (cached); else the
+#: live ZeroCopyIngest
+_zero_copy: "ZeroCopyIngest | bool | None" = None
+
+
+def zero_copy_ingest() -> "ZeroCopyIngest | None":
+    """The process-global ingest plane, created on first use by a
+    receiver; None when disabled (``HOTSTUFF_ZERO_COPY=0``) or the
+    native packer is unavailable (no toolchain — cached, never retried
+    per frame)."""
+    global _zero_copy
+    if _zero_copy is None:
+        created: ZeroCopyIngest | bool = False
+        if zero_copy_from_env():
+            from . import native_ed25519
+
+            if native_ed25519.wave_pack_available():
+                try:
+                    created = ZeroCopyIngest()
+                except Exception as e:  # noqa: BLE001 — ingest must
+                    # degrade to the Python path, never break receive
+                    log.info("zero-copy ingest unavailable: %s", e)
+        _zero_copy = created
+    return _zero_copy if type(_zero_copy) is ZeroCopyIngest else None
+
+
+def zero_copy_ingest_if_active() -> "ZeroCopyIngest | None":
+    """The ingest plane IF a receiver already created it — the
+    dispatcher-side accessor: never triggers a native build from the
+    verify path."""
+    return _zero_copy if type(_zero_copy) is ZeroCopyIngest else None
+
+
+def ingest_note_frame(frame: bytes) -> None:
+    """Receiver-side hook: feed one raw inbound frame to the zero-copy
+    plane just before handler dispatch.  Only vote frames are packed;
+    anything else is a cheap tag test.  Never raises into the receive
+    loop."""
+    if not frame or frame[0] != INGEST_TAG_VOTE:
+        return
+    ing = zero_copy_ingest()
+    if ing is not None:
+        try:
+            ing.note_vote_frame(frame)
+        except Exception:  # noqa: BLE001 — a packer bug must not kill
+            # the connection; the wave simply falls back to Python
+            log.exception("zero-copy vote pack failed")
+
+
+def eval_claims_arena(backend, wave: AdoptedWave, claims: list) -> list[bool]:
+    """Evaluate an adopted zero-copy wave: the arena columns ARE the
+    staging arrays — no flatten, no per-claim bytes.  Device backends
+    verify through ``verify_packed`` (frombuffer views over the columns
+    feed the jitted bucket callable at the pre-padded bucket shape);
+    CPU backends run ONE native batch equation straight from the column
+    addresses.  Any miss (failing batch equation -> per-item
+    attribution, backend without a packed path) falls back to
+    ``eval_claims_sync`` on the claim list.  Always releases the
+    arena."""
+    try:
+        n = wave.n
+        fn = getattr(backend, "verify_packed", None)
+        if fn is not None:
+            out = fn(wave.dig, wave.pk, wave.sig, wave.rows)
+            return [bool(v) for v in out[:n]]
+        from . import native_ed25519
+
+        if (
+            n >= NATIVE_BATCH_MIN
+            and getattr(backend, "supports_flat_batch", False)
+            and native_ed25519.available()
+        ):
+            with _spans.span("host.verify"):
+                fast_ok = native_ed25519.batch_verify_columns(
+                    wave.dig_addr, wave.pk_addr, wave.sig_addr, n
+                )
+            if fast_ok:
+                return [True] * n
+        return eval_claims_sync(backend, claims)
+    finally:
+        wave.release()
 
 
 #: every live _DispatchLoop, for interpreter-exit shutdown (satellite:
@@ -518,6 +836,12 @@ class AsyncVerifyService:
         self.deadline_misses = 0
         self.pipeline_waits = 0
         self.peak_inflight = 0
+        # zero-copy ingest plane (ISSUE 20): waves adopted straight from
+        # a native staging arena vs. vote-overlapping waves that had to
+        # fall back to the Python flatten path
+        self.zero_copy_waves = 0
+        self.zero_copy_sigs = 0
+        self.fallback_waves = 0
         self._next_stats_log = 0.0
         # Telemetry instruments (ISSUE 1), labelled by the service tag.
         # All None when telemetry is off — every hot-path touch below is
@@ -529,6 +853,8 @@ class AsyncVerifyService:
         self._tel_device_wall = None
         self._tel_host_wall = None
         self._tel_route = None
+        self._tel_zero_copy = None
+        self._tel_fallback = None
         from .. import telemetry
 
         if telemetry.enabled():
@@ -571,6 +897,16 @@ class AsyncVerifyService:
                 )
                 for r in ("device", "mesh", "cpu", "probe", "wait")
             }
+            self._tel_zero_copy = reg.counter(
+                "ingest_zero_copy_waves",
+                "Waves adopted straight from a native ingest arena",
+                labels,
+            )
+            self._tel_fallback = reg.counter(
+                "ingest_fallback_waves",
+                "Vote-overlapping waves that fell back to Python flatten",
+                labels,
+            )
             reg.gauge(
                 "verify_pending_batches",
                 "Submissions queued for the next dispatch wave",
@@ -748,18 +1084,10 @@ class AsyncVerifyService:
         span of the flat arrays), so a valid pad can never flip a real
         claim's verdict — and because it is valid, a packed wave that
         falls back to the CPU batch equation still passes when every
-        real signature does."""
+        real signature does.  Shared with the native ingest arenas
+        (``make_pad_claim``) so adopted pad rows are byte-identical."""
         if self._pad_claim is None:
-            from .digest import Digest
-            from .keys import generate_keypair
-            from .signature import Signature
-
-            pk, sk = generate_keypair(b"\xa5" * 32, 0xFFFF)
-            digest = Digest.of(b"hotstuff_tpu wave pad claim v1")
-            sig = Signature.new(digest, sk)
-            self._pad_claim = (
-                "one", digest.to_bytes(), pk.to_bytes(), sig.to_bytes()
-            )
+            self._pad_claim = make_pad_claim()
         return self._pad_claim
 
     def _pack_wave(self, claims: list, n_sigs: int) -> list:
@@ -870,6 +1198,7 @@ class AsyncVerifyService:
         claims: list,
         measure_only: bool = False,
         deadline: float | None = None,
+        wave: "AdoptedWave | None" = None,
     ):
         """Start a device dispatch on the dedicated dispatch loop and
         register it in the in-flight table (occupancy + deadline stamp
@@ -891,12 +1220,12 @@ class AsyncVerifyService:
             # preallocated buffers wave after wave.
             self._dispatch = _DispatchLoop(self.pipeline_depth)
         self._wave_serial += 1
-        wave = self._wave_serial
+        serial = self._wave_serial
         # guarded-by: gil -- written here on the event loop, popped by
         # _deliver (loop) and by _on_done's loop-closed fallback (slot
         # thread); every access is a single dict bytecode, atomic under
         # the GIL, and the routing reads tolerate one-wave staleness
-        self._inflight[wave] = time.monotonic() + (
+        self._inflight[serial] = time.monotonic() + (
             deadline if deadline is not None else self._deadline_s()
         )
         self.peak_inflight = max(self.peak_inflight, len(self._inflight))
@@ -911,7 +1240,7 @@ class AsyncVerifyService:
 
         def _deliver(result, exc):
             # on the event loop: free the slot, resolve the wave future
-            self._inflight.pop(wave, None)
+            self._inflight.pop(serial, None)
             if self._slot_free is not None:
                 self._slot_free.set()
             if fut.cancelled():
@@ -936,10 +1265,10 @@ class AsyncVerifyService:
                 # the loop closed mid-flight (benchmark loop teardown /
                 # interpreter exit): free the slot directly so routing
                 # never sees a phantom in-flight wave
-                self._inflight.pop(wave, None)
+                self._inflight.pop(serial, None)
 
         self._dispatch.submit(
-            lambda: self._dispatch_sync(claims, t_spawn, end_holder),
+            lambda: self._dispatch_sync(claims, t_spawn, end_holder, wave),
             _on_done,
         )
         return fut, end_holder
@@ -949,9 +1278,12 @@ class AsyncVerifyService:
         claims: list,
         t_spawn: int | None = None,
         end_holder: list | None = None,
+        wave: "AdoptedWave | None" = None,
     ) -> list[bool]:
         """Slot-thread body: evaluate on the forced-device dispatch
-        view, timing the dispatch for the routing EWMA."""
+        view, timing the dispatch for the routing EWMA.  An adopted
+        zero-copy wave stages from its arena columns instead of
+        flattening claim tuples (released inside eval_claims_arena)."""
         rec = _spans.recorder()
         if rec is not None:
             t_enter = time.perf_counter_ns()
@@ -961,7 +1293,10 @@ class AsyncVerifyService:
                 rec.add("stage.slot_wait", t_spawn, t_enter - t_spawn)
         target = getattr(self.backend, "async_backend", self.backend)
         t0 = time.perf_counter()
-        out = eval_claims_sync(target, claims)
+        if wave is not None:
+            out = eval_claims_arena(target, wave, claims)
+        else:
+            out = eval_claims_sync(target, claims)
         wall = time.perf_counter() - t0
         if rec is not None:
             end_ns = time.perf_counter_ns()
@@ -1051,6 +1386,27 @@ class AsyncVerifyService:
                 self._tel_claims_unique.inc(len(claims))
                 self._tel_wave.observe(n_sigs)
 
+            # zero-copy adoption (ISSUE 20): if the native transport
+            # packed this wave's votes into a staging arena and the
+            # claim stream matches the packed prefix exactly, adopt the
+            # arena — downstream flatten/prepare become frombuffer
+            # views.  Passive accessor: the verify path never triggers
+            # a native build; only receivers create the plane.
+            adopted = None
+            ing = zero_copy_ingest_if_active()
+            if ing is not None and ing.active:
+                with _spans.span("native.pack"):
+                    fb_before = ing.fallback_waves
+                    adopted = ing.try_adopt(claims, self.wave_buckets)
+                if adopted is not None:
+                    self.zero_copy_waves += 1
+                    self.zero_copy_sigs += n_sigs
+                    if self._tel_zero_copy is not None:
+                        self._tel_zero_copy.inc()
+                elif ing.fallback_waves != fb_before:
+                    self.fallback_waves += 1
+                    if self._tel_fallback is not None:
+                        self._tel_fallback.inc()
             try:
                 with _spans.span("route.decide"):
                     route = self._route_device(n_sigs)
@@ -1085,10 +1441,16 @@ class AsyncVerifyService:
                         else route
                     ].inc()
                 dispatch_claims = claims
-                if route in ("device", "probe") and self._packing_on:
+                if (
+                    route in ("device", "probe")
+                    and self._packing_on
+                    and adopted is None
+                ):
                     # fixed-shape wave (ISSUE 6): pad to the bucket so
                     # the dispatch hits a warm jitted callable.  Probes
                     # pack too — they measure the shape real waves use.
+                    # Adopted waves skip this: the arena is already
+                    # bucket-shaped with native-padded rows.
                     with _spans.span("stage.pack"):
                         dispatch_claims = self._pack_wave(claims, n_sigs)
                 if route == "probe":
@@ -1097,7 +1459,11 @@ class AsyncVerifyService:
                     # itself is served from the CPU so a degraded tunnel
                     # never adds wave latency
                     self.probe_dispatches += 1
-                    self._spawn_device(loop, dispatch_claims, measure_only=True)
+                    self._spawn_device(
+                        loop, dispatch_claims, measure_only=True,
+                        wave=adopted,
+                    )
+                    adopted = None  # released by the probe dispatch
                 if route == "device":
                     self.device_dispatches += 1
                     if self._device_route_label == "mesh":
@@ -1105,8 +1471,10 @@ class AsyncVerifyService:
                     self.device_sigs += n_sigs
                     deadline = self._deadline_s()
                     exec_fut, end_holder = self._spawn_device(
-                        loop, dispatch_claims, deadline=deadline
+                        loop, dispatch_claims, deadline=deadline,
+                        wave=adopted,
                     )
+                    adopted = None  # released by the slot thread
                     # async readback (ISSUE 5): the dispatcher does NOT
                     # await the device — a per-wave lander task lands
                     # this wave's verdicts when its completion future
@@ -1126,19 +1494,27 @@ class AsyncVerifyService:
                     continue
                 self.cpu_dispatches += 1
                 self.cpu_sigs += n_sigs
-                await self._serve_cpu(batch)
+                if adopted is not None:
+                    wave_held, adopted = adopted, None
+                    await self._serve_cpu_arena(batch, claims, wave_held)
+                else:
+                    await self._serve_cpu(batch)
                 if wave_t0 is not None:
                     rec.add(
                         "e2e", wave_t0, time.perf_counter_ns() - wave_t0
                     )
                 self._log_stats()
             except asyncio.CancelledError:
+                if adopted is not None:
+                    adopted.release()
                 for _, fut in batch:
                     if not fut.done():
                         fut.cancel()
                 raise
             except Exception as e:  # noqa: BLE001 — backend failure must
                 # reach every waiter, not kill the dispatcher
+                if adopted is not None:
+                    adopted.release()
                 log.warning("verify dispatch failed: %s", e)
                 for _, fut in batch:
                     if not fut.done():
@@ -1167,6 +1543,22 @@ class AsyncVerifyService:
                     self._tel_host_wall.add(time.perf_counter() - t0)
                 for c, r in zip(todo, results):
                     memo[c] = r
+            if not fut.done():
+                fut.set_result([memo[c] for c in cs])
+            await asyncio.sleep(0)
+
+    async def _serve_cpu_arena(self, batch, claims: list, wave) -> None:
+        """CPU serving for an adopted zero-copy wave: ONE native batch
+        equation straight from the arena columns covers every unique
+        claim (no b"".join flatten, no per-claim re-verify), then
+        verdicts fan out per submission exactly like _serve_cpu."""
+        cpu = getattr(self.backend, "cpu_backend", self.backend)
+        t0 = time.perf_counter()
+        results = eval_claims_arena(cpu, wave, claims)
+        if self._tel_host_wall is not None:
+            self._tel_host_wall.add(time.perf_counter() - t0)
+        memo = dict(zip(claims, results))
+        for cs, fut in batch:
             if not fut.done():
                 fut.set_result([memo[c] for c in cs])
             await asyncio.sleep(0)
@@ -1245,7 +1637,7 @@ class AsyncVerifyService:
                 "Verify service stats [%s]: dispatches=%d device=%d "
                 "cpu=%d probe=%d device_sigs=%d cpu_sigs=%d "
                 "deadline_misses=%d waits=%d depth=%d mesh=%d "
-                "agg=%d agg_sigs=%d ewma_ms=%.1f",
+                "agg=%d agg_sigs=%d ewma_ms=%.1f zc=%d fb=%d",
                 self._stats_tag,
                 self.dispatches,
                 self.device_dispatches,
@@ -1260,19 +1652,32 @@ class AsyncVerifyService:
                 self.agg_claims,
                 self.agg_sigs,
                 (self._device_ewma_s or 0.0) * 1e3,
+                self.zero_copy_waves,
+                self.fallback_waves,
             )
 
 
 __all__ = [
+    "AdoptedWave",
     "AsyncVerifyService",
+    "ZeroCopyIngest",
     "claim_sig_count",
+    "eval_claims_arena",
     "eval_claims_sync",
     "flatten_claims",
+    "ingest_arena_rows_from_env",
+    "ingest_note_frame",
+    "ingest_ring_from_env",
+    "make_pad_claim",
     "pipeline_depth_from_env",
     "wave_buckets_from_env",
     "resolve_wave_buckets",
     "coalesce_window_s_from_env",
+    "zero_copy_from_env",
+    "zero_copy_ingest",
+    "zero_copy_ingest_if_active",
     "CPU_US_PER_SIG",
+    "DEFAULT_INGEST_RING_DEPTH",
     "DEFAULT_PIPELINE_DEPTH",
     "DEFAULT_WAVE_BUCKETS",
     "PIPELINE_MARGINAL_COST",

@@ -178,3 +178,239 @@ def batch_verify_shared(msg: bytes, votes) -> bool:
     pks = b"".join(pk for pk, _ in votes)
     sigs = b"".join(sig for _, sig in votes)
     return batch_verify(msg, len(msg), pks, sigs, n, shared=True)
+
+
+def batch_verify_columns(
+    dig_addr: int, pks_addr: int, sigs_addr: int, n: int
+) -> bool:
+    """Batch verify straight from native arena column addresses
+    (wave_pack.cpp staging memory) — the zero-copy CPU route: no
+    ``b"".join`` flatten, no bytes materialization.  The addresses come
+    from ``WavePacker.arena_info`` and stay valid until the arena is
+    recycled; the caller owns that lifetime."""
+    if n == 0:
+        return True
+    assert _lib is not None and _lib is not False, "call available() first"
+    return (
+        _lib.hs_ed25519_batch_verify(
+            ctypes.cast(dig_addr, ctypes.c_char_p),
+            32,
+            ctypes.cast(pks_addr, ctypes.c_char_p),
+            ctypes.cast(sigs_addr, ctypes.c_char_p),
+            n,
+            0,
+        )
+        == 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wave-pack arena bindings (native/wave_pack.cpp, ISSUE 20)
+#
+# The wp_* ABI ships in libhs_transport.so (same dlopen handle as the
+# reactor's ht_* surface) — votes parsed at the reactor read path land
+# in bucket-shaped staging arenas that the async verify service adopts
+# as NumPy frombuffer views instead of flattening Python claim tuples.
+# ---------------------------------------------------------------------------
+
+_TRANSPORT_LIB = "libhs_transport.so"
+
+# None = never tried; False = unavailable (cached); CDLL = loaded
+_wp_lib: ctypes.CDLL | bool | None = None
+
+
+def _load_wave_lib() -> ctypes.CDLL:
+    path = os.path.join(_native_dir(), "build", _TRANSPORT_LIB)
+    try:
+        subprocess.run(
+            ["make", "-C", _native_dir(), f"build/{_TRANSPORT_LIB}"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        if not os.path.exists(path):
+            raise ImportError(f"cannot build {_TRANSPORT_LIB}: {e}") from e
+    try:
+        lib = ctypes.CDLL(path)
+        lib.wp_create.restype = ctypes.c_void_p
+        lib.wp_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.wp_destroy.argtypes = [ctypes.c_void_p]
+        lib.wp_set_pad.restype = ctypes.c_int
+        lib.wp_set_pad.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        lib.wp_probe_vote.restype = ctypes.c_int
+        lib.wp_probe_vote.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.wp_pack_vote.restype = ctypes.c_long
+        lib.wp_pack_vote.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_char_p,
+        ]
+        lib.wp_count.restype = ctypes.c_long
+        lib.wp_count.argtypes = [ctypes.c_void_p]
+        lib.wp_seal.restype = ctypes.c_long
+        lib.wp_seal.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.wp_arena_info.restype = ctypes.c_int
+        lib.wp_arena_info.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.wp_recycle.restype = ctypes.c_int
+        lib.wp_recycle.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.wp_discard.restype = ctypes.c_int
+        lib.wp_discard.argtypes = [ctypes.c_void_p]
+        lib.wp_counters.restype = ctypes.c_int
+        lib.wp_counters.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+        ]
+        lib.wp_parse_producer.restype = ctypes.c_long
+        lib.wp_parse_producer.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+    except (OSError, AttributeError) as e:
+        raise ImportError(f"cannot load {_TRANSPORT_LIB}: {e}") from e
+    return lib
+
+
+def wave_pack_available() -> bool:
+    global _wp_lib
+    if _wp_lib is None:
+        try:
+            _wp_lib = _load_wave_lib()
+        except ImportError as e:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "native wave packer unavailable (%s); ingest stays on the "
+                "Python flatten path",
+                e,
+            )
+            _wp_lib = False
+    return _wp_lib is not False
+
+
+MAX_PRODUCER_BATCH = 512
+
+
+def probe_vote(frame: bytes) -> bool:
+    """Stateless Decoder-parity accept/reject for a vote frame (the
+    differential fuzz harness drives this against decode_message)."""
+    assert _wp_lib is not None and _wp_lib is not False
+    return _wp_lib.wp_probe_vote(frame, len(frame)) == 1
+
+
+def parse_producer(frame: bytes):
+    """Decoder-parity producer-v2 parse: ``(digests, spans)`` where
+    ``digests`` is the packed 32B digest column and ``spans`` is a list
+    of ``(offset, length)`` body windows into ``frame`` — or ``None``
+    for any frame the Python Decoder rejects."""
+    assert _wp_lib is not None and _wp_lib is not False
+    digs = ctypes.create_string_buffer(MAX_PRODUCER_BATCH * 32)
+    spans = (ctypes.c_uint64 * (MAX_PRODUCER_BATCH * 2))()
+    n = _wp_lib.wp_parse_producer(frame, len(frame), digs, spans)
+    if n < 0:
+        return None
+    return (
+        digs.raw[: n * 32],
+        [(spans[2 * i], spans[2 * i + 1]) for i in range(n)],
+    )
+
+
+class WavePacker:
+    """Owner of one native arena ring.  ``pack_vote`` runs on the event
+    loop (reactor drain path); ``recycle`` runs on verifier slot threads
+    once the adopted views are consumed — the native side serializes
+    both under one mutex."""
+
+    def __init__(self, capacity: int, ring_depth: int = 4):
+        if not wave_pack_available():
+            raise ImportError("wave packer unavailable")
+        assert _wp_lib is not None and _wp_lib is not False
+        self._lib = _wp_lib
+        self._h = self._lib.wp_create(capacity, ring_depth)
+        if not self._h:
+            raise MemoryError("wp_create failed")
+        self.capacity = capacity
+        self.ring_depth = ring_depth
+        self._digest_out = ctypes.create_string_buffer(32)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.wp_destroy(self._h)
+            self._h = None
+
+    def set_pad(self, digest: bytes, pk: bytes, sig: bytes) -> bool:
+        return self._lib.wp_set_pad(self._h, digest, pk, sig) == 0
+
+    def pack_vote(self, frame: bytes):
+        """``(row_slot, claim_digest32)`` on success, else the negative
+        native error code (int): -1 malformed frame, -2 open arena
+        full, -3 no pad installed."""
+        slot = self._lib.wp_pack_vote(
+            self._h, frame, len(frame), self._digest_out
+        )
+        if slot < 0:
+            return int(slot)
+        return slot, self._digest_out.raw
+
+    def count(self) -> int:
+        return int(self._lib.wp_count(self._h))
+
+    def seal(self, n_take: int) -> int | None:
+        """Seal the open arena at ``n_take`` rows (surplus rows carry
+        over to the next arena).  Returns the sealed arena index."""
+        idx = self._lib.wp_seal(self._h, n_take)
+        return None if idx < 0 else int(idx)
+
+    def arena_info(self, arena: int):
+        """``(dig_addr, pk_addr, sig_addr, rows, capacity)`` of a sealed
+        arena — feed the addresses to ``column_view`` / NumPy."""
+        out = (ctypes.c_uint64 * 5)()
+        if self._lib.wp_arena_info(self._h, arena, out) != 0:
+            return None
+        return (
+            int(out[0]),
+            int(out[1]),
+            int(out[2]),
+            int(out[3]),
+            int(out[4]),
+        )
+
+    def recycle(self, arena: int) -> bool:
+        return self._lib.wp_recycle(self._h, arena) == 0
+
+    def discard(self) -> bool:
+        return self._lib.wp_discard(self._h) == 0
+
+    def counters(self) -> dict:
+        out = (ctypes.c_uint64 * 7)()
+        n = self._lib.wp_counters(self._h, out, 7)
+        names = (
+            "packed",
+            "reject",
+            "full",
+            "seal",
+            "discard",
+            "recycle",
+            "moved",
+        )
+        return {names[i]: int(out[i]) for i in range(n)}
+
+
+def column_view(addr: int, nbytes: int):
+    """Writable buffer over ``nbytes`` of native arena memory at
+    ``addr`` (buffer-protocol object — ``np.frombuffer`` accepts it
+    directly).  Valid only until the owning arena is recycled."""
+    return (ctypes.c_uint8 * nbytes).from_address(addr)

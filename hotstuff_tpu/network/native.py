@@ -37,6 +37,7 @@ import subprocess
 from collections import deque
 
 from ..faults.plane import BARRIER_POLL_S, corrupt_frame
+from .receiver import dispatch_ingest
 
 log = logging.getLogger(__name__)
 
@@ -85,12 +86,12 @@ def _load_lib() -> ctypes.CDLL:
             raise ImportError(f"cannot build {_LIB_NAME}: {e}") from e
         # no toolchain but a prebuilt library exists: try it
     lib = ctypes.CDLL(path)
-    if not hasattr(lib, "ht_counters"):
+    if not hasattr(lib, "wp_pack_vote"):
         # probe the NEWEST entry point so a stale prebuilt .so keeps
         # the documented contract (ImportError, so importorskip /
         # try-except fallbacks behave instead of AttributeError at bind)
         raise ImportError(
-            f"stale {_LIB_NAME}: missing ht_counters; "
+            f"stale {_LIB_NAME}: missing wp_pack_vote; "
             f"rebuild with `make -C native`"
         )
     lib.ht_start.restype = ctypes.c_void_p
@@ -355,7 +356,7 @@ class NativeReceiver:
                 payload = b""  # isolate window: swallow the frame unACKed
             try:
                 if payload:
-                    await self.handler.dispatch(writer, payload)
+                    await dispatch_ingest(self.handler, writer, payload)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — a handler bug must not

@@ -14,9 +14,48 @@ import asyncio
 import logging
 from typing import Protocol
 
+from ..crypto.async_service import ingest_note_frame, zero_copy_ingest
 from .framing import FramingError, read_frame, send_frame, set_nodelay
 
 log = logging.getLogger(__name__)
+
+#: wire tags mirrored from consensus/wire.py — importing it here would
+#: cycle (consensus imports this module for the Writer protocol);
+#: tests/test_wire_fuzz.py asserts these against the live constants
+_TAG_VOTE = 1
+_TAG_PRODUCER_V2 = 6
+
+
+async def dispatch_ingest(handler, writer, frame: bytes) -> None:
+    """Frame dispatch through the zero-copy ingest taps (ISSUE 20),
+    shared by the asyncio and native receivers.
+
+    Vote frames are additionally noted to the native wave packer — the
+    verify service later adopts the packed digest/pk/sig columns
+    instead of flattening Python claim tuples.  Batched producer-v2
+    frames parse natively into a digest column + body spans and skip
+    per-item payload tuples entirely when the handler exposes
+    ``dispatch_producer_v2``.  Every miss — plane disabled, native
+    library unavailable, handler without the fast path, frame the
+    native parser rejects — falls through to ``handler.dispatch``
+    unchanged (the differential fuzz corpus pins native and Python
+    accept/reject to byte parity, so only frames BOTH reject ever
+    double-parse)."""
+    if frame:
+        tag = frame[0]
+        if tag == _TAG_VOTE:
+            ingest_note_frame(frame)
+        elif tag == _TAG_PRODUCER_V2:
+            fast = getattr(handler, "dispatch_producer_v2", None)
+            if fast is not None and zero_copy_ingest() is not None:
+                from ..crypto import native_ed25519
+
+                parsed = native_ed25519.parse_producer(frame)
+                if parsed is not None:
+                    digests, spans = parsed
+                    await fast(writer, frame, digests, spans)
+                    return
+    await handler.dispatch(writer, frame)
 
 
 class Writer:
@@ -100,7 +139,7 @@ class Receiver:
                     self._flows.rx(peer, frame)
                 if self._faults is not None and self._faults.inbound_cut():
                     continue  # isolate window: swallow the frame unACKed
-                await self.handler.dispatch(writer, frame)
+                await dispatch_ingest(self.handler, writer, frame)
         except (
             asyncio.IncompleteReadError,
             ConnectionError,

@@ -23,6 +23,7 @@ dependency) and for the analysis plane running in a bare CI venv.
 #: with other names (parents, ad-hoc) are recorded but never summed
 SPAN_LEAF_STAGES: tuple = (
     "coalesce.wait",
+    "native.pack",
     "route.decide",
     "pipeline.wait",
     "stage.pack",
@@ -115,6 +116,14 @@ PAYLOAD_EDGES: tuple = ("recv.producer", "payload.first")
 #: admission-plane edges: value records (shed count / credit window in
 #: the ``u`` field), rendered as the ingest-plane track
 INGEST_EDGES: tuple = ("ingest.shed", "ingest.credit")
+
+#: zero-copy ingest metrics (ISSUE 20): registry counter names for
+#: waves the verify service adopted straight from a native staging
+#: arena vs. vote-overlapping waves that had to fall back to the
+#: Python flatten path (disjoint non-vote waves count as neither).
+#: The hit rate zc/(zc+fb) is surfaced on the verify stats line
+#: (``zc=``/``fb=``) and asserted >=0.9 by scripts/ingest_check.py.
+INGEST_COUNTERS: tuple = ("ingest_zero_copy_waves", "ingest_fallback_waves")
 
 #: standalone edges: local timeout complaints, the profiler fan-out
 #: record (stage in ``p``, duration in ``u``), and each ring segment's
@@ -228,6 +237,7 @@ __all__ = [
     "CONTROL_EDGES",
     "PAYLOAD_EDGES",
     "INGEST_EDGES",
+    "INGEST_COUNTERS",
     "MISC_EDGES",
     "FAULT_PREFIX",
     "BYZ_PREFIX",

@@ -15,6 +15,12 @@
 //              reactor thread's epoll loop and the ht_next drain —
 //              every mutex-protected queue handoff in transport.cpp
 //              under genuine cross-thread fire.
+//   wavepack:  one wave packer ring (wave_pack.cpp), four packer
+//              threads racing wp_pack_vote against a sealer thread
+//              doing wp_seal/wp_arena_info/column reads/wp_recycle and
+//              periodic wp_discard — the production topology (reactor
+//              thread packs, verifier slot threads seal and recycle)
+//              with the thread count turned up.
 //
 // Exit 0 and "SAN_STRESS OK" on success; any sanitizer report fails
 // the process via halt_on_error=1 (set by scripts/san_check.py).
@@ -54,6 +60,21 @@ int ht_next(void* rp, long* src, int* kind, uint8_t* buf, int cap);
 int ht_set_read_paused(void* rp, long conn, int paused);
 int ht_close_conn(void* rp, long conn);
 void ht_stop(void* rp);
+// wave_pack.cpp
+void* wp_create(int capacity, int ring_depth);
+void wp_destroy(void* h);
+int wp_set_pad(void* h, const uint8_t* dig, const uint8_t* pk,
+               const uint8_t* sig);
+int wp_probe_vote(const uint8_t* frame, long n);
+long wp_pack_vote(void* h, const uint8_t* frame, long n, uint8_t* digest_out);
+long wp_count(void* h);
+long wp_seal(void* h, long n_take);
+int wp_arena_info(void* h, long arena, uint64_t out[5]);
+int wp_recycle(void* h, long arena);
+int wp_discard(void* h);
+int wp_counters(void* h, uint64_t* out, int cap);
+long wp_parse_producer(const uint8_t* frame, long n, uint8_t* digests_out,
+                       uint64_t* spans_out);
 }
 
 namespace {
@@ -248,6 +269,154 @@ void transport_stress() {
               sent.load(), got_accepted.load(), got_peer.load());
 }
 
+// ---- wave-pack stress ------------------------------------------------------
+
+constexpr int kPackThreads = 4;
+constexpr int kPacksPerThread = 2000;
+constexpr int kArenaCap = 64;
+constexpr int kRingDepth = 4;
+
+// Valid 145-byte ed25519 vote frame with deterministic junk contents —
+// the packer checks wire shape, not signatures.
+void make_vote_frame(uint8_t out[145], int t, int i) {
+  std::memset(out, 0, 145);
+  out[0] = 1;  // TAG_VOTE
+  for (int k = 0; k < 32; k++) out[1 + k] = (uint8_t)(t * 37 + i + k);
+  uint64_t rnd = (uint64_t)t << 32 | (uint32_t)i;
+  std::memcpy(out + 33, &rnd, 8);  // round (LE on every target we build)
+  out[41] = 32;                    // pk_len LE
+  for (int k = 0; k < 32; k++) out[45 + k] = (uint8_t)(t + k);
+  out[77] = 64;  // sig_len LE
+  for (int k = 0; k < 64; k++) out[81 + k] = (uint8_t)(i + k);
+}
+
+void wavepack_stress() {
+  void* wp = wp_create(kArenaCap, kRingDepth);
+  if (!wp) return fail("wp_create");
+  uint8_t pad_dig[32], pad_pk[32], pad_sig[64];
+  std::memset(pad_dig, 0xA5, sizeof pad_dig);
+  std::memset(pad_pk, 0x5A, sizeof pad_pk);
+  std::memset(pad_sig, 0x3C, sizeof pad_sig);
+  if (wp_set_pad(wp, pad_dig, pad_pk, pad_sig) != 0) {
+    wp_destroy(wp);
+    return fail("wp_set_pad");
+  }
+
+  std::atomic<long> packed{0}, dropped{0};
+  std::atomic<bool> done_packing{false};
+
+  // sealer: the verifier-slot role — seal whatever is packed, adopt the
+  // column views (read every exposed byte: ASan bounds + TSan ordering
+  // vs. the packers), recycle; periodic discard models an ingest resync
+  std::thread sealer([&] {
+    std::vector<uint8_t> sink(1, 0);
+    uint64_t info[5];
+    long seals = 0;
+    while (true) {
+      long c = wp_count(wp);
+      if (c <= 0) {
+        if (done_packing.load() && wp_count(wp) <= 0) break;
+        usleep(100);
+        continue;
+      }
+      long take = c > 16 ? 16 : c;
+      long arena = wp_seal(wp, take);
+      if (arena == -2) {  // every arena busy: shed like the real plane
+        wp_discard(wp);
+        continue;
+      }
+      if (arena < 0) continue;  // packer raced the count snapshot
+      if (wp_arena_info(wp, arena, info) != 0) {
+        fail("wp_arena_info on sealed arena");
+        break;
+      }
+      if ((long)info[3] != take || (long)info[4] != kArenaCap) {
+        fail("wp_arena_info shape mismatch");
+        break;
+      }
+      const uint8_t* dig = (const uint8_t*)(uintptr_t)info[0];
+      const uint8_t* pk = (const uint8_t*)(uintptr_t)info[1];
+      const uint8_t* sig = (const uint8_t*)(uintptr_t)info[2];
+      uint8_t acc = 0;
+      for (long r = 0; r < kArenaCap; r++) {  // full fixed shape, pads too
+        for (int k = 0; k < 32; k++) acc ^= dig[r * 32 + k];
+        for (int k = 0; k < 32; k++) acc ^= pk[r * 32 + k];
+        for (int k = 0; k < 64; k++) acc ^= sig[r * 64 + k];
+      }
+      sink[0] ^= acc;
+      if (wp_recycle(wp, arena) != 0) {
+        fail("wp_recycle");
+        break;
+      }
+      if (++seals % 97 == 0) wp_discard(wp);
+    }
+    if (sink[0] == 0xFF) std::printf("(sink)\n");  // keep the reads live
+  });
+
+  std::vector<std::thread> packers;
+  for (int t = 0; t < kPackThreads; t++) {
+    packers.emplace_back([&, t] {
+      uint8_t frame[145], digest[32];
+      uint64_t spans[8 * 2];
+      uint8_t digs[8 * 32];
+      for (int i = 0; i < kPacksPerThread; i++) {
+        make_vote_frame(frame, t, i);
+        if (wp_probe_vote(frame, sizeof frame) != 1) {
+          fail("wp_probe_vote rejected a valid frame");
+          return;
+        }
+        long slot = wp_pack_vote(wp, frame, sizeof frame, digest);
+        if (slot == -2) {
+          dropped.fetch_add(1);  // open arena full: real plane resyncs
+          usleep(50);
+        } else if (slot >= 0) {
+          packed.fetch_add(1);
+        } else {
+          fail("wp_pack_vote rejected a valid frame");
+          return;
+        }
+        if (i % 53 == 17) {
+          // stateless producer parse races the stateful ring paths
+          uint8_t pf[6 + 2 * (32 + 4 + 3)];
+          pf[0] = 6;  // TAG_PRODUCER_V2
+          pf[1] = 2;  // version
+          pf[2] = 2; pf[3] = 0; pf[4] = 0; pf[5] = 0;  // count LE
+          size_t off = 6;
+          for (int item = 0; item < 2; item++) {
+            std::memset(pf + off, (uint8_t)(t + item), 32);
+            off += 32;
+            pf[off] = 3; pf[off + 1] = 0; pf[off + 2] = 0; pf[off + 3] = 0;
+            off += 4;
+            std::memset(pf + off, 0x42, 3);
+            off += 3;
+          }
+          if (wp_parse_producer(pf, (long)off, digs, spans) != 2) {
+            fail("wp_parse_producer rejected a valid frame");
+            return;
+          }
+        }
+      }
+    });
+  }
+  for (auto& th : packers) th.join();
+  done_packing.store(true);
+  sealer.join();
+
+  uint64_t ctr[7] = {0};
+  wp_counters(wp, ctr, 7);
+  long expect = (long)kPackThreads * kPacksPerThread - dropped.load();
+  if ((long)ctr[0] != packed.load() || packed.load() != expect)
+    fail("wave-pack lost packed rows");
+  if (ctr[3] == 0) fail("wave-pack sealer never sealed");
+  if (ctr[3] != ctr[5]) fail("seal/recycle imbalance");
+  wp_destroy(wp);
+  std::printf("wavepack stress done: packed=%llu seals=%llu moved=%llu "
+              "discards=%llu dropped=%ld\n",
+              (unsigned long long)ctr[0], (unsigned long long)ctr[3],
+              (unsigned long long)ctr[6], (unsigned long long)ctr[4],
+              dropped.load());
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -258,8 +427,10 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "SAN_STRESS FAIL: mkdtemp\n");
     return 1;
   }
-  if (std::strcmp(which, "transport") != 0) store_stress(dir);
-  if (std::strcmp(which, "store") != 0) transport_stress();
+  bool all = std::strcmp(which, "all") == 0;
+  if (all || std::strcmp(which, "store") == 0) store_stress(dir);
+  if (all || std::strcmp(which, "transport") == 0) transport_stress();
+  if (all || std::strcmp(which, "wavepack") == 0) wavepack_stress();
   if (g_failed) return 1;
   std::printf("SAN_STRESS OK\n");
   return 0;

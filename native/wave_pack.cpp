@@ -1,0 +1,422 @@
+// Zero-copy wire→device ingest: native frame parsing straight into
+// wave-shaped staging arenas (ISSUE 20).
+//
+// The Python hot path used to be: reactor frame → Decoder → Vote object
+// → claim tuple → flatten_claims (fresh bytes per claim) → prepare
+// (another copy into staging arrays). This file moves the parse+pack
+// onto the native side: vote frames are validated with EXACTLY the
+// bounds the Python Decoder enforces (tests/test_wire_fuzz.py holds a
+// differential harness to that contract) and their digest/pk/sig
+// columns are scattered straight into a ring of preallocated,
+// bucket-shaped staging arenas. The async verify service then *adopts*
+// an arena (NumPy frombuffer views over the columns) instead of
+// flattening claim objects — see crypto/async_service.py.
+//
+// Wire contracts mirrored here (consensus/wire.py, scheme=ed25519):
+//
+//   vote frame (TAG_VOTE=1), accepted iff EXACTLY 145 bytes:
+//     [u8 tag=1][32B block hash][u64 LE round]
+//     [u32 LE pk_len==32][32B pk][u32 LE sig_len==64][64B sig]
+//   claim digest = SHA-512(hash || round_le8)[:32]  (messages.py
+//   Vote.digest) — hash and round are adjacent on the wire, so the
+//   digest input is simply frame[1:41].
+//
+//   producer batch v2 (TAG_PRODUCER_V2=6):
+//     [u8 tag=6][u8 version==2][u32 LE count, 1..512]
+//     count x ([32B digest][u32 LE len<=65536][len bytes body])
+//   with no trailing bytes (Decoder.finish()).
+//
+// Arena ring lifecycle (all transitions under one mutex — pack runs on
+// the event-loop thread, recycle on verifier slot threads):
+//
+//   FREE --wp_seal promotes--> OPEN --wp_pack_vote fills rows-->
+//   OPEN --wp_seal(n_take)--> SEALED (surplus rows move to the next
+//   FREE arena, which becomes OPEN) --wp_recycle--> FREE
+//
+// Every arena is pre-filled with a VALID pad claim (wp_set_pad), and
+// recycle/discard re-pad only the dirtied rows — so a sealed arena is
+// always a full, valid, fixed-shape wave: rows [0,n) are real claims,
+// rows [n,capacity) are the pad claim. Fixed-shape bucket padding
+// therefore costs nothing at dispatch time.
+//
+// Exposed through the same dlopen handle as transport.cpp's ht_* ABI
+// (both compile into libhs_transport.so).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+// ---- SHA-512 (single block, messages <= 111 bytes) -------------------------
+// The only digest this file needs is SHA-512(hash32 || round8)[:32] for
+// the vote claim column — a fixed 40-byte message, so one 128-byte
+// block always suffices. Verified byte-for-byte against hashlib by
+// tests/test_wire_fuzz.py.
+
+constexpr uint64_t kShaK[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL,
+};
+
+inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+// digest of a message that fits one padded block (len <= 111)
+void sha512_single_block(const uint8_t* msg, size_t len, uint8_t out[64]) {
+  uint8_t block[128];
+  std::memset(block, 0, sizeof block);
+  std::memcpy(block, msg, len);
+  block[len] = 0x80;
+  uint64_t bits = (uint64_t)len * 8;
+  for (int i = 0; i < 8; i++)
+    block[127 - i] = (uint8_t)(bits >> (8 * i));
+
+  uint64_t h[8] = {0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+                   0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+                   0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+                   0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+  uint64_t w[80];
+  for (int i = 0; i < 16; i++) {
+    uint64_t v = 0;
+    for (int b = 0; b < 8; b++) v = (v << 8) | block[i * 8 + b];
+    w[i] = v;
+  }
+  for (int i = 16; i < 80; i++) {
+    uint64_t s0 = rotr64(w[i - 15], 1) ^ rotr64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+    uint64_t s1 = rotr64(w[i - 2], 19) ^ rotr64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint64_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint64_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 80; i++) {
+    uint64_t S1 = rotr64(e, 14) ^ rotr64(e, 18) ^ rotr64(e, 41);
+    uint64_t ch = (e & f) ^ (~e & g);
+    uint64_t t1 = hh + S1 + ch + kShaK[i] + w[i];
+    uint64_t S0 = rotr64(a, 28) ^ rotr64(a, 34) ^ rotr64(a, 39);
+    uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint64_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  for (int i = 0; i < 8; i++)
+    for (int b2 = 0; b2 < 8; b2++)
+      out[i * 8 + b2] = (uint8_t)(h[i] >> (56 - 8 * b2));
+}
+
+// ---- wire parsing (Decoder-parity) -----------------------------------------
+
+constexpr int kTagVote = 1;
+constexpr int kTagProducerV2 = 6;
+constexpr int kProducerVersion = 2;
+constexpr long kMaxProducerBatch = 512;   // wire.py MAX_PRODUCER_BATCH
+constexpr long kMaxPayloadBody = 65536;   // wire.py MAX_PAYLOAD_BODY
+constexpr int kVoteFrameLen = 145;        // tag + <32sQI32sI64s>
+constexpr int kDigSize = 32;
+constexpr int kPkSize = 32;
+constexpr int kSigSize = 64;
+
+inline uint32_t le32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+// Accept iff the Python Decoder (scheme=ed25519) accepts: the struct
+// fast path in messages.py reads a fixed 144-byte layout after the tag
+// (truncation -> CodecError), rejects pk_len/sig_len field mismatches,
+// and decode_message's finish() rejects trailing bytes — net: exactly
+// 145 bytes with the two length fields pinned to 32/64.
+inline bool vote_ok(const uint8_t* frame, long n) {
+  return n == kVoteFrameLen && frame[0] == kTagVote &&
+         le32(frame + 41) == kPkSize && le32(frame + 77) == kSigSize;
+}
+
+// ---- staging arena ring ----------------------------------------------------
+
+enum ArenaState { kFree = 0, kOpen = 1, kSealed = 2 };
+
+struct Arena {
+  std::vector<uint8_t> dig, pk, sig;
+  int count = 0;   // rows packed (OPEN) / exposed (SEALED)
+  int dirty = 0;   // high-water of rows written since the last pad fill
+  int state = kFree;
+};
+
+struct Packer {
+  std::mutex mu;
+  int capacity = 0;
+  int depth = 0;
+  int open = -1;
+  bool pad_set = false;
+  uint8_t pad_dig[kDigSize];
+  uint8_t pad_pk[kPkSize];
+  uint8_t pad_sig[kSigSize];
+  std::vector<Arena> ring;
+  // counters: packed, reject, full, seal, discard, recycle, moved rows
+  uint64_t c_packed = 0, c_reject = 0, c_full = 0, c_seal = 0;
+  uint64_t c_discard = 0, c_recycle = 0, c_moved = 0;
+};
+
+void pad_rows(Packer* p, Arena& a, int lo, int hi) {
+  for (int r = lo; r < hi; r++) {
+    std::memcpy(a.dig.data() + (size_t)r * kDigSize, p->pad_dig, kDigSize);
+    std::memcpy(a.pk.data() + (size_t)r * kPkSize, p->pad_pk, kPkSize);
+    std::memcpy(a.sig.data() + (size_t)r * kSigSize, p->pad_sig, kSigSize);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Ring of `ring_depth` arenas, each `capacity` rows (capacity should be
+// the LARGEST wave bucket so any smaller bucket is a prefix view).
+// Returns an opaque handle, or null on bad args / alloc failure.
+void* wp_create(int capacity, int ring_depth) {
+  if (capacity <= 0 || ring_depth < 2) return nullptr;
+  Packer* p = new (std::nothrow) Packer();
+  if (!p) return nullptr;
+  p->capacity = capacity;
+  p->depth = ring_depth;
+  p->ring.resize(ring_depth);
+  for (auto& a : p->ring) {
+    a.dig.resize((size_t)capacity * kDigSize);
+    a.pk.resize((size_t)capacity * kPkSize);
+    a.sig.resize((size_t)capacity * kSigSize);
+  }
+  p->ring[0].state = kOpen;
+  p->open = 0;
+  return p;
+}
+
+void wp_destroy(void* h) { delete static_cast<Packer*>(h); }
+
+// Install the pad claim and pre-fill EVERY arena with it. Must run
+// before the first pack (packing without a pad would leave unsealed
+// rows garbage instead of valid claims). Rejected once any row has
+// been written.
+int wp_set_pad(void* h, const uint8_t* dig, const uint8_t* pk,
+               const uint8_t* sig) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  for (auto& a : p->ring)
+    if (a.dirty > 0) return -1;
+  std::memcpy(p->pad_dig, dig, kDigSize);
+  std::memcpy(p->pad_pk, pk, kPkSize);
+  std::memcpy(p->pad_sig, sig, kSigSize);
+  for (auto& a : p->ring) pad_rows(p, a, 0, p->capacity);
+  p->pad_set = true;
+  return 0;
+}
+
+// Stateless accept/reject probe with Decoder parity — the differential
+// fuzz harness drives this over the same corpus as decode_message.
+int wp_probe_vote(const uint8_t* frame, long n) {
+  return vote_ok(frame, n) ? 1 : 0;
+}
+
+// Parse a vote frame into the open arena. Returns the row slot (>= 0)
+// and writes the 32-byte claim digest to digest_out (also column 0 of
+// the row), or: -1 malformed frame, -2 arena full (caller falls back
+// for this wave), -3 no pad installed / no open arena.
+long wp_pack_vote(void* h, const uint8_t* frame, long n, uint8_t* digest_out) {
+  Packer* p = static_cast<Packer*>(h);
+  if (!vote_ok(frame, n)) {
+    std::lock_guard<std::mutex> g(p->mu);
+    p->c_reject++;
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(p->mu);
+  if (!p->pad_set || p->open < 0) return -3;
+  Arena& a = p->ring[p->open];
+  if (a.count >= p->capacity) {
+    p->c_full++;
+    return -2;
+  }
+  int row = a.count;
+  uint8_t full[64];
+  // Vote.digest(): sha512_trunc(hash || round_le8) — the wire already
+  // holds hash and LE round adjacent at frame[1:41]
+  sha512_single_block(frame + 1, 40, full);
+  std::memcpy(a.dig.data() + (size_t)row * kDigSize, full, kDigSize);
+  std::memcpy(a.pk.data() + (size_t)row * kPkSize, frame + 45, kPkSize);
+  std::memcpy(a.sig.data() + (size_t)row * kSigSize, frame + 81, kSigSize);
+  a.count = row + 1;
+  if (a.count > a.dirty) a.dirty = a.count;
+  p->c_packed++;
+  if (digest_out) std::memcpy(digest_out, full, kDigSize);
+  return row;
+}
+
+// Rows currently packed in the open arena (debug/ingest accounting).
+long wp_count(void* h) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  return p->open < 0 ? -1 : p->ring[p->open].count;
+}
+
+// Seal the open arena, exposing its first n_take rows as a wave. Any
+// surplus rows (claims packed after the dispatcher snapshot) move to
+// the head of the next FREE arena, which becomes the new OPEN arena —
+// so the pack stream stays aligned with the claim stream. Returns the
+// sealed arena index, or: -1 bad n_take, -2 no FREE arena available
+// (caller should discard + fall back).
+long wp_seal(void* h, long n_take) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  if (p->open < 0) return -1;
+  Arena& a = p->ring[p->open];
+  if (n_take < 0 || n_take > a.count) return -1;
+  int next = -1;
+  for (int i = 0; i < p->depth; i++) {
+    int j = (p->open + 1 + i) % p->depth;
+    if (p->ring[j].state == kFree) {
+      next = j;
+      break;
+    }
+  }
+  if (next < 0) return -2;
+  Arena& f = p->ring[next];
+  long surplus = a.count - n_take;
+  if (surplus > 0) {
+    std::memcpy(f.dig.data(), a.dig.data() + (size_t)n_take * kDigSize,
+                (size_t)surplus * kDigSize);
+    std::memcpy(f.pk.data(), a.pk.data() + (size_t)n_take * kPkSize,
+                (size_t)surplus * kPkSize);
+    std::memcpy(f.sig.data(), a.sig.data() + (size_t)n_take * kSigSize,
+                (size_t)surplus * kSigSize);
+    p->c_moved += (uint64_t)surplus;
+  }
+  f.count = (int)surplus;
+  if (f.count > f.dirty) f.dirty = f.count;
+  f.state = kOpen;
+  long sealed = p->open;
+  a.count = (int)n_take;
+  a.state = kSealed;
+  p->open = next;
+  p->c_seal++;
+  return sealed;
+}
+
+// Column addresses + shape of a sealed arena, for NumPy frombuffer
+// adoption: out = {dig_ptr, pk_ptr, sig_ptr, exposed_rows, capacity}.
+int wp_arena_info(void* h, long arena, uint64_t out[5]) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  if (arena < 0 || arena >= p->depth) return -1;
+  Arena& a = p->ring[arena];
+  if (a.state != kSealed) return -1;
+  out[0] = (uint64_t)(uintptr_t)a.dig.data();
+  out[1] = (uint64_t)(uintptr_t)a.pk.data();
+  out[2] = (uint64_t)(uintptr_t)a.sig.data();
+  out[3] = (uint64_t)a.count;
+  out[4] = (uint64_t)p->capacity;
+  return 0;
+}
+
+// Return a sealed arena to the FREE pool: re-pad its dirtied rows so
+// the next seal exposes a fully valid fixed-shape wave again. Called
+// from verifier slot threads once the adopted views are consumed.
+int wp_recycle(void* h, long arena) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  if (arena < 0 || arena >= p->depth) return -1;
+  Arena& a = p->ring[arena];
+  if (a.state != kSealed) return -1;
+  pad_rows(p, a, 0, a.dirty);
+  a.count = 0;
+  a.dirty = 0;
+  a.state = kFree;
+  p->c_recycle++;
+  return 0;
+}
+
+// Drop everything packed into the open arena (pack/claim streams went
+// out of sync — e.g. a deduped duplicate vote): re-pad and start over.
+int wp_discard(void* h) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  if (p->open < 0) return -1;
+  Arena& a = p->ring[p->open];
+  pad_rows(p, a, 0, a.dirty);
+  a.count = 0;
+  a.dirty = 0;
+  p->c_discard++;
+  return 0;
+}
+
+// counters: {packed, reject, full, seal, discard, recycle, moved}
+int wp_counters(void* h, uint64_t* out, int cap) {
+  Packer* p = static_cast<Packer*>(h);
+  std::lock_guard<std::mutex> g(p->mu);
+  uint64_t vals[7] = {p->c_packed, p->c_reject,  p->c_full, p->c_seal,
+                      p->c_discard, p->c_recycle, p->c_moved};
+  int n = cap < 7 ? cap : 7;
+  for (int i = 0; i < n; i++) out[i] = vals[i];
+  return n;
+}
+
+// Stateless producer-v2 batch parse with Decoder parity. On accept,
+// writes the digest column (count x 32B) to digests_out and
+// (offset, len) body spans into spans_out (count x 2 u64) — bodies
+// stay in the caller's frame buffer as memoryview slices, no copies.
+// Returns the item count, or -1 on any frame the Python Decoder
+// rejects. Output buffers must hold MAX_PRODUCER_BATCH entries.
+long wp_parse_producer(const uint8_t* frame, long n, uint8_t* digests_out,
+                       uint64_t* spans_out) {
+  if (n < 2 || frame[0] != kTagProducerV2) return -1;
+  if (frame[1] != kProducerVersion) return -1;
+  if (n < 6) return -1;  // truncated count field
+  long count = (long)le32(frame + 2);
+  if (count < 1 || count > kMaxProducerBatch) return -1;
+  long off = 6;
+  for (long i = 0; i < count; i++) {
+    if (off + kDigSize > n) return -1;  // truncated digest
+    if (digests_out)
+      std::memcpy(digests_out + i * kDigSize, frame + off, kDigSize);
+    off += kDigSize;
+    if (off + 4 > n) return -1;  // truncated body length
+    long blen = (long)le32(frame + off);
+    if (blen > kMaxPayloadBody) return -1;
+    off += 4;
+    if (off + blen > n) return -1;  // truncated body
+    if (spans_out) {
+      spans_out[i * 2] = (uint64_t)off;
+      spans_out[i * 2 + 1] = (uint64_t)blen;
+    }
+    off += blen;
+  }
+  return off == n ? count : -1;  // Decoder.finish(): no trailing bytes
+}
+
+}  // extern "C"

@@ -229,6 +229,18 @@ GUARDS = (
         +1,
         0.5,
     ),
+    # zero-copy ingest throughput (ISSUE 20): sustained wire -> arena ->
+    # device sigs/s through the native wave packer + verify_packed.
+    # Skip-if-missing covers references from before the ingest block
+    # existed and hosts without the native toolchain; the wide 50% gate
+    # tolerates simulated-device weather while catching a fall off the
+    # arena fast path (the flatten detour alone is >2x on large waves).
+    (
+        "ingest.zero_copy_sigs_per_s",
+        lambda doc: (doc.get("ingest") or {}).get("zero_copy_sigs_per_s"),
+        -1,
+        0.5,
+    ),
 )
 
 #: the ratcheted metric: lower is better, fresh must stay within

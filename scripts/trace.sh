@@ -101,6 +101,14 @@
 #                                 # must produce byte-identical flow
 #                                 # tables and amp stays sane under
 #                                 # flapping-link chaos
+#   INGEST=1 scripts/trace.sh     # ONLY the zero-copy ingest check
+#                                 # (scripts/ingest_check.py): signed
+#                                 # votes over the native reactor
+#                                 # transport must verify straight from
+#                                 # the staging arenas — every verdict
+#                                 # True, zero-copy hit rate >= 90%,
+#                                 # e2e sigs/s reported; non-zero exit
+#                                 # if the pack/claim streams desync
 #   LINT=1 scripts/trace.sh       # ONLY the static analysis plane
 #                                 # (scripts/analysis_check.py): every
 #                                 # hotstuff_tpu/analysis lint rule,
@@ -170,6 +178,11 @@ fi
 if [ "${NET:-0}" = "1" ]; then
     exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python scripts/net_check.py "$@"
+fi
+
+if [ "${INGEST:-0}" = "1" ]; then
+    exec timeout -k 10 1800 env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python scripts/ingest_check.py "$@"
 fi
 
 if [ "${LINT:-0}" = "1" ]; then

@@ -1,0 +1,310 @@
+"""Native ingest-arena lifecycle (ISSUE 20): the wave packer's ring
+mechanics (pack → seal → adopt → recycle, surplus carry, full/discard
+resync) and the service-side adoption policy built on top of them.
+
+Wire-level accept/reject parity with the Python Decoder lives in
+tests/test_wire_fuzz.py; this file covers the STATE machine — the
+properties that make arena adoption safe to run concurrently with the
+reactor (claim/row alignment, pad refill on recycle, idempotent
+release).  Skips cleanly where the native toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+
+import pytest
+
+from hotstuff_tpu.crypto.async_service import (
+    DEFAULT_WAVE_BUCKETS,
+    AdoptedWave,
+    ZeroCopyIngest,
+    eval_claims_arena,
+    eval_claims_sync,
+    make_pad_claim,
+)
+from hotstuff_tpu.crypto.digest import Digest
+
+
+def _native():
+    from hotstuff_tpu.crypto import native_ed25519 as ne
+
+    if not ne.wave_pack_available():
+        pytest.skip("native wave packer unavailable")
+    return ne
+
+
+def _vote(rng):
+    """(wire frame, claim tuple) with random contents — lifecycle tests
+    never verify signatures, only byte plumbing."""
+    h = rng.randbytes(32)
+    rnd = rng.randrange(1 << 63)
+    pk = rng.randbytes(32)
+    sig = rng.randbytes(64)
+    frame = (
+        bytes([1]) + h + struct.pack("<Q", rnd)
+        + struct.pack("<I", 32) + pk
+        + struct.pack("<I", 64) + sig
+    )
+    msg = h + struct.pack("<Q", rnd)
+    return frame, ("one", Digest.of(msg).to_bytes(), pk, sig)
+
+
+def _packer(ne, capacity=8, ring=3):
+    pad = make_pad_claim()
+    p = ne.WavePacker(capacity, ring)
+    assert p.set_pad(pad[1], pad[2], pad[3])
+    return p
+
+
+def test_pad_must_be_installed_before_packing():
+    ne = _native()
+    rng = random.Random(1)
+    p = ne.WavePacker(8, 2)
+    try:
+        frame, _ = _vote(rng)
+        assert p.pack_vote(frame) == -3  # no pad installed
+        pad = make_pad_claim()
+        assert p.set_pad(pad[1], pad[2], pad[3])
+        res = p.pack_vote(frame)
+        assert not isinstance(res, int)
+        # once any row is dirty a pad swap is rejected: recycled arenas
+        # are re-padded with the INSTALLED pad, so swapping mid-flight
+        # would mix pad generations inside one ring
+        assert not p.set_pad(pad[1], pad[2], pad[3])
+    finally:
+        p.close()
+
+
+def test_pack_seal_adopt_recycle_cycle():
+    ne = _native()
+    rng = random.Random(2)
+    p = _packer(ne, capacity=8, ring=3)
+    try:
+        frames = [_vote(rng)[0] for _ in range(5)]
+        for i, f in enumerate(frames):
+            slot, digest = p.pack_vote(f)
+            assert slot == i and len(digest) == 32
+        assert p.count() == 5
+        arena = p.seal(3)  # take 3, carry 2 into the next arena
+        assert arena is not None
+        info = p.arena_info(arena)
+        assert info is not None
+        _, _, _, rows, cap = info
+        assert rows == 3 and cap == 8
+        assert p.count() == 2  # the surplus carried over, still packed
+        assert p.counters()["moved"] == 2
+        assert p.recycle(arena)
+        # the recycled arena rejoins the FREE pool: sealing the carried
+        # surplus and three more packs still finds arenas
+        for f in (_vote(rng)[0] for _ in range(3)):
+            assert not isinstance(p.pack_vote(f), int)
+        arena2 = p.seal(5)
+        assert arena2 is not None and p.count() == 0
+        assert p.recycle(arena2)
+    finally:
+        p.close()
+
+
+def test_recycle_restores_pad_rows():
+    ne = _native()
+    rng = random.Random(3)
+    pad = make_pad_claim()
+    p = _packer(ne, capacity=4, ring=2)
+    try:
+        frame, claim = _vote(rng)
+        p.pack_vote(frame)
+        arena = p.seal(1)
+        dig_addr, pk_addr, sig_addr, rows, cap = p.arena_info(arena)
+        dig = bytes(ne.column_view(dig_addr, cap * 32))
+        assert dig[:32] == claim[1]
+        assert dig[32:64] == pad[1]  # untouched rows hold the pad
+        assert p.recycle(arena)
+        # after recycle the SAME arena must eventually come back clean;
+        # drive one full ring cycle and check the dirty row was re-padded
+        for _ in range(2):
+            f2, _ = _vote(rng)
+            p.pack_vote(f2)
+            a = p.seal(1)
+            info = p.arena_info(a)
+            d = bytes(ne.column_view(info[0], 32 * 2))
+            assert d[32:64] == pad[1]
+            p.recycle(a)
+    finally:
+        p.close()
+
+
+def test_open_arena_full_returns_full_code():
+    ne = _native()
+    rng = random.Random(4)
+    p = _packer(ne, capacity=2, ring=2)
+    try:
+        assert not isinstance(p.pack_vote(_vote(rng)[0]), int)
+        assert not isinstance(p.pack_vote(_vote(rng)[0]), int)
+        assert p.pack_vote(_vote(rng)[0]) == -2  # open arena full
+        assert p.counters()["full"] == 1
+        assert p.discard()
+        assert p.count() == 0
+        assert not isinstance(p.pack_vote(_vote(rng)[0]), int)
+    finally:
+        p.close()
+
+
+def test_malformed_frames_rejected_with_code():
+    ne = _native()
+    rng = random.Random(5)
+    p = _packer(ne)
+    try:
+        good, _ = _vote(rng)
+        assert p.pack_vote(good[:-1]) == -1
+        assert p.pack_vote(b"") == -1
+        assert p.pack_vote(bytes([2]) + good[1:]) == -1
+        assert p.counters()["reject"] == 3
+        assert p.count() == 0
+    finally:
+        p.close()
+
+
+def test_ingest_full_arena_resyncs_instead_of_wedging():
+    _native()
+    rng = random.Random(6)
+    ing = ZeroCopyIngest(capacity=2, ring_depth=2)
+    assert ing.note_vote_frame(_vote(rng)[0])
+    assert ing.note_vote_frame(_vote(rng)[0])
+    # third pack hits the full open arena: the plane resyncs (discard +
+    # key clear) so the NEXT vote stream can line up again, rather than
+    # wedging with a full arena whose claims never arrive
+    assert not ing.note_vote_frame(_vote(rng)[0])
+    assert not ing.active
+    assert ing.note_vote_frame(_vote(rng)[0])
+    assert ing.active
+
+
+def test_adoption_prefix_and_surplus_carry():
+    _native()
+    rng = random.Random(7)
+    ing = ZeroCopyIngest(capacity=16, ring_depth=3)
+    pairs = [_vote(rng) for _ in range(10)]
+    for f, _ in pairs:
+        assert ing.note_vote_frame(f)
+    claims = [c for _, c in pairs]
+    # first wave adopts a strict prefix; the surplus rows carry into
+    # the next arena and stay adoptable in order
+    w1 = ing.try_adopt(claims[:4], DEFAULT_WAVE_BUCKETS)
+    assert w1 is not None and w1.n == 4 and w1.rows == 16
+    w1.release()
+    w2 = ing.try_adopt(claims[4:], DEFAULT_WAVE_BUCKETS)
+    assert w2 is not None and w2.n == 6
+    w2.release()
+    assert not ing.active
+    assert ing.zero_copy_waves == 2 and ing.fallback_waves == 0
+
+
+def test_adoption_policy_disjoint_vs_overlap():
+    _native()
+    rng = random.Random(8)
+    ing = ZeroCopyIngest(capacity=16, ring_depth=2)
+    pairs = [_vote(rng) for _ in range(3)]
+    for f, _ in pairs:
+        ing.note_vote_frame(f)
+    claims = [c for _, c in pairs]
+    # a wave DISJOINT from the packed votes (pure QC/propose wave
+    # between vote bursts) must leave the arena untouched — it is not
+    # a fallback, the votes' own wave is still coming
+    other = [("one", b"\x11" * 32, b"\x22" * 32, b"\x33" * 64)]
+    assert ing.try_adopt(other, DEFAULT_WAVE_BUCKETS) is None
+    assert ing.active and ing.fallback_waves == 0
+    # a wave that OVERLAPS the packed stream out of position (dedup,
+    # a dropped vote, mixed ordering) can never realign: resync + count
+    mixed = [claims[1], claims[0]]
+    assert ing.try_adopt(mixed, DEFAULT_WAVE_BUCKETS) is None
+    assert not ing.active and ing.fallback_waves == 1
+    # after the resync the stream lines up again from scratch
+    for f, _ in pairs:
+        ing.note_vote_frame(f)
+    w = ing.try_adopt(claims, DEFAULT_WAVE_BUCKETS)
+    assert w is not None
+    w.release()
+
+
+def test_adopted_wave_release_is_idempotent():
+    _native()
+    rng = random.Random(9)
+    ing = ZeroCopyIngest(capacity=4, ring_depth=2)
+    f, c = _vote(rng)
+    ing.note_vote_frame(f)
+    w = ing.try_adopt([c], (4,))
+    assert isinstance(w, AdoptedWave)
+    w.release()
+    w.release()  # second release is a no-op, not a double recycle
+    assert ing.packer.counters()["recycle"] == 1
+
+
+class _PackedBackend:
+    """Device-shaped stub: records the packed call, verdicts by row."""
+
+    def __init__(self, rows_ok):
+        self.rows_ok = rows_ok
+        self.calls = 0
+
+    def verify_packed(self, dig, pk, sig, rows):
+        self.calls += 1
+        assert len(dig) == rows * 32
+        assert len(pk) == rows * 32
+        assert len(sig) == rows * 64
+        return self.rows_ok[:rows]
+
+
+def test_eval_claims_arena_device_path_and_release():
+    _native()
+    rng = random.Random(10)
+    ing = ZeroCopyIngest(capacity=4, ring_depth=2)
+    pairs = [_vote(rng) for _ in range(2)]
+    for f, _ in pairs:
+        ing.note_vote_frame(f)
+    claims = [c for _, c in pairs]
+    w = ing.try_adopt(claims, (4,))
+    assert w is not None and w.rows == 4
+    backend = _PackedBackend([True, False, True, True])
+    out = eval_claims_arena(backend, w, claims)
+    assert backend.calls == 1
+    assert out == [True, False]  # out[:n], pad rows dropped
+    assert w._released  # released even on the happy path
+
+
+def test_eval_claims_arena_falls_back_to_sync():
+    """A backend with neither a packed path nor the flat batch fast
+    path serves the CLAIM LIST through eval_claims_sync — the arena is
+    an accelerator, never a correctness dependency — and the arena is
+    still released."""
+    _native()
+    rng = random.Random(11)
+    ing = ZeroCopyIngest(capacity=4, ring_depth=2)
+    f, c = _vote(rng)
+    ing.note_vote_frame(f)
+    w = ing.try_adopt([c], (4,))
+    assert w is not None
+
+    class _Plain:
+        supports_flat_batch = False
+
+        def verify_many(self, digests, pks, sigs):
+            assert digests == [c[1]] and pks == [c[2]] and sigs == [c[3]]
+            return [True]
+
+    out = eval_claims_arena(_Plain(), w, [c])
+    assert out == [True]
+    assert out == eval_claims_sync(_Plain(), [c])
+    assert w._released
+
+
+def test_counters_surface_expected_names():
+    _native()
+    ing = ZeroCopyIngest(capacity=4, ring_depth=2)
+    counters = ing.counters()
+    for name in (
+        "packed", "reject", "full", "seal", "discard", "recycle",
+        "moved", "zero_copy_waves", "fallback_waves",
+    ):
+        assert name in counters, name

@@ -862,3 +862,259 @@ def test_manifest_schedule_links_corpus():
         buf = bytearray(frame)
         buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
         _decode_must_not_crash(bytes(buf))
+
+# ---------------------------------------------------------------------------
+# zero-copy ingest differential harness (ISSUE 20): the native frame
+# parser (native/wave_pack.cpp) and the Python Decoder must accept /
+# reject BYTE-IDENTICAL corpora — a frame only the native side accepts
+# would be mis-ingested past the codec's caps, and a frame only Python
+# accepts would silently lose the fast path.  Every test here drives
+# the SAME byte corpus through both and asserts zero divergence; the
+# suite skips cleanly where the native toolchain is absent.
+
+
+def _wave_native():
+    from hotstuff_tpu.crypto import native_ed25519 as ne
+
+    if not ne.wave_pack_available():
+        pytest.skip("native wave packer unavailable")
+    return ne
+
+
+def _py_accepts_vote(frame: bytes) -> bool:
+    from hotstuff_tpu.consensus.wire import TAG_VOTE
+
+    try:
+        tag, _ = decode_message(frame, scheme="ed25519")
+    except SerializationError:
+        return False
+    return tag == TAG_VOTE
+
+
+def _py_producer_items(frame: bytes):
+    from hotstuff_tpu.consensus.wire import TAG_PRODUCER_V2
+
+    try:
+        tag, payload = decode_message(frame, scheme="ed25519")
+    except SerializationError:
+        return None
+    if tag != TAG_PRODUCER_V2:
+        return None
+    return payload
+
+
+def _raw_vote_frame(rng):
+    """A wire-shaped ed25519 vote frame with random contents (decode
+    never verifies signatures, so random bytes exercise the codec the
+    same way real votes do) and the claim tuple ``Vote.claim()`` would
+    produce for it."""
+    import struct
+
+    h = rng.randbytes(32)
+    rnd = rng.randrange(1 << 63)
+    pk = rng.randbytes(32)
+    sig = rng.randbytes(64)
+    frame = (
+        bytes([1]) + h + struct.pack("<Q", rnd)
+        + struct.pack("<I", 32) + pk
+        + struct.pack("<I", 64) + sig
+    )
+    claim = (
+        "one",
+        Digest.of(h + struct.pack("<Q", rnd)).to_bytes(),
+        pk,
+        sig,
+    )
+    return frame, claim
+
+
+def test_ingest_tag_constants_match_wire():
+    """The receiver/service ingest taps hardcode wire tags (importing
+    consensus.wire there would cycle) — pin them to the live values."""
+    from hotstuff_tpu.consensus.wire import TAG_PRODUCER_V2, TAG_VOTE
+    from hotstuff_tpu.crypto.async_service import INGEST_TAG_VOTE
+    from hotstuff_tpu.network import receiver
+
+    assert INGEST_TAG_VOTE == TAG_VOTE
+    assert receiver._TAG_VOTE == TAG_VOTE
+    assert receiver._TAG_PRODUCER_V2 == TAG_PRODUCER_V2
+
+
+def test_native_vote_probe_matches_decoder():
+    """Accept/reject parity on the vote corpus: real signed votes,
+    every truncation, trailing junk, length-field bombs, and a
+    mutation storm — zero divergence allowed."""
+    import struct
+
+    ne = _wave_native()
+    rng = random.Random(0xF040)
+
+    def check(frame: bytes):
+        assert ne.probe_vote(frame) == _py_accepts_vote(frame), frame.hex()
+
+    # a REAL signed vote (and the decoder sanity-checks it first)
+    blocks = chain(3)
+    pk, sk = keys()[0]
+    real = encode_vote(signed_vote(blocks[1], pk, sk))
+    assert _py_accepts_vote(real) and ne.probe_vote(real)
+
+    # synthetic well-formed frames
+    frames = [real] + [_raw_vote_frame(rng)[0] for _ in range(20)]
+    for frame in frames[:4]:
+        for cut in range(len(frame) + 1):
+            check(frame[:cut])
+        check(frame + b"\x00")
+        check(frame + frame)
+    # forged pk/sig length prefixes around the fixed sizes
+    base = bytearray(frames[1])
+    for off in (41, 77):
+        for val in (0, 1, 31, 33, 48, 63, 65, 96, 1 << 16, 0xFFFFFFFF):
+            buf = bytearray(base)
+            buf[off : off + 4] = struct.pack("<I", val)
+            check(bytes(buf))
+    # mutation storm: single- and multi-byte flips
+    for frame in frames:
+        for _ in range(200):
+            buf = bytearray(frame)
+            for _ in range(rng.randrange(1, 4)):
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            check(bytes(buf))
+    # random tag-1-prefixed garbage of assorted lengths
+    for _ in range(500):
+        check(b"\x01" + rng.randbytes(rng.randrange(0, 200)))
+
+
+def test_native_pack_digest_matches_vote_claim():
+    """The digest the native packer computes (single-block SHA-512 over
+    hash||round) must equal ``Vote.claim()``'s — it becomes the claim
+    key the arena adoption matches against."""
+    ne = _wave_native()
+    from hotstuff_tpu.crypto.async_service import make_pad_claim
+
+    pad = make_pad_claim()
+    packer = ne.WavePacker(16, 2)
+    try:
+        assert packer.set_pad(pad[1], pad[2], pad[3])
+        blocks = chain(3)
+        for i, (pk, sk) in enumerate(keys()[:3]):
+            vote = signed_vote(blocks[1], pk, sk)
+            res = packer.pack_vote(encode_vote(vote))
+            assert not isinstance(res, int), res
+            slot, digest = res
+            assert slot == i
+            assert digest == vote.claim()[1]
+    finally:
+        packer.close()
+
+
+def test_native_producer_parse_matches_decoder():
+    """Producer-v2 parity: on every corpus frame the native parser and
+    the Python Decoder agree on accept/reject, and on acceptance the
+    digest column and body spans reproduce the decoded items exactly."""
+    import struct
+
+    ne = _wave_native()
+    from hotstuff_tpu.consensus.wire import (
+        MAX_PRODUCER_BATCH,
+        PRODUCER_FRAME_VERSION,
+        TAG_PRODUCER_V2,
+    )
+
+    assert ne.MAX_PRODUCER_BATCH == MAX_PRODUCER_BATCH
+
+    def check(frame: bytes):
+        native = ne.parse_producer(frame)
+        items = _py_producer_items(frame)
+        if items is None:
+            assert native is None, frame[:32].hex()
+            return
+        assert native is not None, frame[:32].hex()
+        digests, spans = native
+        assert len(spans) == len(items)
+        for i, (digest, body) in enumerate(items):
+            assert digests[i * 32 : (i + 1) * 32] == digest.to_bytes()
+            off, ln = spans[i]
+            assert frame[off : off + ln] == body
+
+    rng = random.Random(0xF041)
+    frames = [
+        _v2_frame(1, body_size=0),
+        _v2_frame(5),
+        _v2_frame(16, body_size=1),
+        _v2_frame(3, body_size=300),
+    ]
+    for frame in frames:
+        check(frame)
+        for cut in range(len(frame) + 1):
+            check(frame[:cut])
+        check(frame + b"\x00")
+    # version bytes and count bombs
+    frame = frames[1]
+    for version in (0, 1, 3, 255):
+        check(bytes([frame[0], version]) + frame[2:])
+    head = bytes([TAG_PRODUCER_V2, PRODUCER_FRAME_VERSION])
+    for count in (0, 1, MAX_PRODUCER_BATCH, MAX_PRODUCER_BATCH + 1,
+                  0xFFFFFFFF):
+        check(head + struct.pack("<I", count))
+        check(head + struct.pack("<I", count) + frame[6:])
+    # per-item length bombs around the body cap
+    for ln in (0, 1, 65536, 65537, 0xFFFFFFFF):
+        bomb = head + struct.pack("<I", 1) + b"\xaa" * 32
+        bomb += struct.pack("<I", ln) + b"\xbb" * min(ln, 70_000)
+        check(bomb)
+    # mutation storm
+    for frame in frames:
+        for _ in range(300):
+            buf = bytearray(frame)
+            for _ in range(rng.randrange(1, 4)):
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            check(bytes(buf))
+    # random tag-6-prefixed garbage
+    for _ in range(500):
+        check(bytes([TAG_PRODUCER_V2]) + rng.randbytes(rng.randrange(0, 300)))
+
+
+def test_flatten_claims_vs_arena_columns_every_bucket():
+    """Column parity at every wave bucket: the adopted arena's
+    digest/pk/sig columns must be byte-identical to what
+    ``flatten_claims`` produces for the same claims, with pad rows
+    equal to the shared pad claim — the property that makes arena
+    adoption a drop-in replacement for the flatten/prepare hop."""
+    np = pytest.importorskip("numpy")
+    _wave_native()
+    from hotstuff_tpu.crypto.async_service import (
+        DEFAULT_WAVE_BUCKETS,
+        ZeroCopyIngest,
+        flatten_claims,
+        make_pad_claim,
+    )
+
+    rng = random.Random(0xF042)
+    pad = make_pad_claim()
+    ing = ZeroCopyIngest(capacity=DEFAULT_WAVE_BUCKETS[-1], ring_depth=3)
+    for bucket in DEFAULT_WAVE_BUCKETS:
+        for n in (bucket, max(1, bucket - 3)):
+            pairs = [_raw_vote_frame(rng) for _ in range(n)]
+            for frame, _ in pairs:
+                assert ing.note_vote_frame(frame)
+            claims = [c for _, c in pairs]
+            wave = ing.try_adopt(claims, DEFAULT_WAVE_BUCKETS)
+            assert wave is not None, (bucket, n)
+            assert wave.n == n and wave.rows == bucket
+            digests, pks, sigs, spans = flatten_claims(claims)
+            assert spans == [(i, i + 1) for i in range(n)]
+            dig_v = np.frombuffer(wave.dig, np.uint8).reshape(bucket, 32)
+            pk_v = np.frombuffer(wave.pk, np.uint8).reshape(bucket, 32)
+            sig_v = np.frombuffer(wave.sig, np.uint8).reshape(bucket, 64)
+            for i in range(n):
+                assert dig_v[i].tobytes() == digests[i]
+                assert pk_v[i].tobytes() == pks[i]
+                assert sig_v[i].tobytes() == sigs[i]
+            for i in range(n, bucket):
+                assert dig_v[i].tobytes() == pad[1]
+                assert pk_v[i].tobytes() == pad[2]
+                assert sig_v[i].tobytes() == pad[3]
+            wave.release()
+    counters = ing.counters()
+    assert counters["zero_copy_waves"] == 2 * len(DEFAULT_WAVE_BUCKETS)
+    assert counters["fallback_waves"] == 0
